@@ -1,0 +1,92 @@
+"""Benchmark: MNIST ConvNet training throughput, images/sec/chip.
+
+The BASELINE.json north-star metric. The reference's published number is
+22.72 s wall-clock for 3 epochs x 60k images + eval on one (unnamed) GPU
+(README.md:201) => ~7,923 images/sec; `vs_baseline` is the ratio of this
+run's steady-state images/sec/chip to that.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Config mirrors the reference DDP variant per-replica batch 32 with the
+TPU-native AMP equivalent (bf16); flags allow fp32/other batch sizes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+REFERENCE_IMAGES_PER_SEC = 60000 * 3 / 22.72  # README.md:201 (incl. eval)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser("bench")
+    p.add_argument("--batch_size", type=int, default=32, help="per replica")
+    p.add_argument("--precision", default="bf16", choices=["fp32", "bf16"])
+    p.add_argument("--model", default="convnet")
+    p.add_argument("--dataset", default="mnist")
+    p.add_argument("--warmup", type=int, default=20)
+    p.add_argument("--steps", type=int, default=200)
+    args = p.parse_args(argv)
+
+    import jax
+
+    from ddp_practice_tpu.config import MeshConfig, TrainConfig
+    from ddp_practice_tpu.data.loader import prefetch_to_device
+    from ddp_practice_tpu.train.loop import Trainer
+
+    cfg = TrainConfig(
+        model=args.model,
+        dataset=args.dataset,
+        batch_size=args.batch_size,
+        precision=args.precision,
+        log_every_steps=0,
+        mesh=MeshConfig(data=-1),
+    )
+    trainer = Trainer(cfg)
+    n_chips = jax.device_count()
+
+    def batches():
+        epoch = 0
+        while True:
+            trainer.train_loader.set_epoch(epoch)
+            yield from prefetch_to_device(
+                iter(trainer.train_loader), trainer.batch_shardings, size=2
+            )
+            epoch += 1
+
+    it = batches()
+    state = trainer.state
+    for _ in range(args.warmup):
+        state, metrics = trainer.train_step(state, next(it))
+    jax.block_until_ready(state.params)
+
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        state, metrics = trainer.train_step(state, next(it))
+    jax.block_until_ready(state.params)
+    dt = time.perf_counter() - t0
+
+    ips = args.steps * trainer.global_batch / dt
+    ips_per_chip = ips / n_chips
+    print(
+        json.dumps(
+            {
+                "metric": f"{args.model}/{args.dataset} train throughput "
+                          f"(bs={args.batch_size}/replica, {args.precision}, "
+                          f"{n_chips} chip(s))",
+                "value": round(ips_per_chip, 1),
+                "unit": "images/sec/chip",
+                "vs_baseline": round(ips_per_chip / REFERENCE_IMAGES_PER_SEC, 3),
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

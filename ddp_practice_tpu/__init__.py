@@ -1,0 +1,23 @@
+"""ddp_practice_tpu — a TPU-native (JAX/XLA/shard_map/pallas) training framework.
+
+Brand-new implementation of the capabilities of the reference `gbbin/DDP-practice`
+(single/multi-device data-parallel training with mixed precision), re-designed
+TPU-first:
+
+- NCCL process groups        -> `jax.distributed` + `jax.sharding.Mesh`
+  (reference: ddp_main.py:69-73)
+- DistributedDataParallel    -> `lax.pmean` gradient sync inside a jitted,
+  shard_mapped train step (reference: ddp_main.py:121-123)
+- SyncBatchNorm              -> cross-replica `pmean` of batch statistics via
+  BatchNorm(axis_name=...) (reference: ddp_main.py:120)
+- autocast + GradScaler      -> native bf16 precision policy, fp32 params
+  (reference: ddp_main.py:31,126,91-93)
+- DistributedSampler         -> per-host sharded input with (seed, epoch)-keyed
+  shuffling (reference: ddp_main.py:130-142,160)
+"""
+
+__version__ = "0.1.0"
+
+from ddp_practice_tpu.config import TrainConfig, MeshConfig, PrecisionPolicy
+
+__all__ = ["TrainConfig", "MeshConfig", "PrecisionPolicy", "__version__"]

@@ -1,0 +1,94 @@
+"""Checkpointing: save AND restore (the reference only saves).
+
+Reference contract: rank-0-only `torch.save({"model": ..., "scaler": ...})`
+once at end of training (origin_main.py:113, ddp_main.py:165-169); no load
+path exists (SURVEY §2.5). Here: process-0 writes the full train-state
+pytree plus a manifest carrying step count and the precision-policy name
+(the slot where the reference kept GradScaler state — with bf16 there is no
+scaler, but the schema keeps the field for continuity), and `restore`
+rebuilds a sharded state on any mesh.
+
+Format: one .npz of flattened leaves keyed by pytree path + manifest.json.
+Self-contained (no orbax API surface), multi-host-safe: only process 0
+writes; every process reads.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Optional
+
+import jax
+import numpy as np
+from jax.tree_util import keystr, tree_flatten_with_path, tree_unflatten
+
+_LEAVES = "leaves.npz"
+_MANIFEST = "manifest.json"
+
+
+def save(directory: str, state: Any, *, extra: Optional[dict] = None) -> None:
+    """Write state on process 0 (the rank-0 gate of ddp_main.py:165-169)."""
+    if jax.process_index() != 0:
+        return
+    os.makedirs(directory, exist_ok=True)
+    paths_and_leaves, treedef = tree_flatten_with_path(state)
+    arrays = {}
+    names = []
+    for i, (path, leaf) in enumerate(paths_and_leaves):
+        name = f"leaf_{i}"
+        names.append(keystr(path))
+        arrays[name] = np.asarray(jax.device_get(leaf))
+    np.savez(os.path.join(directory, _LEAVES), **arrays)
+    manifest = {"paths": names, "extra": extra or {}}
+    with open(os.path.join(directory, _MANIFEST), "w") as f:
+        json.dump(manifest, f, indent=2)
+
+
+def restore(directory: str, target: Any, *, shardings: Any = None) -> Any:
+    """Rebuild `target`-structured state from a checkpoint.
+
+    Leaves are matched by position with path-string verification. With
+    `shardings` (a matching pytree of NamedSharding), leaves are placed
+    sharded — so a checkpoint written on one mesh restores onto another
+    (e.g. single-chip -> v4-8).
+    """
+    data = np.load(os.path.join(directory, _LEAVES))
+    with open(os.path.join(directory, _MANIFEST)) as f:
+        manifest = json.load(f)
+    paths_and_leaves, treedef = tree_flatten_with_path(target)
+    if len(paths_and_leaves) != len(manifest["paths"]):
+        raise ValueError(
+            f"checkpoint has {len(manifest['paths'])} leaves; "
+            f"target has {len(paths_and_leaves)}"
+        )
+    leaves = []
+    for i, (path, leaf) in enumerate(paths_and_leaves):
+        want = keystr(path)
+        got = manifest["paths"][i]
+        if want != got:
+            raise ValueError(f"checkpoint leaf {i} is {got!r}; target wants {want!r}")
+        arr = data[f"leaf_{i}"]
+        if hasattr(leaf, "dtype") and arr.dtype != leaf.dtype:
+            arr = arr.astype(leaf.dtype)
+        leaves.append(arr)
+    restored = tree_unflatten(treedef, leaves)
+    if shardings is not None:
+        restored = jax.tree.map(
+            lambda x, s: jax.device_put(x, s), restored, shardings
+        )
+    return restored
+
+
+def latest_manifest(directory: str) -> Optional[dict]:
+    path = os.path.join(directory, _MANIFEST)
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return json.load(f)
+
+
+def exists(directory: str) -> bool:
+    return os.path.exists(os.path.join(directory, _LEAVES)) and os.path.exists(
+        os.path.join(directory, _MANIFEST)
+    )

@@ -1,0 +1,114 @@
+"""CLI launcher.
+
+One command replaces both reference launch styles (SURVEY §2.4): the
+mp.spawn parent (ddp_main.py:173-178) and torchrun (README launch cmd) —
+on TPU there is one process per host, so "launching" is just running this
+module; multi-host runs add --coordinator (no hardcoded port — the
+reference pins 19198, ddp_main.py:62).
+
+Parity flags kept: -e/--epochs (default 3), -b/--batch_size (default 32,
+per data-parallel replica) — origin_main.py:34-54. `--gpu` has no TPU
+meaning; `--devices N` limits visible local devices instead.
+
+Examples:
+  python -m ddp_practice_tpu.cli                      # ConvNet/MNIST parity run
+  python -m ddp_practice_tpu.cli --precision bf16     # the "AMP" variant
+  python -m ddp_practice_tpu.cli --model vit_tiny --dataset cifar10 \\
+      --tensor 2 --optimizer adamw --lr 1e-3
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import time
+
+from ddp_practice_tpu.config import MeshConfig, TrainConfig
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser("ddp_practice_tpu")
+    p.add_argument("-e", "--epochs", type=int, default=3)
+    p.add_argument("-b", "--batch_size", type=int, default=32,
+                   help="per data-parallel replica, like the reference")
+    p.add_argument("--model", default="convnet",
+                   choices=["convnet", "resnet18", "resnet50", "vit_tiny"])
+    p.add_argument("--dataset", default="mnist")
+    p.add_argument("--data_dir", default="./data")
+    p.add_argument("--lr", type=float, default=1e-4)
+    p.add_argument("--optimizer", default="sgd", choices=["sgd", "adam", "adamw"])
+    p.add_argument("--momentum", type=float, default=0.0)
+    p.add_argument("--weight_decay", type=float, default=0.0)
+    p.add_argument("--lr_schedule", default="constant",
+                   choices=["constant", "cosine", "warmup_cosine"])
+    p.add_argument("--scale_lr", action="store_true",
+                   help="scale lr by replica count (the reference deliberately "
+                        "does not; README.md:506)")
+    p.add_argument("--seed", type=int, default=3407)
+    p.add_argument("--precision", default="fp32", choices=["fp32", "bf16"])
+    p.add_argument("--data_axis", type=int, default=-1)
+    p.add_argument("--seq", type=int, default=1, help="sequence-parallel degree")
+    p.add_argument("--tensor", type=int, default=1, help="tensor-parallel degree")
+    p.add_argument("--devices", type=int, default=0,
+                   help="use only the first N local devices (0 = all)")
+    p.add_argument("--coordinator", default=None,
+                   help="host:port for multi-host rendezvous")
+    p.add_argument("--num_processes", type=int, default=None)
+    p.add_argument("--process_id", type=int, default=None)
+    p.add_argument("--ckpt_dir", default=None)
+    p.add_argument("--resume", action="store_true")
+    p.add_argument("--eval_every", type=int, default=0)
+    p.add_argument("--log_every", type=int, default=100)
+    p.add_argument("--profile_dir", default=None)
+    p.add_argument("--loader", default="auto", choices=["auto", "native", "python"])
+    p.add_argument("--json", action="store_true", help="print summary as JSON")
+    return p
+
+
+def config_from_args(args) -> TrainConfig:
+    return TrainConfig(
+        model=args.model,
+        dataset=args.dataset,
+        data_dir=args.data_dir,
+        epochs=args.epochs,
+        batch_size=args.batch_size,
+        learning_rate=args.lr,
+        optimizer=args.optimizer,
+        momentum=args.momentum,
+        weight_decay=args.weight_decay,
+        lr_schedule=args.lr_schedule,
+        scale_lr_by_replicas=args.scale_lr,
+        seed=args.seed,
+        precision=args.precision,
+        mesh=MeshConfig(data=args.data_axis, seq=args.seq, tensor=args.tensor),
+        coordinator_address=args.coordinator,
+        num_processes=args.num_processes,
+        process_id=args.process_id,
+        checkpoint_dir=args.ckpt_dir,
+        resume=args.resume,
+        eval_every_epochs=args.eval_every,
+        log_every_steps=args.log_every,
+        profile_dir=args.profile_dir,
+        loader_backend=args.loader,
+    )
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.devices:
+        import os
+
+        os.environ.setdefault("JAX_NUM_CPU_DEVICES", str(args.devices))
+    from ddp_practice_tpu.train.loop import fit  # deferred: jax import cost
+
+    t0 = time.time()
+    summary = fit(config_from_args(args))
+    summary["wall_seconds"] = time.time() - t0
+    if args.json:
+        print(json.dumps(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,19 @@
+"""Input pipeline: datasets, per-host sharding, batching.
+
+Replaces the reference's torchvision MNIST + DataLoader + DistributedSampler
+stack (origin_main.py:88-107, ddp_main.py:127-156) with NumPy-array datasets,
+a deterministic (seed, epoch)-keyed global shuffle, per-host strided shards,
+and device placement through `jax.make_array_from_process_local_data`.
+"""
+
+from ddp_practice_tpu.data.datasets import Dataset, load_dataset
+from ddp_practice_tpu.data.sharding import ShardSpec, epoch_indices
+from ddp_practice_tpu.data.loader import DataLoader
+
+__all__ = [
+    "Dataset",
+    "load_dataset",
+    "ShardSpec",
+    "epoch_indices",
+    "DataLoader",
+]

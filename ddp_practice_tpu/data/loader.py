@@ -1,0 +1,124 @@
+"""Batched, sharded, deterministic data loader.
+
+Replaces the reference's `DataLoader(num_workers=4, pin_memory=True)` +
+`DistributedSampler` stack (origin_main.py:91-107, ddp_main.py:127-156).
+On TPU the analogue of the pinned-memory H2D pipeline is forming globally
+sharded `jax.Array`s from process-local numpy data and letting the runtime
+overlap the transfer; `prefetch_to_device` below keeps a small queue of
+batches in flight.
+
+Batch assembly (index gather) can run through the optional native C++
+backend (ddp_practice_tpu/data/native_loader.py) when built; the numpy
+path is the always-available fallback.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Iterator, Optional
+
+import numpy as np
+
+from ddp_practice_tpu.data.datasets import Dataset
+from ddp_practice_tpu.data.sharding import ShardSpec, epoch_indices, pad_to_multiple
+
+
+class DataLoader:
+    """Iterates dicts of numpy arrays: image, label, weight.
+
+    One instance per process; each process sees only its slice of every
+    global batch. `set_epoch` mirrors the reference's reshuffle contract
+    (ddp_main.py:160).
+    """
+
+    def __init__(
+        self,
+        dataset: Dataset,
+        *,
+        global_batch_size: int,
+        shard: Optional[ShardSpec] = None,
+        seed: int = 3407,
+        shuffle: bool = True,
+        drop_last: bool = False,
+        backend: str = "auto",
+    ):
+        self.dataset = dataset
+        self.global_batch_size = int(global_batch_size)
+        self.shard = shard or ShardSpec()
+        self.seed = seed
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self._epoch = 0
+        self._gather = _make_gather(backend, dataset)
+
+    def set_epoch(self, epoch: int) -> None:
+        self._epoch = int(epoch)
+
+    @property
+    def steps_per_epoch(self) -> int:
+        n = len(self.dataset)
+        if self.drop_last:
+            return n // self.global_batch_size
+        return -(-n // self.global_batch_size)
+
+    def __len__(self) -> int:
+        return self.steps_per_epoch
+
+    def __iter__(self) -> Iterator[dict]:
+        n = len(self.dataset)
+        order = epoch_indices(n, seed=self.seed, epoch=self._epoch, shuffle=self.shuffle)
+        if self.drop_last:
+            usable = (n // self.global_batch_size) * self.global_batch_size
+            order, weights = order[:usable], np.ones(usable, dtype=np.float32)
+        else:
+            order, weights = pad_to_multiple(order, self.global_batch_size)
+        sl = self.shard.local_slice(self.global_batch_size)
+        for start in range(0, len(order), self.global_batch_size):
+            gidx = order[start : start + self.global_batch_size]
+            gw = weights[start : start + self.global_batch_size]
+            lidx, lw = gidx[sl], gw[sl]
+            images, labels = self._gather(lidx)
+            yield {"image": images, "label": labels, "weight": lw}
+
+
+def _make_gather(backend: str, dataset: Dataset):
+    """Return fn(indices) -> (images, labels), optionally native-accelerated."""
+    if backend in ("auto", "native"):
+        try:
+            from ddp_practice_tpu.data import native_loader
+
+            gather = native_loader.make_gather(dataset)
+            if gather is not None:
+                return gather
+            if backend == "native":
+                raise RuntimeError("native loader requested but not built")
+        except ImportError:
+            if backend == "native":
+                raise
+    return lambda idx: (dataset.images[idx], dataset.labels[idx])
+
+
+def prefetch_to_device(iterator, sharding, *, size: int = 2):
+    """Form globally sharded jax.Arrays from local batches, keeping `size`
+    batches in flight — the TPU analogue of pin_memory+async H2D
+    (origin_main.py:96,60-61).
+
+    `sharding` maps batch keys to `jax.sharding.NamedSharding`s (a single
+    sharding is broadcast to all keys).
+    """
+    import jax
+
+    def to_global(batch):
+        out = {}
+        for k, v in batch.items():
+            sh = sharding[k] if isinstance(sharding, dict) else sharding
+            out[k] = jax.make_array_from_process_local_data(sh, np.asarray(v))
+        return out
+
+    queue = collections.deque()
+    for batch in iterator:
+        queue.append(to_global(batch))
+        if len(queue) > size:
+            yield queue.popleft()
+    while queue:
+        yield queue.popleft()

@@ -1,0 +1,102 @@
+"""Model zoo.
+
+The reference defines a single ConvNet three times over (origin_main.py:9-31,
+ddp_main.py:13-36, ddp_main_torchrun.py:12-35). Here models are flax.linen
+modules defined once, parameterized by a precision policy and an optional
+data-parallel axis name (which turns every BatchNorm into a SyncBatchNorm,
+replacing ddp_main.py:120).
+
+Ladder beyond parity (BASELINE.json configs): ResNet-18/50, ViT-Tiny.
+"""
+
+from typing import Optional
+
+from ddp_practice_tpu.config import PrecisionPolicy
+from ddp_practice_tpu.models.convnet import ConvNet
+from ddp_practice_tpu.models.resnet import ResNet, ResNet18, ResNet50
+from ddp_practice_tpu.models.vit import ViT, ViTTiny
+
+_REGISTRY = {}
+
+
+def register(name):
+    def deco(fn):
+        _REGISTRY[name] = fn
+        return fn
+    return deco
+
+
+def create_model(
+    name: str,
+    *,
+    num_classes: int = 10,
+    policy: Optional[PrecisionPolicy] = None,
+    axis_name: Optional[str] = None,
+    **kwargs,
+):
+    """Instantiate a model by name.
+
+    axis_name: data-parallel mesh axis for cross-replica batch statistics
+    (the SyncBatchNorm equivalent); None for single-device training.
+    """
+    policy = policy or PrecisionPolicy.fp32()
+    name = name.lower()
+    if name not in _REGISTRY:
+        raise ValueError(f"unknown model {name!r}; have {sorted(_REGISTRY)}")
+    return _REGISTRY[name](
+        num_classes=num_classes, policy=policy, axis_name=axis_name, **kwargs
+    )
+
+
+@register("convnet")
+def _convnet(*, num_classes, policy, axis_name, **kw):
+    return ConvNet(
+        num_classes=num_classes,
+        dtype=policy.compute_dtype,
+        param_dtype=policy.param_dtype,
+        axis_name=axis_name,
+        **kw,
+    )
+
+
+@register("resnet18")
+def _resnet18(*, num_classes, policy, axis_name, **kw):
+    return ResNet18(
+        num_classes=num_classes,
+        dtype=policy.compute_dtype,
+        param_dtype=policy.param_dtype,
+        axis_name=axis_name,
+        **kw,
+    )
+
+
+@register("resnet50")
+def _resnet50(*, num_classes, policy, axis_name, **kw):
+    return ResNet50(
+        num_classes=num_classes,
+        dtype=policy.compute_dtype,
+        param_dtype=policy.param_dtype,
+        axis_name=axis_name,
+        **kw,
+    )
+
+
+@register("vit_tiny")
+def _vit_tiny(*, num_classes, policy, axis_name, **kw):
+    return ViTTiny(
+        num_classes=num_classes,
+        dtype=policy.compute_dtype,
+        param_dtype=policy.param_dtype,
+        **kw,
+    )
+
+
+__all__ = [
+    "create_model",
+    "ConvNet",
+    "ResNet",
+    "ResNet18",
+    "ResNet50",
+    "ViT",
+    "ViTTiny",
+]

@@ -1,0 +1,59 @@
+"""The reference ConvNet, as a single flax.linen module.
+
+Architecture (reference origin_main.py:12-24, identical in ddp_main.py:16-28):
+two blocks of [Conv 5x5 pad 2 -> BatchNorm -> ReLU -> MaxPool 2x2]
+(channels 1 -> 16 -> 32), flatten, Linear(7*7*32 -> 10).
+
+Differences by design (TPU-first, not a port):
+- NHWC layout (XLA:TPU-preferred) instead of torch NCHW.
+- Mixed precision is a dtype policy on the module (compute in `dtype`,
+  params in `param_dtype`) instead of an autocast context manager
+  (ddp_main.py:31-36); logits are returned in fp32 for a stable loss.
+- `axis_name` turns BatchNorm statistics into cross-replica statistics via
+  `lax.pmean` over the data axis — the SyncBatchNorm equivalent
+  (ddp_main.py:120) — with zero code change at the call site.
+- BatchNorm momentum 0.9 matches torch's default momentum=0.1 under
+  linen's opposite convention; epsilon 1e-5 matches torch's default.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class ConvNet(nn.Module):
+    num_classes: int = 10
+    features: Sequence[int] = (16, 32)
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+    axis_name: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, *, train: bool = False) -> jnp.ndarray:
+        x = x.astype(self.dtype)
+        for feat in self.features:
+            x = nn.Conv(
+                feat,
+                kernel_size=(5, 5),
+                padding=2,
+                dtype=self.dtype,
+                param_dtype=self.param_dtype,
+            )(x)
+            x = nn.BatchNorm(
+                use_running_average=not train,
+                momentum=0.9,
+                epsilon=1e-5,
+                dtype=self.dtype,
+                param_dtype=self.param_dtype,
+                axis_name=self.axis_name,
+            )(x)
+            x = nn.relu(x)
+            x = nn.max_pool(x, window_shape=(2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(
+            self.num_classes, dtype=self.dtype, param_dtype=self.param_dtype
+        )(x)
+        return x.astype(jnp.float32)

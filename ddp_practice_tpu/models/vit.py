@@ -1,0 +1,152 @@
+"""Vision Transformer, TPU-first flax.linen implementation.
+
+Not in the reference (no attention anywhere, origin_main.py:9-31); this is
+the BASELINE.json transformer rung ("ViT-Tiny on CIFAR-10, pjit DP") and the
+flagship model for sharded training: its parameter names line up with the
+tensor-parallel sharding rules in `ddp_practice_tpu/parallel/sharding_rules.py`
+(attention QKV/out projections and MLP in/out projections shard over the
+'tensor' mesh axis), and its attention can run under sequence parallelism via
+`ddp_practice_tpu.parallel.ring.ring_attention`.
+
+TPU notes: everything is batched matmul (MXU-friendly); attention uses the
+framework's own `ops.attention` (switchable between a fused jnp path and the
+ring path); compute dtype policy-driven (bf16), logits fp32.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ddp_practice_tpu.ops.attention import dot_product_attention
+
+
+class MlpBlock(nn.Module):
+    mlp_dim: int
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        d = x.shape[-1]
+        x = nn.Dense(
+            self.mlp_dim, dtype=self.dtype, param_dtype=self.param_dtype, name="fc_in"
+        )(x)
+        x = nn.gelu(x)
+        x = nn.Dense(d, dtype=self.dtype, param_dtype=self.param_dtype, name="fc_out")(x)
+        return x
+
+
+class SelfAttention(nn.Module):
+    num_heads: int
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+    seq_axis: Optional[str] = None  # mesh axis for ring attention (sequence parallel)
+
+    @nn.compact
+    def __call__(self, x):
+        b, s, d = x.shape
+        assert d % self.num_heads == 0, (d, self.num_heads)
+        head_dim = d // self.num_heads
+        qkv = nn.DenseGeneral(
+            (3, self.num_heads, head_dim),
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            name="qkv",
+        )(x)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        out = dot_product_attention(q, k, v, seq_axis=self.seq_axis)
+        out = nn.DenseGeneral(
+            d,
+            axis=(-2, -1),
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            name="out",
+        )(out)
+        return out
+
+
+class EncoderBlock(nn.Module):
+    num_heads: int
+    mlp_dim: int
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+    seq_axis: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x):
+        y = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype, name="ln1")(x)
+        y = SelfAttention(
+            self.num_heads,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            seq_axis=self.seq_axis,
+            name="attn",
+        )(y)
+        x = x + y
+        y = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype, name="ln2")(x)
+        y = MlpBlock(
+            self.mlp_dim, dtype=self.dtype, param_dtype=self.param_dtype, name="mlp"
+        )(y)
+        return x + y
+
+
+class ViT(nn.Module):
+    num_classes: int = 10
+    patch_size: int = 4
+    hidden_dim: int = 192
+    depth: int = 12
+    num_heads: int = 3
+    mlp_dim: int = 768
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+    seq_axis: Optional[str] = None
+    axis_name: Optional[str] = None  # accepted for registry uniformity (no BN)
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        x = x.astype(self.dtype)
+        p = self.patch_size
+        x = nn.Conv(
+            self.hidden_dim,
+            kernel_size=(p, p),
+            strides=(p, p),
+            padding="VALID",
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            name="patch_embed",
+        )(x)
+        b, h, w, d = x.shape
+        x = x.reshape((b, h * w, d))
+        pos = self.param(
+            "pos_embed",
+            nn.initializers.normal(stddev=0.02),
+            (1, h * w, d),
+            self.param_dtype,
+        )
+        x = x + pos.astype(self.dtype)
+        for i in range(self.depth):
+            x = EncoderBlock(
+                self.num_heads,
+                self.mlp_dim,
+                dtype=self.dtype,
+                param_dtype=self.param_dtype,
+                seq_axis=self.seq_axis,
+                name=f"block{i}",
+            )(x)
+        x = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype, name="ln_f")(x)
+        x = jnp.mean(x, axis=1)  # global average pool (no class token; MXU-friendlier)
+        x = nn.Dense(
+            self.num_classes, dtype=self.dtype, param_dtype=self.param_dtype, name="head"
+        )(x)
+        return x.astype(jnp.float32)
+
+
+def ViTTiny(**kw):
+    kw.setdefault("hidden_dim", 192)
+    kw.setdefault("depth", 12)
+    kw.setdefault("num_heads", 3)
+    kw.setdefault("mlp_dim", 768)
+    return ViT(**kw)

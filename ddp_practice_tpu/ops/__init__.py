@@ -1,0 +1,11 @@
+"""Numerical ops: losses, attention, and (pallas) custom kernels.
+
+The reference's ops are all external CUDA/cuDNN kernels reached through
+torch layer calls (SURVEY §2.2). Here the hot ops are XLA:TPU-compiled jnp
+with pallas kernels where fusion matters.
+"""
+
+from ddp_practice_tpu.ops.losses import cross_entropy, accuracy_counts
+from ddp_practice_tpu.ops.attention import dot_product_attention
+
+__all__ = ["cross_entropy", "accuracy_counts", "dot_product_attention"]

@@ -1,0 +1,58 @@
+"""Attention ops.
+
+No attention exists in the reference (its model is a 2-conv CNN,
+origin_main.py:9-31); this implements the transformer path of the model
+ladder. Two execution paths:
+
+- fused single-device/GSPMD path: plain jnp softmax attention, fp32
+  accumulation, fused by XLA onto the MXU.
+- sequence-parallel path: `parallel.ring.ring_attention` — blockwise
+  attention with online softmax, K/V blocks rotated around the 'seq' mesh
+  axis with `lax.ppermute` (ring attention; long-context first-class).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+
+def dot_product_attention(
+    q: jnp.ndarray,  # (batch, seq, heads, head_dim)
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    causal: bool = False,
+    seq_axis: Optional[str] = None,
+) -> jnp.ndarray:
+    """Multi-head attention; dispatches to ring attention when `seq_axis`
+    names a mesh axis the sequence dimension is sharded over."""
+    if seq_axis is not None:
+        from ddp_practice_tpu.parallel.ring import ring_attention
+
+        return ring_attention(q, k, v, axis_name=seq_axis, causal=causal)
+    return _attention(q, k, v, causal=causal)
+
+
+def _attention(q, k, v, *, causal: bool) -> jnp.ndarray:
+    in_dtype = q.dtype
+    head_dim = q.shape[-1]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(head_dim, jnp.float32))
+    # (b, s, h, d) -> scores (b, h, sq, sk), accumulate in fp32
+    scores = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        sq, sk = scores.shape[-2], scores.shape[-1]
+        mask = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
+        scores = jnp.where(mask, scores, jnp.asarray(-1e30, scores.dtype))
+    probs = jnp.exp(
+        scores - jnp.max(scores, axis=-1, keepdims=True)
+    )
+    probs = probs / jnp.sum(probs, axis=-1, keepdims=True)
+    out = jnp.einsum(
+        "bhqk,bkhd->bqhd", probs.astype(in_dtype), v,
+        preferred_element_type=jnp.float32,
+    )
+    return out.astype(in_dtype)

@@ -1,0 +1,60 @@
+"""Loss and metric ops.
+
+Replaces `nn.CrossEntropyLoss` (origin_main.py:86) and the eval
+size/correct accumulators (ddp_main.py:96-112). Loss math runs in fp32
+regardless of the compute dtype (the reference gets this from autocast's
+fp32 loss policy; here it's explicit).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+
+def cross_entropy(
+    logits: jnp.ndarray,
+    labels: jnp.ndarray,
+    *,
+    weight: Optional[jnp.ndarray] = None,
+    label_smoothing: float = 0.0,
+) -> jnp.ndarray:
+    """Mean softmax cross-entropy over the (global) batch.
+
+    `weight` masks padded samples (0.0) so sums stay exact under sharded
+    uneven batches — the exactness fix for the reference's padded-eval
+    double counting (SURVEY §2.5).
+    """
+    logits = logits.astype(jnp.float32)
+    num_classes = logits.shape[-1]
+    onehot = jnp.eye(num_classes, dtype=jnp.float32)[labels]
+    if label_smoothing > 0.0:
+        onehot = onehot * (1.0 - label_smoothing) + label_smoothing / num_classes
+    logprobs = logits - jnp.max(logits, axis=-1, keepdims=True)
+    logprobs = logprobs - jnp.log(
+        jnp.sum(jnp.exp(logprobs), axis=-1, keepdims=True)
+    )
+    nll = -jnp.sum(onehot * logprobs, axis=-1)
+    if weight is None:
+        return jnp.mean(nll)
+    denom = jnp.maximum(jnp.sum(weight), 1.0)
+    return jnp.sum(nll * weight) / denom
+
+
+def accuracy_counts(
+    logits: jnp.ndarray,
+    labels: jnp.ndarray,
+    *,
+    weight: Optional[jnp.ndarray] = None,
+) -> tuple:
+    """(correct, total) counts — the eval contract of ddp_main.py:99-109.
+
+    Under GSPMD these sums over sharded arrays compile to global reductions
+    (the `dist.reduce(SUM)` equivalent happens inside XLA).
+    """
+    pred = jnp.argmax(logits, axis=-1)
+    match = (pred == labels).astype(jnp.float32)
+    if weight is None:
+        return jnp.sum(match), jnp.asarray(match.size, jnp.float32)
+    return jnp.sum(match * weight), jnp.sum(weight)

@@ -1,0 +1,38 @@
+"""Distributed runtime: mesh, multi-host init, sharding rules, collectives.
+
+This package is the NCCL/DDP/torchrun replacement (SURVEY §2.2, §5.8):
+
+- `dist.initialize`      ≈ init_process_group(nccl, env://) (ddp_main.py:69-73)
+- `mesh.build_mesh`      ≈ rank/world bookkeeping — the mesh IS the backend
+- GSPMD sharding (jit + NamedSharding) ≈ the DDP reducer's gradient
+  all-reduce, lowered by XLA onto ICI/DCN
+- `ring.ring_attention`  — sequence/context parallelism (absent from the
+  reference; first-class here)
+- `sharding_rules`       — tensor-parallel parameter PartitionSpecs
+"""
+
+from ddp_practice_tpu.parallel.mesh import (
+    build_mesh,
+    batch_sharding,
+    replicated,
+    shard_state,
+)
+from ddp_practice_tpu.parallel.dist import (
+    initialize,
+    is_main_process,
+    process_count,
+    process_index,
+)
+from ddp_practice_tpu.parallel.sharding_rules import param_sharding_rules
+
+__all__ = [
+    "build_mesh",
+    "batch_sharding",
+    "replicated",
+    "shard_state",
+    "initialize",
+    "is_main_process",
+    "process_count",
+    "process_index",
+    "param_sharding_rules",
+]

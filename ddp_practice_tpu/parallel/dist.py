@@ -1,0 +1,65 @@
+"""Multi-host runtime initialization.
+
+Replaces the reference's process-group bootstrap: MASTER_ADDR/MASTER_PORT
+env wiring + `init_process_group(backend="nccl", init_method="env://")`
+(ddp_main.py:60-73) and torchrun's env contract
+(ddp_main_torchrun.py:102-104). On TPU there is one process per *host*
+(not per chip); `jax.distributed.initialize` performs the rendezvous and
+after it `jax.devices()` spans the whole slice. No hardcoded port
+(the reference pins 19198, ddp_main.py:62 — SURVEY §2.5 flags it).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+import jax
+
+log = logging.getLogger(__name__)
+
+_initialized = False
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Idempotent multi-host init.
+
+    With no arguments, relies on the environment (TPU pod metadata or
+    JAX_COORDINATOR_ADDRESS et al.); single-process runs skip rendezvous
+    entirely — exactly like running origin_main.py without DDP.
+    """
+    global _initialized
+    if _initialized:
+        return
+    if coordinator_address is None and num_processes in (None, 1):
+        # Single-host: nothing to rendezvous; jax.devices() is local.
+        _initialized = True
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _initialized = True
+    log.info(
+        "distributed initialized: process %d/%d, %d global devices",
+        jax.process_index(), jax.process_count(), jax.device_count(),
+    )
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def is_main_process() -> bool:
+    """The rank-0 gate for side effects (prints, checkpoint writes) —
+    reference: ddp_main.py:158-169."""
+    return jax.process_index() == 0

@@ -1,0 +1,76 @@
+"""Device mesh construction and sharding helpers.
+
+The mesh replaces the reference's rank/world/env bookkeeping
+(ddp_main.py:60-73): axes ("data", "seq", "tensor") carry data, sequence,
+and tensor parallelism. Gradient synchronization is not a wrapper (the DDP
+reducer, ddp_main.py:121-123) but a consequence of shardings: batch sharded
+over "data" + params replicated ⇒ XLA inserts the gradient all-reduce over
+ICI/DCN during backward, overlapped by the latency-hiding scheduler.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ddp_practice_tpu.config import MeshConfig
+
+
+def build_mesh(
+    config: Optional[MeshConfig] = None, devices=None
+) -> Mesh:
+    """Build a Mesh over all (or given) devices with axes (data, seq, tensor)."""
+    config = config or MeshConfig()
+    devices = list(devices) if devices is not None else jax.devices()
+    if config.data != -1:
+        # explicit mesh smaller than the host's device count: use a subset
+        want = config.data * config.seq * config.tensor
+        if want < len(devices):
+            devices = devices[:want]
+    shape = config.resolve(len(devices))
+    try:
+        dmesh = mesh_utils.create_device_mesh(
+            shape, devices=np.asarray(devices)
+        )
+    except (ValueError, AssertionError):
+        dmesh = np.asarray(devices).reshape(shape)
+    return Mesh(dmesh, config.axis_names)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh, *, seq_dim: Optional[int] = None) -> NamedSharding:
+    """Sharding for a batch array: leading dim over 'data' (and, when
+    seq_dim is given, that dim over 'seq' — sequence parallelism)."""
+    if seq_dim is None:
+        return NamedSharding(mesh, P(MeshConfig.AXIS_DATA))
+    spec = [None] * (seq_dim + 1)
+    spec[0] = MeshConfig.AXIS_DATA
+    spec[seq_dim] = MeshConfig.AXIS_SEQ
+    return NamedSharding(mesh, P(*spec))
+
+
+def shard_state(state, mesh: Mesh, rules=None):
+    """Build a sharding pytree for a train state.
+
+    Parameters (and their optimizer-state mirrors, which share leaf shapes)
+    follow the tensor-parallel `rules` when given; everything else is
+    replicated — the data-parallel contract of the reference (full replica
+    per device, ddp_main.py:117-123).
+    """
+    rep = replicated(mesh)
+
+    if rules is None:
+        return jax.tree.map(lambda _: rep, state)
+
+    def leaf_sharding(path, leaf):
+        spec = rules(path, leaf)
+        return NamedSharding(mesh, spec) if spec is not None else rep
+
+    return jax.tree_util.tree_map_with_path(leaf_sharding, state)

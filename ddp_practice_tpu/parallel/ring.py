@@ -1,0 +1,128 @@
+"""Ring attention: sequence/context parallelism over the 'seq' mesh axis.
+
+Absent from the reference (no attention, no sequence axis — SURVEY §5.7) but
+first-class here: the sequence dimension is sharded across devices; each
+device computes blockwise attention for its local queries while K/V blocks
+rotate around the ring via `lax.ppermute` (ICI neighbor exchange), with an
+online-softmax accumulator so the result is exact — the Ring Attention
+construction (Liu et al.) on top of XLA collectives.
+
+Works in two modes:
+- already inside a `shard_map`/pmap where `axis_name` is bound: computes
+  directly on the local blocks.
+- under GSPMD `jit`: wraps itself in a `shard_map` island over the current
+  mesh (batch dim over 'data', sequence dim over `axis_name`).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ddp_practice_tpu.config import MeshConfig
+
+_NEG_INF = -1e30
+
+# Mesh registry so model code deep inside a jitted function can open a
+# shard_map island without threading the Mesh object through every module.
+_CURRENT_MESH = None
+
+
+def set_current_mesh(mesh) -> None:
+    global _CURRENT_MESH
+    _CURRENT_MESH = mesh
+
+
+def get_current_mesh():
+    return _CURRENT_MESH
+
+
+def _axis_bound(axis_name: str) -> bool:
+    try:
+        lax.axis_index(axis_name)
+        return True
+    except (NameError, KeyError, ValueError):
+        return False
+
+
+def ring_attention(
+    q: jnp.ndarray,  # (batch, seq_local_or_global, heads, head_dim)
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    axis_name: str,
+    causal: bool = False,
+    mesh=None,
+) -> jnp.ndarray:
+    if _axis_bound(axis_name):
+        return _ring_attention_local(q, k, v, axis_name=axis_name, causal=causal)
+    mesh = mesh or get_current_mesh()
+    if mesh is None:
+        raise ValueError(
+            "ring_attention outside shard_map needs a mesh "
+            "(set via parallel.ring.set_current_mesh)"
+        )
+    # batch over data, sequence over the ring axis, heads stay sharded over
+    # tensor (heads are independent in attention, so TP composes with SP)
+    spec = P(MeshConfig.AXIS_DATA, axis_name, MeshConfig.AXIS_TENSOR, None)
+    fn = jax.shard_map(
+        functools.partial(
+            _ring_attention_local, axis_name=axis_name, causal=causal
+        ),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return fn(q, k, v)
+
+
+def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool):
+    """Blockwise attention on local shards; K/V ring-rotated each step."""
+    in_dtype = q.dtype
+    axis_size = lax.psum(1, axis_name)  # trace-time constant under shard_map
+    my_idx = lax.axis_index(axis_name)
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
+
+    qf = jnp.transpose(q, (0, 2, 1, 3)).astype(jnp.float32)  # (b,h,sq,d)
+    kf = jnp.transpose(k, (0, 2, 1, 3)).astype(jnp.float32)
+    vf = jnp.transpose(v, (0, 2, 1, 3)).astype(jnp.float32)
+
+    o0 = jnp.zeros((b, h, sq, d), jnp.float32)
+    m0 = jnp.full((b, h, sq), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, sq), jnp.float32)
+
+    q_pos = my_idx * sq + jnp.arange(sq)  # global query positions
+
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    def body(carry, step):
+        o, m, l, kb, vb = carry
+        kblock = (my_idx - step) % axis_size
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, kb) * scale
+        if causal:
+            k_pos = kblock * sk + jnp.arange(sk)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            s = jnp.where(mask[None, None], s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        # guard exp(-inf - -inf): rows still fully masked keep m at _NEG_INF
+        p = jnp.exp(s - m_new[..., None])
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1)
+        o_new = o * corr[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, vb)
+        kb = lax.ppermute(kb, axis_name, perm)
+        vb = lax.ppermute(vb, axis_name, perm)
+        return (o_new, m_new, l_new, kb, vb), None
+
+    (o, m, l, _, _), _ = lax.scan(
+        body, (o0, m0, l0, kf, vf), jnp.arange(axis_size)
+    )
+    o = o / jnp.maximum(l, 1e-30)[..., None]
+    return jnp.transpose(o, (0, 2, 1, 3)).astype(in_dtype)

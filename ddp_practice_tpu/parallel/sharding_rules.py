@@ -1,0 +1,68 @@
+"""Tensor-parallel parameter sharding rules.
+
+Megatron-style TP for the transformer family, expressed the TPU way: not
+manual collectives but `PartitionSpec`s on parameter leaves — XLA/GSPMD
+inserts the all-reduces (over ICI) at the row-parallel boundaries. Rules key
+on parameter *path names*, so they apply equally to the optimizer-state
+mirrors of each parameter (optax momentum/adam trees repeat the names).
+
+Column-parallel (shard output features over 'tensor'): attention QKV,
+MLP fc_in. Row-parallel (shard input features): attention out, MLP fc_out.
+The reference has no model parallelism at all (SURVEY §2.3) — this is new
+capability the mesh design carries from day one.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from jax.sharding import PartitionSpec as P
+from jax.tree_util import keystr
+
+from ddp_practice_tpu.config import MeshConfig
+
+T = MeshConfig.AXIS_TENSOR
+
+
+def _vit_rule(path, leaf) -> Optional[P]:
+    name = keystr(path)
+    is_kernel = "kernel" in name
+    is_bias = "bias" in name
+    if "qkv" in name:
+        # kernel (d, 3, heads, head_dim); bias (3, heads, head_dim)
+        if is_kernel:
+            return P(None, None, T, None)
+        if is_bias:
+            return P(None, T, None)
+    if "attn" in name and ("'out'" in name or "out" in name.split("'")):
+        # kernel (heads, head_dim, d) row-parallel; bias (d,) replicated
+        if is_kernel:
+            return P(T, None, None)
+        return None
+    if "fc_in" in name:
+        # kernel (d, mlp) column-parallel; bias (mlp,)
+        if is_kernel:
+            return P(None, T)
+        if is_bias:
+            return P(T)
+    if "fc_out" in name:
+        # kernel (mlp, d) row-parallel; bias replicated
+        if is_kernel:
+            return P(T, None)
+        return None
+    return None
+
+
+_RULES: dict = {
+    "vit": _vit_rule,
+    "vit_tiny": _vit_rule,
+}
+
+
+def param_sharding_rules(model_name: str) -> Optional[Callable]:
+    """Return rules(path, leaf) -> PartitionSpec | None for a model family.
+
+    None (no model parallelism — e.g. the conv families) means fully
+    replicated parameters, the reference's DDP contract.
+    """
+    return _RULES.get(model_name.lower())

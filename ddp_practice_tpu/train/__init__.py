@@ -1,0 +1,20 @@
+"""Training: state pytree, jitted step functions, epoch driver.
+
+The reference's train()/test() loops (origin_main.py:57-81, ddp_main.py:83-112)
+collapse here into two jitted functions over sharded arrays (SURVEY §3.4):
+the host loop only feeds batches and logs.
+"""
+
+from ddp_practice_tpu.train.state import TrainState, create_state, make_optimizer
+from ddp_practice_tpu.train.steps import make_train_step, make_eval_step
+from ddp_practice_tpu.train.loop import Trainer, fit
+
+__all__ = [
+    "TrainState",
+    "create_state",
+    "make_optimizer",
+    "make_train_step",
+    "make_eval_step",
+    "Trainer",
+    "fit",
+]

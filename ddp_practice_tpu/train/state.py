@@ -1,0 +1,79 @@
+"""Train state pytree and optimizer construction.
+
+Replaces the reference's mutable module + torch.optim.SGD pair
+(origin_main.py:85-87) with an immutable pytree threaded through jitted
+steps. Parameter init is explicitly seeded with `jax.random.PRNGKey` —
+the reference leaves init unseeded and relies on DDP's implicit rank-0
+broadcast (SURVEY §2.5); JAX has no implicit broadcast, so determinism is
+by construction: every process computes identical init from the same key.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import optax
+
+from ddp_practice_tpu.config import TrainConfig
+
+
+@flax.struct.dataclass
+class TrainState:
+    step: jnp.ndarray
+    params: Any
+    batch_stats: Any          # None for models without BatchNorm
+    opt_state: Any
+
+
+def make_optimizer(config: TrainConfig, steps_per_epoch: int = 0) -> optax.GradientTransformation:
+    """SGD lr 1e-4 by default — parity with ddp_main.py:125, including the
+    deliberate choice NOT to scale lr with replica count (README.md:506)
+    unless `scale_lr_by_replicas` is set."""
+    lr = config.learning_rate
+    if config.scale_lr_by_replicas:
+        lr = lr * jax.device_count()
+    total_steps = max(steps_per_epoch * config.epochs, 1)
+    if config.lr_schedule == "constant":
+        schedule = optax.constant_schedule(lr)
+    elif config.lr_schedule == "cosine":
+        schedule = optax.cosine_decay_schedule(lr, total_steps)
+    elif config.lr_schedule == "warmup_cosine":
+        schedule = optax.warmup_cosine_decay_schedule(
+            0.0, lr, config.warmup_steps, total_steps
+        )
+    else:
+        raise ValueError(f"unknown lr_schedule {config.lr_schedule!r}")
+
+    if config.optimizer == "sgd":
+        tx = optax.sgd(schedule, momentum=config.momentum or None)
+    elif config.optimizer == "adamw":
+        tx = optax.adamw(schedule, weight_decay=config.weight_decay)
+    elif config.optimizer == "adam":
+        tx = optax.adam(schedule)
+    else:
+        raise ValueError(f"unknown optimizer {config.optimizer!r}")
+    if config.weight_decay and config.optimizer == "sgd":
+        tx = optax.chain(optax.add_decayed_weights(config.weight_decay), tx)
+    return tx
+
+
+def create_state(
+    model,
+    tx,
+    *,
+    rng: jax.Array,
+    sample_input: jnp.ndarray,
+) -> TrainState:
+    """Initialize params (explicit PRNG key) and optimizer state."""
+    variables = model.init(rng, sample_input, train=False)
+    params = variables["params"]
+    batch_stats = variables.get("batch_stats", None)
+    return TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        batch_stats=batch_stats,
+        opt_state=tx.init(params),
+    )

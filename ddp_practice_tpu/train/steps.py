@@ -1,0 +1,114 @@
+"""Jitted train/eval step factories.
+
+The whole per-batch sequence of the reference — H2D copy, autocast forward,
+loss, zero_grad, scaled backward with overlapped gradient all-reduce, scaler
+step/update (ddp_main.py:85-93, SURVEY §3.4) — compiles here into ONE XLA
+program per step. Distribution is by sharding, not wrappers: with the batch
+sharded over the 'data' mesh axis and params replicated (or TP-sharded),
+XLA inserts and overlaps the gradient all-reduce that DDP's bucketing reducer
+performs in C++ (ddp_main.py:121-123), and BatchNorm's batch-axis mean IS the
+global-batch mean (the SyncBatchNorm contract, ddp_main.py:120) because the
+mean of a 'data'-sharded axis lowers to a cross-replica reduction.
+
+Eval returns weighted (correct, total) sums — the dist.reduce(SUM) pair of
+ddp_main.py:108-109, but exact under padding (SURVEY §2.5).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ddp_practice_tpu.ops.losses import accuracy_counts, cross_entropy
+from ddp_practice_tpu.train.state import TrainState
+
+
+def make_train_step(
+    model,
+    tx,
+    *,
+    label_smoothing: float = 0.0,
+    mesh=None,
+    state_shardings=None,
+    batch_shardings=None,
+):
+    """Build the jitted train step.
+
+    When mesh/shardings are given, they pin input/output layouts (GSPMD);
+    the state buffer is donated so parameters update in place in HBM.
+    """
+
+    def train_step(state: TrainState, batch):
+        has_bn = state.batch_stats is not None
+
+        def loss_fn(params):
+            variables = {"params": params}
+            if has_bn:
+                variables["batch_stats"] = state.batch_stats
+                logits, updated = model.apply(
+                    variables, batch["image"], train=True, mutable=["batch_stats"]
+                )
+                new_stats = updated["batch_stats"]
+            else:
+                logits = model.apply(variables, batch["image"], train=True)
+                new_stats = None
+            loss = cross_entropy(
+                logits, batch["label"], label_smoothing=label_smoothing
+            )
+            return loss, (logits, new_stats)
+
+        (loss, (logits, new_stats)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(state.params)
+        updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        correct, total = accuracy_counts(logits, batch["label"])
+        metrics = {
+            "loss": loss,
+            "accuracy": correct / total,
+            "grad_norm": optax.global_norm(grads),
+        }
+        new_state = TrainState(
+            step=state.step + 1,
+            params=new_params,
+            batch_stats=new_stats,
+            opt_state=new_opt_state,
+        )
+        return new_state, metrics
+
+    if mesh is not None and state_shardings is not None:
+        from ddp_practice_tpu.parallel.mesh import replicated
+
+        rep = replicated(mesh)
+        return jax.jit(
+            train_step,
+            in_shardings=(state_shardings, batch_shardings),
+            out_shardings=(state_shardings, rep),
+            donate_argnums=0,
+        )
+    return jax.jit(train_step, donate_argnums=0)
+
+
+def make_eval_step(model, *, mesh=None, state_shardings=None, batch_shardings=None):
+    """Build the jitted eval step: weighted (correct, total) counts."""
+
+    def eval_step(state: TrainState, batch):
+        variables = {"params": state.params}
+        if state.batch_stats is not None:
+            variables["batch_stats"] = state.batch_stats
+        logits = model.apply(variables, batch["image"], train=False)
+        return accuracy_counts(logits, batch["label"], weight=batch["weight"])
+
+    if mesh is not None and state_shardings is not None:
+        from ddp_practice_tpu.parallel.mesh import replicated
+
+        rep = replicated(mesh)
+        return jax.jit(
+            eval_step,
+            in_shardings=(state_shardings, batch_shardings),
+            out_shardings=(rep, rep),
+        )
+    return jax.jit(eval_step)

@@ -1,0 +1,7 @@
+"""Utilities: process-0 logging, timing, profiling hooks."""
+
+from ddp_practice_tpu.utils.logging import get_logger, main_process_only
+from ddp_practice_tpu.utils.timing import Timer
+from ddp_practice_tpu.utils.profiling import profile_region
+
+__all__ = ["get_logger", "main_process_only", "Timer", "profile_region"]

@@ -1,0 +1,38 @@
+"""Process-0-gated logging.
+
+The reference gates prints and saves on rank 0 by hand at each site
+(ddp_main.py:158-169); here the gate is one decorator / logger filter.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import sys
+
+
+def get_logger(name: str = "ddp_practice_tpu") -> logging.Logger:
+    logger = logging.getLogger(name)
+    if not logger.handlers:
+        handler = logging.StreamHandler(sys.stdout)
+        handler.setFormatter(
+            logging.Formatter("%(asctime)s %(name)s %(levelname)s %(message)s")
+        )
+        logger.addHandler(handler)
+        logger.setLevel(logging.INFO)
+        logger.propagate = False
+    return logger
+
+
+def main_process_only(fn):
+    """Run fn only on process 0 — the rank-0 side-effect gate."""
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        import jax
+
+        if jax.process_index() == 0:
+            return fn(*args, **kwargs)
+        return None
+
+    return wrapper

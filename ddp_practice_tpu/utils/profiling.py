@@ -1,0 +1,32 @@
+"""Profiling hooks (SURVEY §5.1 — absent from the reference; built here).
+
+Wraps `jax.profiler`: traces dump to a directory viewable in
+TensorBoard/Perfetto/XProf; step/epoch regions are annotated with
+`TraceAnnotation` so device timelines line up with the training loop.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Optional
+
+import jax
+
+
+@contextlib.contextmanager
+def profile_region(name: str, profile_dir: Optional[str] = None):
+    """Annotate a region; if profile_dir is set, capture a full trace."""
+    if profile_dir:
+        jax.profiler.start_trace(profile_dir)
+    try:
+        with jax.profiler.TraceAnnotation(name):
+            yield
+    finally:
+        if profile_dir:
+            jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def step_annotation(step: int):
+    with jax.profiler.StepTraceAnnotation("train", step_num=step):
+        yield

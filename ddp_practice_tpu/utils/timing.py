@@ -1,0 +1,22 @@
+"""Wall-clock timing — the reference's only performance instrument
+(time.time() around main, origin_main.py:118-121), kept for parity, plus
+per-phase accounting for throughput metrics."""
+
+from __future__ import annotations
+
+import time
+
+
+class Timer:
+    def __init__(self):
+        self._start = time.perf_counter()
+        self._laps = {}
+
+    def elapsed(self) -> float:
+        return time.perf_counter() - self._start
+
+    def lap(self, name: str) -> float:
+        now = time.perf_counter()
+        last = self._laps.get(name, self._start)
+        self._laps[name] = now
+        return now - last

@@ -1,0 +1,35 @@
+"""Test harness: 8 virtual CPU devices.
+
+The reference has no tests at all (SURVEY §4); its README checklist
+(init/teardown, wrapping, sampler wiring, rank-0 side effects, eval reduce)
+is the invariant list these tests assert. Distribution is tested without a
+cluster: XLA's host platform is forced to expose 8 devices, so the mesh,
+GSPMD sharding, collectives, and ring attention all run on one CPU.
+"""
+
+import os
+
+# Belt: env vars (effective if jax not yet imported).
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# Suspenders: pytest plugins may have imported jax already (before this
+# conftest ran), so also override through the config system — effective any
+# time before backend initialization.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs

@@ -1,0 +1,77 @@
+"""Checkpoint tests: save + the restore path the reference lacks
+(SURVEY §2.5 — torch.save only, no load), including resume-through-Trainer
+and restore-onto-a-mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ddp_practice_tpu import checkpoint as ckpt
+from ddp_practice_tpu.config import MeshConfig, TrainConfig
+from ddp_practice_tpu.models import create_model
+from ddp_practice_tpu.parallel.mesh import build_mesh, shard_state
+from ddp_practice_tpu.train import create_state, make_optimizer
+from ddp_practice_tpu.train.loop import Trainer
+
+
+def _state():
+    cfg = TrainConfig()
+    model = create_model("convnet")
+    tx = make_optimizer(cfg)
+    return create_state(
+        model, tx, rng=jax.random.PRNGKey(7), sample_input=jnp.zeros((1, 28, 28, 1))
+    )
+
+
+def test_roundtrip(tmp_path):
+    state = _state()
+    d = str(tmp_path / "ck")
+    ckpt.save(d, state, extra={"precision_policy": "bf16", "step": 0})
+    assert ckpt.exists(d)
+    restored = ckpt.restore(d, jax.eval_shape(lambda: state))
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        jax.device_get(state), restored,
+    )
+    man = ckpt.latest_manifest(d)
+    assert man["extra"]["precision_policy"] == "bf16"  # the "scaler slot"
+
+
+def test_restore_onto_mesh(tmp_path, devices):
+    """A checkpoint written anywhere restores sharded onto a mesh
+    (single-chip -> pod portability)."""
+    state = _state()
+    d = str(tmp_path / "ck")
+    ckpt.save(d, state)
+    mesh = build_mesh(MeshConfig(data=8))
+    shardings = shard_state(jax.eval_shape(lambda: state), mesh)
+    restored = ckpt.restore(d, jax.eval_shape(lambda: state), shardings=shardings)
+    leaf = jax.tree_util.tree_leaves(restored.params)[0]
+    assert len(leaf.sharding.device_set) == 8
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(jax.device_get(a)), np.asarray(jax.device_get(b))
+        ),
+        jax.device_get(state.params), jax.device_get(restored.params),
+    )
+
+
+def test_trainer_resume(tmp_path):
+    """Train 1 epoch, checkpoint, resume: step counter continues — the
+    resume path the reference never built."""
+    d = str(tmp_path / "ck")
+    cfg = TrainConfig(
+        dataset="synthetic",
+        epochs=1,
+        batch_size=32,
+        log_every_steps=0,
+        checkpoint_dir=d,
+        mesh=MeshConfig(data=1),
+    )
+    t1 = Trainer(cfg)
+    t1.fit()
+    steps_after_first = int(t1.state.step)
+    assert steps_after_first > 0
+
+    t2 = Trainer(cfg.replace(resume=True))
+    assert int(t2.state.step) == steps_after_first

@@ -1,0 +1,44 @@
+"""Loss/metric op tests: known-value cross-entropy, weighted counts."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from ddp_practice_tpu.ops import accuracy_counts, cross_entropy
+
+
+def test_cross_entropy_uniform_logits():
+    logits = jnp.zeros((4, 10))
+    labels = jnp.asarray([0, 3, 7, 9])
+    np.testing.assert_allclose(
+        float(cross_entropy(logits, labels)), np.log(10.0), rtol=1e-6
+    )
+
+
+def test_cross_entropy_confident_correct():
+    logits = jnp.asarray([[100.0, 0.0], [0.0, 100.0]])
+    labels = jnp.asarray([0, 1])
+    assert float(cross_entropy(logits, labels)) < 1e-6
+
+
+def test_cross_entropy_weighted_ignores_padding():
+    logits = jnp.asarray([[10.0, 0.0], [0.0, 10.0], [-50.0, 50.0]])
+    labels = jnp.asarray([0, 1, 0])  # third is "wrong" but weight 0
+    w = jnp.asarray([1.0, 1.0, 0.0])
+    assert float(cross_entropy(logits, labels, weight=w)) < 1e-3
+
+
+def test_accuracy_counts_weighted():
+    logits = jnp.asarray([[1.0, 0.0], [0.0, 1.0], [1.0, 0.0], [1.0, 0.0]])
+    labels = jnp.asarray([0, 1, 1, 0])
+    w = jnp.asarray([1.0, 1.0, 1.0, 0.0])  # last (correct) sample is padding
+    correct, total = accuracy_counts(logits, labels, weight=w)
+    assert float(correct) == 2.0
+    assert float(total) == 3.0
+
+
+def test_label_smoothing_increases_loss_on_confident():
+    logits = jnp.asarray([[100.0, 0.0]])
+    labels = jnp.asarray([0])
+    plain = float(cross_entropy(logits, labels))
+    smoothed = float(cross_entropy(logits, labels, label_smoothing=0.1))
+    assert smoothed > plain

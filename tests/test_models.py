@@ -1,0 +1,76 @@
+"""Model tests: forward shapes/dtypes, precision policy, parameter shapes.
+
+The ConvNet contract comes from the reference architecture
+(origin_main.py:12-24): conv5x5(1->16) -> BN -> relu -> pool, conv5x5(16->32)
+-> BN -> relu -> pool, dense(7*7*32 -> 10).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ddp_practice_tpu.config import PrecisionPolicy
+from ddp_practice_tpu.models import create_model
+
+
+def _init_and_apply(model, x, train=False):
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    if train and "batch_stats" in variables:
+        out, _ = model.apply(variables, x, train=True, mutable=["batch_stats"])
+        return variables, out
+    return variables, model.apply(variables, x, train=train)
+
+
+def test_convnet_shapes_match_reference():
+    model = create_model("convnet")
+    x = jnp.zeros((2, 28, 28, 1))
+    variables, logits = _init_and_apply(model, x)
+    assert logits.shape == (2, 10)
+    params = variables["params"]
+    # conv 5x5, 1->16 then 16->32 (origin_main.py:13-22), dense 7*7*32 -> 10
+    assert params["Conv_0"]["kernel"].shape == (5, 5, 1, 16)
+    assert params["Conv_1"]["kernel"].shape == (5, 5, 16, 32)
+    assert params["Dense_0"]["kernel"].shape == (7 * 7 * 32, 10)
+    assert "batch_stats" in variables  # BatchNorm present
+
+
+def test_convnet_bf16_policy_fp32_logits():
+    model = create_model("convnet", policy=PrecisionPolicy.bf16())
+    x = jnp.zeros((2, 28, 28, 1), jnp.float32)
+    variables, logits = _init_and_apply(model, x)
+    assert logits.dtype == jnp.float32      # loss math stays fp32
+    # params stay fp32 (master weights)
+    leaf = variables["params"]["Conv_0"]["kernel"]
+    assert leaf.dtype == jnp.float32
+
+
+def test_resnet18_forward():
+    model = create_model("resnet18")
+    x = jnp.zeros((2, 32, 32, 3))
+    _, logits = _init_and_apply(model, x)
+    assert logits.shape == (2, 10)
+
+
+def test_resnet50_forward():
+    model = create_model("resnet50")
+    x = jnp.zeros((1, 64, 64, 3))
+    _, logits = _init_and_apply(model, x)
+    assert logits.shape == (1, 10)
+
+
+def test_vit_tiny_forward():
+    model = create_model("vit_tiny", depth=2)
+    x = jnp.zeros((2, 32, 32, 3))
+    _, logits = _init_and_apply(model, x)
+    assert logits.shape == (2, 10)
+
+
+def test_train_eval_mode_differ_through_bn():
+    """BN uses batch stats in train, running stats in eval — the
+    model.train()/model.eval() split of ddp_main.py:84,98."""
+    model = create_model("convnet")
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(4, 28, 28, 1)), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    out_train, _ = model.apply(variables, x, train=True, mutable=["batch_stats"])
+    out_eval = model.apply(variables, x, train=False)
+    assert not np.allclose(np.asarray(out_train), np.asarray(out_eval))

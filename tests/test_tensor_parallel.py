@@ -1,0 +1,81 @@
+"""Tensor-parallel tests: TP-sharded ViT training matches replicated ViT.
+
+Model parallelism is absent from the reference (SURVEY §2.3); the mesh
+design carries it from day one. GSPMD turns PartitionSpecs on QKV/MLP
+parameters into Megatron-style column/row-parallel execution — these tests
+pin the numerics to the replicated baseline.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ddp_practice_tpu.config import MeshConfig, TrainConfig
+from ddp_practice_tpu.models import create_model
+from ddp_practice_tpu.parallel.mesh import batch_sharding, build_mesh, shard_state
+from ddp_practice_tpu.parallel.sharding_rules import param_sharding_rules
+from ddp_practice_tpu.train import create_state, make_optimizer, make_train_step
+
+
+def _setup(mesh_cfg, rules=None, devices=None):
+    cfg = TrainConfig(optimizer="sgd", learning_rate=1e-2)
+    mesh = build_mesh(mesh_cfg, devices=devices)
+    model = create_model("vit_tiny", depth=2, hidden_dim=32, num_heads=4, mlp_dim=64)
+    tx = make_optimizer(cfg)
+    sample = jnp.zeros((1, 16, 16, 3))
+
+    def init_fn(r):
+        return create_state(model, tx, rng=r, sample_input=sample)
+
+    abstract = jax.eval_shape(init_fn, jax.random.PRNGKey(0))
+    shardings = shard_state(abstract, mesh, rules)
+    state = jax.jit(init_fn, out_shardings=shardings)(jax.random.PRNGKey(0))
+    bsh = batch_sharding(mesh)
+    step = make_train_step(
+        model, tx, mesh=mesh, state_shardings=shardings, batch_shardings=bsh
+    )
+    return mesh, state, step, bsh
+
+
+def _batch(n=8, seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "image": jnp.asarray(rng.uniform(size=(n, 16, 16, 3)), jnp.float32),
+        "label": jnp.asarray(rng.integers(0, 10, n), jnp.int32),
+        "weight": jnp.ones((n,), jnp.float32),
+    }
+
+
+def test_tp_sharding_rules_applied(devices):
+    rules = param_sharding_rules("vit_tiny")
+    mesh, state, _, _ = _setup(MeshConfig(data=2, tensor=4), rules)
+    qkv = state.params["block0"]["attn"]["qkv"]["kernel"]
+    spec = qkv.sharding.spec
+    assert "tensor" in str(spec), spec
+    # sharded leaf really is split across devices
+    assert qkv.addressable_shards[0].data.shape[2] == qkv.shape[2] // 4
+
+
+def test_tp_matches_replicated(devices):
+    batch = _batch(8, seed=4)
+    rules = param_sharding_rules("vit_tiny")
+
+    mesh_r, state_r, step_r, bsh_r = _setup(
+        MeshConfig(data=1), devices=jax.devices()[:1]
+    )
+    mesh_t, state_t, step_t, bsh_t = _setup(MeshConfig(data=2, tensor=4), rules)
+
+    br = {k: jax.device_put(v, bsh_r) for k, v in batch.items()}
+    bt = {k: jax.device_put(v, bsh_t) for k, v in batch.items()}
+    for _ in range(2):
+        state_r, mr = step_r(state_r, br)
+        state_t, mt = step_t(state_t, bt)
+    np.testing.assert_allclose(
+        float(mr["loss"]), float(mt["loss"]), rtol=2e-4
+    )
+    pr = jax.device_get(state_r.params)
+    pt = jax.device_get(state_t.params)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-3, atol=1e-5),
+        pr, pt,
+    )

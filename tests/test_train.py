@@ -1,0 +1,71 @@
+"""Training-step and end-to-end loop tests (single device).
+
+The end-to-end contract is the reference's: accuracy climbs well above
+chance within the epoch budget (origin_main.py reaches 91.55% on MNIST in
+3 epochs; here on the synthetic stand-in dataset we require >90%)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ddp_practice_tpu.config import MeshConfig, TrainConfig
+from ddp_practice_tpu.models import create_model
+from ddp_practice_tpu.train import create_state, make_optimizer, make_train_step
+from ddp_practice_tpu.train.loop import fit
+
+
+def _tiny_setup():
+    cfg = TrainConfig(optimizer="adam", learning_rate=1e-3)
+    model = create_model("convnet")
+    tx = make_optimizer(cfg)
+    rng = jax.random.PRNGKey(0)
+    state = create_state(
+        model, tx, rng=rng, sample_input=jnp.zeros((1, 28, 28, 1))
+    )
+    return model, tx, state
+
+
+def test_train_step_decreases_loss():
+    model, tx, state = _tiny_setup()
+    step = make_train_step(model, tx)
+    rng = np.random.default_rng(0)
+    batch = {
+        "image": jnp.asarray(rng.uniform(size=(16, 28, 28, 1)), jnp.float32),
+        "label": jnp.asarray(rng.integers(0, 10, 16), jnp.int32),
+        "weight": jnp.ones((16,), jnp.float32),
+    }
+    losses = []
+    for _ in range(20):
+        state, metrics = step(state, batch)
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0] * 0.5, losses[:3] + losses[-3:]
+    assert int(state.step) == 20
+
+
+def test_fit_reaches_reference_accuracy_contract():
+    """The 91%-in-3-epochs contract (README.md:199) on the synthetic MNIST
+    stand-in. Uses the parity budget: 3 epochs, batch 32."""
+    cfg = TrainConfig(
+        dataset="synthetic",
+        epochs=3,
+        batch_size=32,
+        optimizer="adam",       # synthetic task; SGD 1e-4 parity run is the
+        learning_rate=1e-3,     # full-MNIST config, too slow for CI
+        log_every_steps=0,
+        mesh=MeshConfig(data=1),
+    )
+    summary = fit(cfg)
+    assert summary["accuracy"] > 0.90, summary
+    assert summary["steps"] == 3 * (4096 // 32)
+
+
+def test_sgd_parity_hyperparams():
+    """Optimizer defaults match the reference: SGD, lr 1e-4, unscaled
+    (ddp_main.py:125; README.md:506)."""
+    cfg = TrainConfig()
+    assert cfg.learning_rate == 1e-4
+    assert cfg.optimizer == "sgd"
+    assert cfg.epochs == 3
+    assert cfg.batch_size == 32
+    assert cfg.seed == 3407
+    assert not cfg.scale_lr_by_replicas
