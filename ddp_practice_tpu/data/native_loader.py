@@ -1,0 +1,123 @@
+"""ctypes binding for the native (C++) batch-assembly backend.
+
+Builds `native/libddp_loader.so` on first use if a compiler is available
+(no pybind11 in this environment; the C ABI + ctypes keeps the binding
+dependency-free). Falls back silently — callers treat None from
+`make_gather` as "use the numpy path", which is bit-identical.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Callable, Optional
+
+import numpy as np
+
+from ddp_practice_tpu.data.datasets import Dataset
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
+_SO_NAME = "libddp_loader.so"
+
+_lib = None
+_lib_lock = threading.Lock()
+_build_attempted = False
+
+
+def _load_library() -> Optional[ctypes.CDLL]:
+    global _lib, _build_attempted
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        so_path = os.path.abspath(os.path.join(_NATIVE_DIR, _SO_NAME))
+        if not os.path.exists(so_path) and not _build_attempted:
+            _build_attempted = True
+            _try_build()
+        if not os.path.exists(so_path):
+            return None
+        lib = ctypes.CDLL(so_path)
+        lib.dl_create.restype = ctypes.c_void_p
+        lib.dl_create.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+        ]
+        lib.dl_destroy.argtypes = [ctypes.c_void_p]
+        lib.dl_gather.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int32,
+        ]
+        lib.dl_version.restype = ctypes.c_int32
+        _lib = lib
+        return _lib
+
+
+def _try_build() -> None:
+    makefile = os.path.join(_NATIVE_DIR, "Makefile")
+    if not os.path.exists(makefile):
+        return
+    try:
+        subprocess.run(
+            ["make", "-C", os.path.abspath(_NATIVE_DIR)],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+    except (subprocess.SubprocessError, OSError):
+        pass
+
+
+class _NativeGather:
+    """Callable gather backed by the C++ library.
+
+    Holds contiguous fp32/int32 views of the dataset for the library's
+    zero-copy wrap; keeps them referenced for the handle's lifetime.
+    """
+
+    def __init__(self, lib: ctypes.CDLL, dataset: Dataset):
+        self._lib = lib
+        self._images = np.ascontiguousarray(dataset.images, dtype=np.float32)
+        self._labels = np.ascontiguousarray(dataset.labels, dtype=np.int32)
+        self._sample_shape = self._images.shape[1:]
+        self._sample_elems = int(np.prod(self._sample_shape))
+        self._handle = lib.dl_create(
+            self._images.ctypes.data_as(ctypes.c_void_p),
+            self._labels.ctypes.data_as(ctypes.c_void_p),
+            len(self._images),
+            self._sample_elems,
+        )
+
+    def __call__(self, indices: np.ndarray):
+        idx = np.ascontiguousarray(indices, dtype=np.int64)
+        n = len(idx)
+        out_images = np.empty((n,) + self._sample_shape, np.float32)
+        out_labels = np.empty((n,), np.int32)
+        self._lib.dl_gather(
+            self._handle,
+            idx.ctypes.data_as(ctypes.c_void_p),
+            n,
+            out_images.ctypes.data_as(ctypes.c_void_p),
+            out_labels.ctypes.data_as(ctypes.c_void_p),
+            0,
+        )
+        return out_images, out_labels
+
+    def __del__(self):
+        try:
+            if self._handle:
+                self._lib.dl_destroy(self._handle)
+        except Exception:
+            pass
+
+
+def make_gather(dataset: Dataset) -> Optional[Callable]:
+    """Return a native gather callable, or None if the backend is
+    unavailable (caller falls back to numpy)."""
+    lib = _load_library()
+    if lib is None:
+        return None
+    return _NativeGather(lib, dataset)
+
+
+def available() -> bool:
+    return _load_library() is not None
