@@ -65,8 +65,12 @@ class MeshConfig:
     configuration. Axes:
 
     - ``data``: data parallelism (batch sharding + gradient pmean)
-    - ``seq``: sequence/context parallelism (ring attention)
+    - ``seq``: sequence/context parallelism (ring/ulysses attention)
     - ``tensor``: tensor parallelism (head/feature sharding)
+    - ``pipe``: pipeline parallelism (stage-sharded block stacks, GPipe
+      microbatch schedule over ppermute)
+    - ``expert``: expert parallelism (MoE expert sharding, all-to-all
+      token dispatch)
 
     A size of -1 on the data axis means "all remaining devices".
     """
@@ -74,30 +78,42 @@ class MeshConfig:
     data: int = -1
     seq: int = 1
     tensor: int = 1
+    pipe: int = 1
+    expert: int = 1
 
     AXIS_DATA = "data"
     AXIS_SEQ = "seq"
     AXIS_TENSOR = "tensor"
+    AXIS_PIPE = "pipe"
+    AXIS_EXPERT = "expert"
 
     @property
     def axis_names(self) -> tuple:
-        return (self.AXIS_DATA, self.AXIS_SEQ, self.AXIS_TENSOR)
+        return (
+            self.AXIS_DATA,
+            self.AXIS_SEQ,
+            self.AXIS_TENSOR,
+            self.AXIS_PIPE,
+            self.AXIS_EXPERT,
+        )
 
     def resolve(self, n_devices: int) -> tuple:
-        """Return concrete (data, seq, tensor) sizes for n_devices."""
-        seq, tensor = self.seq, self.tensor
+        """Return concrete (data, seq, tensor, pipe, expert) sizes."""
+        rest = self.seq * self.tensor * self.pipe * self.expert
         data = self.data
         if data == -1:
-            if n_devices % (seq * tensor) != 0:
+            if n_devices % rest != 0:
                 raise ValueError(
-                    f"{n_devices} devices not divisible by seq*tensor={seq * tensor}"
+                    f"{n_devices} devices not divisible by "
+                    f"seq*tensor*pipe*expert={rest}"
                 )
-            data = n_devices // (seq * tensor)
-        if data * seq * tensor != n_devices:
+            data = n_devices // rest
+        if data * rest != n_devices:
             raise ValueError(
-                f"mesh {data}x{seq}x{tensor} != {n_devices} devices"
+                f"mesh {data}x{self.seq}x{self.tensor}x{self.pipe}"
+                f"x{self.expert} != {n_devices} devices"
             )
-        return (data, seq, tensor)
+        return (data, self.seq, self.tensor, self.pipe, self.expert)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -135,6 +151,16 @@ class TrainConfig:
 
     # distribution
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
+    # ZeRO-3: shard params + optimizer state over the 'data' axis (the
+    # reference replicates both on every process — SURVEY §2.3)
+    fsdp: bool = False
+    # sequence-parallel attention scheme when mesh.seq > 1
+    sp_impl: str = "ring"              # ring | ulysses
+    # GPipe microbatches per step when mesh.pipe > 1
+    num_microbatches: int = 4
+    # MoE expert count when mesh.expert > 1 (0 = auto: 8 rounded up to a
+    # multiple of the expert axis)
+    num_experts: int = 0
     # multi-host rendezvous (replaces MASTER_ADDR/MASTER_PORT, ddp_main.py:61-62)
     coordinator_address: Optional[str] = None
     num_processes: Optional[int] = None
@@ -146,6 +172,7 @@ class TrainConfig:
     resume: bool = False
 
     # eval / logging
+    max_steps_per_epoch: int = 0       # 0 = full epoch; >0 caps steps (smoke runs)
     eval_every_epochs: int = 0         # 0 = only at end (reference behavior)
     log_every_steps: int = 100
     profile_dir: Optional[str] = None

@@ -23,6 +23,55 @@ import jax.numpy as jnp
 from ddp_practice_tpu.ops.attention import dot_product_attention
 
 
+class ViTEmbed(nn.Module):
+    """Patch + position embedding stem (shared by ViT/ViT-MoE/PipelinedViT)."""
+
+    patch_size: int = 4
+    hidden_dim: int = 192
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.astype(self.dtype)
+        p = self.patch_size
+        x = nn.Conv(
+            self.hidden_dim,
+            kernel_size=(p, p),
+            strides=(p, p),
+            padding="VALID",
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            name="patch_embed",
+        )(x)
+        b, h, w, d = x.shape
+        x = x.reshape((b, h * w, d))
+        pos = self.param(
+            "pos_embed",
+            nn.initializers.normal(stddev=0.02),
+            (1, h * w, d),
+            self.param_dtype,
+        )
+        return x + pos.astype(self.dtype)
+
+
+class ViTHead(nn.Module):
+    """Final LN + global average pool + classifier (shared across ViTs)."""
+
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype, name="ln_f")(x)
+        x = jnp.mean(x, axis=1)  # global average pool (no class token; MXU-friendlier)
+        x = nn.Dense(
+            self.num_classes, dtype=self.dtype, param_dtype=self.param_dtype, name="head"
+        )(x)
+        return x.astype(jnp.float32)
+
+
 class MlpBlock(nn.Module):
     mlp_dim: int
     dtype: jnp.dtype = jnp.float32
@@ -43,7 +92,8 @@ class SelfAttention(nn.Module):
     num_heads: int
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
-    seq_axis: Optional[str] = None  # mesh axis for ring attention (sequence parallel)
+    seq_axis: Optional[str] = None  # mesh axis for sequence parallelism
+    sp_impl: str = "ring"           # "ring" | "ulysses"
 
     @nn.compact
     def __call__(self, x):
@@ -57,7 +107,9 @@ class SelfAttention(nn.Module):
             name="qkv",
         )(x)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-        out = dot_product_attention(q, k, v, seq_axis=self.seq_axis)
+        out = dot_product_attention(
+            q, k, v, seq_axis=self.seq_axis, sp_impl=self.sp_impl
+        )
         out = nn.DenseGeneral(
             d,
             axis=(-2, -1),
@@ -74,6 +126,7 @@ class EncoderBlock(nn.Module):
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
     seq_axis: Optional[str] = None
+    sp_impl: str = "ring"
 
     @nn.compact
     def __call__(self, x):
@@ -83,6 +136,7 @@ class EncoderBlock(nn.Module):
             dtype=self.dtype,
             param_dtype=self.param_dtype,
             seq_axis=self.seq_axis,
+            sp_impl=self.sp_impl,
             name="attn",
         )(y)
         x = x + y
@@ -103,30 +157,18 @@ class ViT(nn.Module):
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
     seq_axis: Optional[str] = None
+    sp_impl: str = "ring"
     axis_name: Optional[str] = None  # accepted for registry uniformity (no BN)
 
     @nn.compact
     def __call__(self, x, *, train: bool = False):
-        x = x.astype(self.dtype)
-        p = self.patch_size
-        x = nn.Conv(
-            self.hidden_dim,
-            kernel_size=(p, p),
-            strides=(p, p),
-            padding="VALID",
+        x = ViTEmbed(
+            patch_size=self.patch_size,
+            hidden_dim=self.hidden_dim,
             dtype=self.dtype,
             param_dtype=self.param_dtype,
-            name="patch_embed",
+            name="embed",
         )(x)
-        b, h, w, d = x.shape
-        x = x.reshape((b, h * w, d))
-        pos = self.param(
-            "pos_embed",
-            nn.initializers.normal(stddev=0.02),
-            (1, h * w, d),
-            self.param_dtype,
-        )
-        x = x + pos.astype(self.dtype)
         for i in range(self.depth):
             x = EncoderBlock(
                 self.num_heads,
@@ -134,14 +176,15 @@ class ViT(nn.Module):
                 dtype=self.dtype,
                 param_dtype=self.param_dtype,
                 seq_axis=self.seq_axis,
+                sp_impl=self.sp_impl,
                 name=f"block{i}",
             )(x)
-        x = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype, name="ln_f")(x)
-        x = jnp.mean(x, axis=1)  # global average pool (no class token; MXU-friendlier)
-        x = nn.Dense(
-            self.num_classes, dtype=self.dtype, param_dtype=self.param_dtype, name="head"
+        return ViTHead(
+            num_classes=self.num_classes,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            name="classifier",
         )(x)
-        return x.astype(jnp.float32)
 
 
 def ViTTiny(**kw):

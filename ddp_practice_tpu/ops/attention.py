@@ -25,13 +25,22 @@ def dot_product_attention(
     *,
     causal: bool = False,
     seq_axis: Optional[str] = None,
+    sp_impl: str = "ring",
 ) -> jnp.ndarray:
-    """Multi-head attention; dispatches to ring attention when `seq_axis`
-    names a mesh axis the sequence dimension is sharded over."""
+    """Multi-head attention; dispatches to a sequence-parallel scheme when
+    `seq_axis` names a mesh axis the sequence dimension is sharded over:
+    "ring" (K/V rotation, extreme lengths) or "ulysses" (all-to-all head
+    scatter, maximally fused local attention)."""
     if seq_axis is not None:
-        from ddp_practice_tpu.parallel.ring import ring_attention
+        if sp_impl == "ring":
+            from ddp_practice_tpu.parallel.ring import ring_attention
 
-        return ring_attention(q, k, v, axis_name=seq_axis, causal=causal)
+            return ring_attention(q, k, v, axis_name=seq_axis, causal=causal)
+        if sp_impl == "ulysses":
+            from ddp_practice_tpu.parallel.ulysses import ulysses_attention
+
+            return ulysses_attention(q, k, v, axis_name=seq_axis, causal=causal)
+        raise ValueError(f"unknown sp_impl {sp_impl!r} (want 'ring'|'ulysses')")
     return _attention(q, k, v, causal=causal)
 
 

@@ -24,6 +24,7 @@ from ddp_practice_tpu.parallel.dist import (
     process_index,
 )
 from ddp_practice_tpu.parallel.sharding_rules import param_sharding_rules
+from ddp_practice_tpu.parallel.fsdp import fsdp_rules
 
 __all__ = [
     "build_mesh",
@@ -35,4 +36,5 @@ __all__ = [
     "process_count",
     "process_index",
     "param_sharding_rules",
+    "fsdp_rules",
 ]

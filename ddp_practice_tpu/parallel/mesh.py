@@ -28,7 +28,10 @@ def build_mesh(
     devices = list(devices) if devices is not None else jax.devices()
     if config.data != -1:
         # explicit mesh smaller than the host's device count: use a subset
-        want = config.data * config.seq * config.tensor
+        want = (
+            config.data * config.seq * config.tensor
+            * config.pipe * config.expert
+        )
         if want < len(devices):
             devices = devices[:want]
     shape = config.resolve(len(devices))

@@ -1,0 +1,103 @@
+"""FSDP/ZeRO-3 tests: data-axis parameter sharding.
+
+The reference fully replicates params + optimizer state per process
+(ddp_main.py:117-125; SURVEY §2.3 "FSDP/ZeRO — No"). Here ZeRO-3 is a
+PartitionSpec choice; these tests assert (a) leaves really are sharded
+over 'data' (and optimizer mirrors with them), (b) training numerics are
+identical to replicated DP, and (c) FSDP composes with tensor parallelism.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ddp_practice_tpu.config import MeshConfig, TrainConfig
+from ddp_practice_tpu.models import create_model
+from ddp_practice_tpu.parallel.fsdp import fsdp_rules
+from ddp_practice_tpu.parallel.mesh import batch_sharding, build_mesh, shard_state
+from ddp_practice_tpu.parallel.sharding_rules import param_sharding_rules
+from ddp_practice_tpu.train import create_state, make_optimizer, make_train_step
+
+
+def _batch(n, seed=0, hw=28, ch=1):
+    rng = np.random.default_rng(seed)
+    return {
+        "image": jnp.asarray(rng.uniform(size=(n, hw, hw, ch)), jnp.float32),
+        "label": jnp.asarray(rng.integers(0, 10, n), jnp.int32),
+        "weight": jnp.ones((n,), jnp.float32),
+    }
+
+
+def _make(mesh_cfg, *, model_name="convnet", rules=None, model_kwargs=None,
+          sample_shape=(1, 28, 28, 1)):
+    cfg = TrainConfig(optimizer="sgd", learning_rate=1e-2)
+    mesh = build_mesh(mesh_cfg)
+    model = create_model(model_name, **(model_kwargs or {}))
+    tx = make_optimizer(cfg)
+
+    def init_fn(r):
+        return create_state(model, tx, rng=r, sample_input=jnp.zeros(sample_shape))
+
+    abstract = jax.eval_shape(init_fn, jax.random.PRNGKey(0))
+    shardings = shard_state(abstract, mesh, rules)
+    state = jax.jit(init_fn, out_shardings=shardings)(jax.random.PRNGKey(0))
+    bsh = batch_sharding(mesh)
+    step = make_train_step(
+        model, tx, mesh=mesh, state_shardings=shardings, batch_shardings=bsh
+    )
+    return mesh, state, step, bsh
+
+
+def test_fsdp_leaves_sharded_over_data(devices):
+    rules = fsdp_rules(8, None, min_leaf_size=128)
+    mesh, state, _, _ = _make(MeshConfig(data=8), rules=rules)
+    # dense kernel (1568, 10): dim 0 divisible by 8 -> sharded over 'data'
+    k = state.params["Dense_0"]["kernel"]
+    assert "data" in str(k.sharding.spec), k.sharding.spec
+    assert k.addressable_shards[0].data.shape[0] == k.shape[0] // 8
+    # optimizer state mirrors the same layout (ZeRO partitioning): total
+    # addressable bytes for that leaf are 1/8 of the logical array
+    assert k.addressable_shards[0].data.size * 8 == k.size
+
+
+def test_fsdp_small_leaves_stay_replicated(devices):
+    rules = fsdp_rules(8, None, min_leaf_size=1024)
+    mesh, state, _, _ = _make(MeshConfig(data=8), rules=rules)
+    b = state.params["Conv_0"]["bias"]  # (16,) — tiny, stays replicated
+    assert b.sharding.spec == jax.sharding.PartitionSpec() or all(
+        s is None for s in b.sharding.spec
+    )
+
+
+def test_fsdp_matches_replicated_dp(devices):
+    batches = [_batch(8, seed=s) for s in range(3)]
+    _, s_rep, step_rep, _ = _make(MeshConfig(data=8))
+    _, s_fsdp, step_fsdp, _ = _make(
+        MeshConfig(data=8), rules=fsdp_rules(8, None, min_leaf_size=128)
+    )
+    for b in batches:
+        s_rep, m_rep = step_rep(s_rep, b)
+        s_fsdp, m_fsdp = step_fsdp(s_fsdp, b)
+    np.testing.assert_allclose(
+        float(m_rep["loss"]), float(m_fsdp["loss"]), rtol=1e-5
+    )
+    for a, b in zip(jax.tree.leaves(s_rep.params), jax.tree.leaves(s_fsdp.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_fsdp_composes_with_tp(devices):
+    """TP rules claim 'tensor' dims; FSDP shards a free dim over 'data'."""
+    tp = param_sharding_rules("vit_tiny")
+    rules = fsdp_rules(2, tp, min_leaf_size=128)
+    mesh, state, step, _ = _make(
+        MeshConfig(data=2, tensor=4),
+        model_name="vit_tiny",
+        rules=rules,
+        model_kwargs=dict(depth=2, hidden_dim=32, num_heads=4, mlp_dim=64),
+        sample_shape=(1, 16, 16, 3),
+    )
+    qkv = state.params["block0"]["attn"]["qkv"]["kernel"]
+    spec = str(qkv.sharding.spec)
+    assert "tensor" in spec and "data" in spec, spec
+    state, metrics = step(state, _batch(8, seed=1, hw=16, ch=3))
+    assert np.isfinite(float(metrics["loss"]))
