@@ -15,6 +15,8 @@ from ddp_practice_tpu.config import PrecisionPolicy
 from ddp_practice_tpu.models.convnet import ConvNet
 from ddp_practice_tpu.models.resnet import ResNet, ResNet18, ResNet50
 from ddp_practice_tpu.models.vit import ViT, ViTTiny
+from ddp_practice_tpu.models.pipeline_vit import PipelinedViT
+from ddp_practice_tpu.models.vit_moe import ViTMoE
 
 _REGISTRY = {}
 
@@ -91,6 +93,35 @@ def _vit_tiny(*, num_classes, policy, axis_name, **kw):
     )
 
 
+@register("vit_tiny_moe")
+def _vit_tiny_moe(*, num_classes, policy, axis_name, **kw):
+    kw.setdefault("hidden_dim", 192)
+    kw.setdefault("depth", 12)
+    kw.setdefault("num_heads", 3)
+    kw.setdefault("mlp_dim", 768)
+    return ViTMoE(
+        num_classes=num_classes,
+        dtype=policy.compute_dtype,
+        param_dtype=policy.param_dtype,
+        **kw,
+    )
+
+
+@register("vit_tiny_pipe")
+def _vit_tiny_pipe(*, num_classes, policy, axis_name, **kw):
+    kw.setdefault("hidden_dim", 192)
+    kw.setdefault("depth", 12)
+    kw.setdefault("num_heads", 3)
+    kw.setdefault("mlp_dim", 768)
+    return PipelinedViT(
+        num_classes=num_classes,
+        dtype=policy.compute_dtype,
+        param_dtype=policy.param_dtype,
+        axis_name=axis_name,
+        **kw,
+    )
+
+
 __all__ = [
     "create_model",
     "ConvNet",
@@ -99,4 +130,6 @@ __all__ = [
     "ResNet50",
     "ViT",
     "ViTTiny",
+    "PipelinedViT",
+    "ViTMoE",
 ]

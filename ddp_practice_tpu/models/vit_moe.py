@@ -1,0 +1,110 @@
+"""ViT-MoE: Vision Transformer with mixture-of-experts MLP blocks.
+
+The expert-parallel rung of the model ladder (no MoE anywhere in the
+reference — SURVEY §2.3). Every `moe_every`-th encoder block swaps its
+dense MLP for `ops.moe.MoEMlp`: top-k routed experts stacked on a leading
+dim sharded over the 'expert' mesh axis, dispatch/combine lowered to
+all-to-alls by GSPMD. Attention blocks are the standard ones (TP/SP
+compose as in plain ViT).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ddp_practice_tpu.models.vit import MlpBlock, SelfAttention, ViTEmbed, ViTHead
+from ddp_practice_tpu.ops.moe import MoEMlp
+
+
+class MoEEncoderBlock(nn.Module):
+    num_heads: int
+    mlp_dim: int
+    num_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+    seq_axis: Optional[str] = None
+    sp_impl: str = "ring"
+    use_moe: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        y = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype, name="ln1")(x)
+        y = SelfAttention(
+            self.num_heads,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            seq_axis=self.seq_axis,
+            sp_impl=self.sp_impl,
+            name="attn",
+        )(y)
+        x = x + y
+        y = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype, name="ln2")(x)
+        if self.use_moe:
+            y = MoEMlp(
+                num_experts=self.num_experts,
+                top_k=self.top_k,
+                capacity_factor=self.capacity_factor,
+                mlp_dim=self.mlp_dim,
+                dtype=self.dtype,
+                param_dtype=self.param_dtype,
+                name="moe",
+            )(y)
+        else:
+            y = MlpBlock(
+                self.mlp_dim, dtype=self.dtype, param_dtype=self.param_dtype,
+                name="mlp",
+            )(y)
+        return x + y
+
+
+class ViTMoE(nn.Module):
+    num_classes: int = 10
+    patch_size: int = 4
+    hidden_dim: int = 192
+    depth: int = 12
+    num_heads: int = 3
+    mlp_dim: int = 768
+    num_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    moe_every: int = 2               # every 2nd block is MoE (GShard layout)
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+    seq_axis: Optional[str] = None
+    sp_impl: str = "ring"
+    axis_name: Optional[str] = None  # registry uniformity (no BN)
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        x = ViTEmbed(
+            patch_size=self.patch_size,
+            hidden_dim=self.hidden_dim,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            name="embed",
+        )(x)
+        for i in range(self.depth):
+            x = MoEEncoderBlock(
+                self.num_heads,
+                self.mlp_dim,
+                num_experts=self.num_experts,
+                top_k=self.top_k,
+                capacity_factor=self.capacity_factor,
+                dtype=self.dtype,
+                param_dtype=self.param_dtype,
+                seq_axis=self.seq_axis,
+                sp_impl=self.sp_impl,
+                use_moe=(i % self.moe_every == self.moe_every - 1),
+                name=f"block{i}",
+            )(x)
+        return ViTHead(
+            num_classes=self.num_classes,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            name="classifier",
+        )(x)

@@ -1,0 +1,164 @@
+"""Mixture-of-Experts: top-k routed MLP with expert parallelism.
+
+Absent from the reference ("Expert parallel (EP/MoE) — No", SURVEY §2.3).
+TPU-first construction (the GShard/Switch recipe, which was designed FOR
+TPUs): routing is dense one-hot linear algebra — no gather/scatter, no
+dynamic shapes, everything lands on the MXU as batched einsums —
+
+    logits  (G,T,E) -> top-k assignment + position-in-expert via cumsum
+    dispatch (G,T,E,C) one-hot   combine (G,T,E,C) gate-weighted
+    expert_in  = einsum(dispatch, x)      -> (E, G, C, D)
+    expert_out = batched expert MLP       -> (E, G, C, D)
+    y          = einsum(combine, expert_out) -> (G, T, D)
+
+Expert weights are stacked on a leading E dim sharded over the 'expert'
+mesh axis, and the (E, ...) activation tensors carry a
+`with_sharding_constraint` to the same axis — XLA lowers the layout switch
+(tokens grouped-by-expert <-> experts-by-token) into all-to-alls over ICI,
+which is exactly the manual NCCL a2a pattern of GPU MoE frameworks, here
+derived from shardings. Capacity overflow drops tokens (residual passes
+them through untouched); a Switch-style load-balance auxiliary loss keeps
+routing uniform.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ddp_practice_tpu.config import MeshConfig
+
+
+def _constrain(x, spec):
+    """Pin a layout on the current framework mesh (no-op without a mesh —
+    e.g. plain single-device unit tests). Uses NamedSharding, which binds
+    under jit without a jax context mesh."""
+    from ddp_practice_tpu.parallel.ring import get_current_mesh
+
+    mesh = get_current_mesh()
+    if mesh is None:
+        return x
+    return jax.lax.with_sharding_constraint(
+        x, jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec(*spec))
+    )
+
+
+def top_k_gating(
+    router_logits: jnp.ndarray,  # (G, T, E) fp32
+    *,
+    k: int,
+    capacity: int,
+):
+    """Return (dispatch (G,T,E,C) bool-ish, combine (G,T,E,C), aux_loss).
+
+    Iterative top-k: pick the best expert per token, compute each token's
+    position within that expert's buffer by a cumsum over the token dim,
+    drop tokens past `capacity`, mask the chosen expert out, repeat. All
+    dense ops — compiles to static-shape TPU code.
+    """
+    g, t, e = router_logits.shape
+    gates = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+
+    remaining = gates
+    fill = jnp.zeros((g, e), jnp.float32)  # tokens already claimed per expert
+    dispatch = jnp.zeros((g, t, e, capacity), jnp.float32)
+    for _ in range(k):
+        choice = jnp.argmax(remaining, axis=-1)              # (G, T)
+        onehot = jax.nn.one_hot(choice, e, dtype=jnp.float32)  # (G, T, E)
+        pos = (
+            jnp.cumsum(onehot, axis=1) - onehot + fill[:, None, :]
+        )  # (G, T, E): position within expert buffer
+        pos_tok = jnp.sum(pos * onehot, axis=-1)             # (G, T)
+        keep = (pos_tok < capacity).astype(jnp.float32)      # (G, T)
+        pos_oh = jax.nn.one_hot(
+            pos_tok.astype(jnp.int32), capacity, dtype=jnp.float32
+        )
+        dispatch = dispatch + jnp.einsum(
+            "gte,gtc->gtec", onehot * keep[..., None], pos_oh
+        )
+        fill = fill + jnp.sum(onehot * keep[..., None], axis=1)
+        remaining = remaining * (1.0 - onehot)
+
+    # per-slot combine weight: router gates renormalized over each token's
+    # kept experts (tokens dropped everywhere get an all-zero combine row —
+    # the residual connection carries them through unchanged)
+    dispatched_expert = jnp.sum(dispatch, axis=-1)           # (G, T, E)
+    gsel = gates * dispatched_expert
+    gsel = gsel / jnp.maximum(jnp.sum(gsel, axis=-1, keepdims=True), 1e-9)
+    combine = dispatch * gsel[..., None]
+
+    # Switch-style load-balance loss: E * sum_e fraction_e * prob_e
+    frac = jnp.mean(dispatched_expert, axis=(0, 1))          # (E,) usage
+    prob = jnp.mean(gates, axis=(0, 1))                      # (E,) router mass
+    aux = e * jnp.sum(frac * prob)
+    return dispatch, combine, aux
+
+
+class MoEMlp(nn.Module):
+    """Expert-parallel MLP: drop-in for a dense transformer MLP block."""
+
+    num_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    mlp_dim: int = 768
+    aux_loss_weight: float = 0.01
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+    expert_axis: Optional[str] = MeshConfig.AXIS_EXPERT
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:  # (G, T, D)
+        g, t, d = x.shape
+        e, f = self.num_experts, self.mlp_dim
+        capacity = max(
+            1, int(self.capacity_factor * self.top_k * t / e)
+        )
+
+        router = nn.Dense(
+            e,
+            dtype=jnp.float32,
+            param_dtype=self.param_dtype,
+            use_bias=False,
+            name="router",
+        )
+        logits = router(x.astype(jnp.float32))               # (G, T, E)
+        dispatch, combine, aux = top_k_gating(
+            logits, k=self.top_k, capacity=capacity
+        )
+        self.sow("intermediates", "moe_aux_loss", self.aux_loss_weight * aux)
+
+        w_in = self.param(
+            "expert_w_in",
+            nn.initializers.lecun_normal(batch_axis=(0,)),
+            (e, d, f),
+            self.param_dtype,
+        )
+        b_in = self.param(
+            "expert_b_in", nn.initializers.zeros, (e, f), self.param_dtype
+        )
+        w_out = self.param(
+            "expert_w_out",
+            nn.initializers.lecun_normal(batch_axis=(0,)),
+            (e, f, d),
+            self.param_dtype,
+        )
+        b_out = self.param(
+            "expert_b_out", nn.initializers.zeros, (e, d), self.param_dtype
+        )
+
+        ax = self.expert_axis
+        cdtype = self.dtype
+        xin = jnp.einsum(
+            "gtec,gtd->egcd", dispatch.astype(cdtype), x.astype(cdtype)
+        )
+        xin = _constrain(xin, (ax, MeshConfig.AXIS_DATA, None, None))
+        h = jnp.einsum("egcd,edf->egcf", xin, w_in.astype(cdtype))
+        h = nn.gelu(h + b_in.astype(cdtype)[:, None, None, :])
+        out = jnp.einsum("egcf,efd->egcd", h, w_out.astype(cdtype))
+        out = out + b_out.astype(cdtype)[:, None, None, :]
+        out = _constrain(out, (ax, MeshConfig.AXIS_DATA, None, None))
+        y = jnp.einsum("gtec,egcd->gtd", combine.astype(cdtype), out)
+        return y.astype(x.dtype)

@@ -53,9 +53,34 @@ def _vit_rule(path, leaf) -> Optional[P]:
     return None
 
 
+def _vit_pipe_rule(path, leaf) -> Optional[P]:
+    """Pipelined ViT: block-stack leaves carry a leading depth dimension
+    sharded over 'pipe' (each device holds its stage's contiguous blocks);
+    embed/head replicated. TP is not composed into the pipeline shard_map
+    (its in_specs declare inner dims replicated), so inner dims stay None.
+    """
+    name = keystr(path)
+    if "'blocks'" in name:
+        return P(MeshConfig.AXIS_PIPE)
+    return None
+
+
+def _vit_moe_rule(path, leaf) -> Optional[P]:
+    """ViT-MoE: stacked expert weights shard their leading E dim over
+    'expert'; router replicated; dense attention/MLP follow the TP rules."""
+    name = keystr(path)
+    if "expert_" in name:
+        return P(MeshConfig.AXIS_EXPERT)
+    if "router" in name:
+        return None
+    return _vit_rule(path, leaf)
+
+
 _RULES: dict = {
     "vit": _vit_rule,
     "vit_tiny": _vit_rule,
+    "vit_tiny_pipe": _vit_pipe_rule,
+    "vit_tiny_moe": _vit_moe_rule,
 }
 
 

@@ -46,18 +46,28 @@ def make_train_step(
 
         def loss_fn(params):
             variables = {"params": params}
+            mutable = ["intermediates"]  # routed layers sow aux losses here
             if has_bn:
                 variables["batch_stats"] = state.batch_stats
-                logits, updated = model.apply(
-                    variables, batch["image"], train=True, mutable=["batch_stats"]
-                )
-                new_stats = updated["batch_stats"]
-            else:
-                logits = model.apply(variables, batch["image"], train=True)
-                new_stats = None
+                mutable.append("batch_stats")
+            logits, updated = model.apply(
+                variables, batch["image"], train=True, mutable=mutable
+            )
+            new_stats = updated["batch_stats"] if has_bn else None
             loss = cross_entropy(
                 logits, batch["label"], label_smoothing=label_smoothing
             )
+            # sown auxiliary losses (MoE load-balance), pre-scaled by their
+            # layers; keyed on the "aux_loss" name suffix so diagnostic sows
+            # (activations, entropies) never leak into the objective
+            aux = sum(
+                jnp.sum(leaf)
+                for path, leaf in jax.tree_util.tree_flatten_with_path(
+                    updated.get("intermediates", {})
+                )[0]
+                if "aux_loss" in jax.tree_util.keystr(path)
+            )
+            loss = loss + aux
             return loss, (logits, new_stats)
 
         (loss, (logits, new_stats)), grads = jax.value_and_grad(

@@ -33,3 +33,14 @@ def devices():
     devs = jax.devices()
     assert len(devs) == 8, f"expected 8 virtual devices, got {len(devs)}"
     return devs
+
+
+@pytest.fixture(autouse=True)
+def _reset_mesh_registry():
+    """Tests that set the framework's current mesh (directly or via
+    Trainer) must not leak it into later tests — sharding constraints
+    consult this global."""
+    yield
+    from ddp_practice_tpu.parallel.ring import set_current_mesh
+
+    set_current_mesh(None)
