@@ -33,7 +33,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-b", "--batch_size", type=int, default=32,
                    help="per data-parallel replica, like the reference")
     p.add_argument("--model", default="convnet",
-                   choices=["convnet", "resnet18", "resnet50", "vit_tiny"])
+                   choices=["convnet", "resnet18", "resnet50", "vit_tiny",
+                            "vit_tiny_moe", "vit_tiny_pipe"])
     p.add_argument("--dataset", default="mnist")
     p.add_argument("--data_dir", default="./data")
     p.add_argument("--lr", type=float, default=1e-4)
@@ -50,8 +51,22 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--data_axis", type=int, default=-1)
     p.add_argument("--seq", type=int, default=1, help="sequence-parallel degree")
     p.add_argument("--tensor", type=int, default=1, help="tensor-parallel degree")
+    p.add_argument("--pipe", type=int, default=1, help="pipeline-parallel stages")
+    p.add_argument("--expert", type=int, default=1, help="expert-parallel degree")
+    p.add_argument("--sp_impl", default="ring", choices=["ring", "ulysses"],
+                   help="sequence-parallel attention scheme")
+    p.add_argument("--microbatches", type=int, default=4,
+                   help="GPipe microbatches per step (pipe > 1)")
+    p.add_argument("--num_experts", type=int, default=0,
+                   help="MoE expert count (0 = auto from --expert axis)")
+    p.add_argument("--fsdp", action="store_true",
+                   help="ZeRO-3: shard params + optimizer state over 'data'")
     p.add_argument("--devices", type=int, default=0,
                    help="use only the first N local devices (0 = all)")
+    p.add_argument("--cpu", type=int, default=0, metavar="N",
+                   help="force the CPU platform with N virtual devices "
+                        "(sharding dev-runs without TPU hardware; set via "
+                        "jax.config because TPU plugins override env vars)")
     p.add_argument("--coordinator", default=None,
                    help="host:port for multi-host rendezvous")
     p.add_argument("--num_processes", type=int, default=None)
@@ -59,6 +74,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--ckpt_dir", default=None)
     p.add_argument("--resume", action="store_true")
     p.add_argument("--eval_every", type=int, default=0)
+    p.add_argument("--max_steps", type=int, default=0,
+                   help="cap steps per epoch (smoke runs; 0 = full epoch)")
     p.add_argument("--log_every", type=int, default=100)
     p.add_argument("--profile_dir", default=None)
     p.add_argument("--loader", default="auto", choices=["auto", "native", "python"])
@@ -81,13 +98,21 @@ def config_from_args(args) -> TrainConfig:
         scale_lr_by_replicas=args.scale_lr,
         seed=args.seed,
         precision=args.precision,
-        mesh=MeshConfig(data=args.data_axis, seq=args.seq, tensor=args.tensor),
+        mesh=MeshConfig(
+            data=args.data_axis, seq=args.seq, tensor=args.tensor,
+            pipe=args.pipe, expert=args.expert,
+        ),
+        fsdp=args.fsdp,
+        sp_impl=args.sp_impl,
+        num_microbatches=args.microbatches,
+        num_experts=args.num_experts,
         coordinator_address=args.coordinator,
         num_processes=args.num_processes,
         process_id=args.process_id,
         checkpoint_dir=args.ckpt_dir,
         resume=args.resume,
         eval_every_epochs=args.eval_every,
+        max_steps_per_epoch=args.max_steps,
         log_every_steps=args.log_every,
         profile_dir=args.profile_dir,
         loader_backend=args.loader,
@@ -100,6 +125,11 @@ def main(argv=None) -> int:
         import os
 
         os.environ.setdefault("JAX_NUM_CPU_DEVICES", str(args.devices))
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", args.cpu)
     from ddp_practice_tpu.train.loop import fit  # deferred: jax import cost
 
     t0 = time.time()

@@ -26,16 +26,22 @@ _lib_lock = threading.Lock()
 _build_attempted = False
 
 
+_ABI_VERSION = 2  # keep in sync with dl_version() in native/dataloader.cpp
+
+
 def _load_library() -> Optional[ctypes.CDLL]:
     global _lib, _build_attempted
     with _lib_lock:
         if _lib is not None:
-            return _lib
+            return _lib if _lib is not _UNAVAILABLE else None
         so_path = os.path.abspath(os.path.join(_NATIVE_DIR, _SO_NAME))
-        if not os.path.exists(so_path) and not _build_attempted:
-            _build_attempted = True
-            _try_build()
-        if not os.path.exists(so_path):
+        if not os.path.exists(so_path) or _stale(so_path):
+            # missing OR built from an older ABI: try one rebuild
+            if not _build_attempted:
+                _build_attempted = True
+                _try_build()
+        if not os.path.exists(so_path) or _stale(so_path):
+            _lib = _UNAVAILABLE  # cache the negative result
             return None
         lib = ctypes.CDLL(so_path)
         lib.dl_create.restype = ctypes.c_void_p
@@ -47,9 +53,24 @@ def _load_library() -> Optional[ctypes.CDLL]:
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int32,
         ]
+        lib.dl_gather.restype = ctypes.c_int32
         lib.dl_version.restype = ctypes.c_int32
         _lib = lib
         return _lib
+
+
+_UNAVAILABLE = object()  # sentinel: library looked for and not usable
+
+
+def _stale(so_path: str) -> bool:
+    """True if the on-disk .so predates the current C ABI (`make` rebuilds
+    it from dataloader.cpp; a stale build must not be half-trusted)."""
+    try:
+        probe = ctypes.CDLL(so_path)
+        probe.dl_version.restype = ctypes.c_int32
+        return probe.dl_version() < _ABI_VERSION
+    except OSError:
+        return True
 
 
 def _try_build() -> None:
@@ -92,7 +113,7 @@ class _NativeGather:
         n = len(idx)
         out_images = np.empty((n,) + self._sample_shape, np.float32)
         out_labels = np.empty((n,), np.int32)
-        self._lib.dl_gather(
+        status = self._lib.dl_gather(
             self._handle,
             idx.ctypes.data_as(ctypes.c_void_p),
             n,
@@ -100,6 +121,11 @@ class _NativeGather:
             out_labels.ctypes.data_as(ctypes.c_void_p),
             0,
         )
+        if status != 0:  # same error class as the numpy fancy-index path
+            raise IndexError(
+                f"native gather: index out of range for dataset of "
+                f"{len(self._images)} samples"
+            )
         return out_images, out_labels
 
     def __del__(self):

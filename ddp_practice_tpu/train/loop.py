@@ -50,6 +50,7 @@ class Trainer:
         mesh_shape = dict(zip(self.mesh.axis_names, self.mesh.devices.shape))
         self.dp = mesh_shape.get(MeshConfig.AXIS_DATA, 1)
         self.sp = mesh_shape.get(MeshConfig.AXIS_SEQ, 1)
+        self.pp = mesh_shape.get(MeshConfig.AXIS_PIPE, 1)
 
         # data — per-replica batch size x data-parallel degree = global batch
         # (the reference's "batch 32 per process" contract, README.md:506)
@@ -82,6 +83,23 @@ class Trainer:
         model_kwargs = {}
         if self.sp > 1:
             model_kwargs["seq_axis"] = MeshConfig.AXIS_SEQ
+            model_kwargs["sp_impl"] = config.sp_impl
+        if self.pp > 1:
+            # pipeline-capable models take the stage count from the mesh; a
+            # non-pipeline model with mesh.pipe > 1 fails loudly here rather
+            # than silently training unpipelined
+            model_kwargs["num_stages"] = self.pp
+            model_kwargs["num_microbatches"] = config.num_microbatches
+        self.ep = mesh_shape.get(MeshConfig.AXIS_EXPERT, 1)
+        if self.ep > 1:
+            # expert count must divide evenly over the 'expert' axis; default
+            # rounds the model's 8 up to the nearest multiple of the axis
+            n_exp = config.num_experts or ((8 + self.ep - 1) // self.ep) * self.ep
+            if n_exp % self.ep != 0:
+                raise ValueError(
+                    f"num_experts={n_exp} not divisible by expert axis {self.ep}"
+                )
+            model_kwargs["num_experts"] = n_exp
         self.model = create_model(
             config.model,
             num_classes=self.train_ds.num_classes,
@@ -104,6 +122,10 @@ class Trainer:
 
         abstract = jax.eval_shape(init_fn, rng)
         rules = param_sharding_rules(config.model)
+        if config.fsdp:
+            from ddp_practice_tpu.parallel.fsdp import fsdp_rules
+
+            rules = fsdp_rules(self.dp, rules)
         self.state_shardings = shard_state(abstract, self.mesh, rules)
         self.state = jax.jit(init_fn, out_shardings=self.state_shardings)(rng)
 
@@ -133,6 +155,12 @@ class Trainer:
 
         self._train_images = 0
         self._train_seconds = 0.0
+        # XLA:CPU's in-process collective rendezvous can deadlock when more
+        # than one execution of a collective-bearing program is in flight
+        # (device threads join different run_ids). On the CPU dev platform,
+        # serialize step dispatch; on TPU, keep async dispatch (collectives
+        # ride ICI and overlap is the point).
+        self._serialize_steps = jax.default_backend() == "cpu"
 
     # ------------------------------------------------------------------ #
 
@@ -146,8 +174,12 @@ class Trainer:
         t0 = time.perf_counter()
         images_this_epoch = 0
         for i, batch in enumerate(it):
+            if cfg.max_steps_per_epoch and i >= cfg.max_steps_per_epoch:
+                break
             with step_annotation(int(self.state.step)):
                 self.state, metrics = self.train_step(self.state, batch)
+            if self._serialize_steps:
+                jax.block_until_ready(metrics)
             images_this_epoch += self.global_batch
             if cfg.log_every_steps and (i + 1) % cfg.log_every_steps == 0:
                 last_metrics = jax.device_get(metrics)
@@ -174,6 +206,8 @@ class Trainer:
         total = jnp.zeros((), jnp.float32)
         for batch in it:
             c, t = self.eval_step(self.state, batch)
+            if self._serialize_steps:
+                jax.block_until_ready(c)
             correct = correct + c
             total = total + t
         return float(correct) / max(float(total), 1.0)

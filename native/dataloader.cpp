@@ -27,10 +27,15 @@ struct Dataset {
 };
 
 void gather_range(const Dataset& ds, const int64_t* indices, int64_t begin,
-                  int64_t end, float* out_images, int32_t* out_labels) {
+                  int64_t end, float* out_images, int32_t* out_labels,
+                  std::atomic<bool>* oob) {
   const size_t row_bytes = static_cast<size_t>(ds.sample_elems) * sizeof(float);
   for (int64_t i = begin; i < end; ++i) {
     const int64_t src = indices[i];
+    if (src < 0 || src >= ds.n) {  // match the numpy backend's IndexError
+      oob->store(true, std::memory_order_relaxed);
+      return;
+    }
     std::memcpy(out_images + i * ds.sample_elems,
                 ds.images + src * ds.sample_elems, row_bytes);
     out_labels[i] = ds.labels[src];
@@ -50,10 +55,14 @@ void* dl_create(const float* images, const int32_t* labels, int64_t n,
 void dl_destroy(void* handle) { delete static_cast<Dataset*>(handle); }
 
 // Gather `count` samples by index into out buffers, using up to
-// `num_threads` threads (<=0 means hardware concurrency).
-void dl_gather(void* handle, const int64_t* indices, int64_t count,
-               float* out_images, int32_t* out_labels, int32_t num_threads) {
+// `num_threads` threads (<=0 means hardware concurrency). Returns 0 on
+// success, -1 if any index is out of [0, n) — mirroring the numpy
+// backend's IndexError instead of reading out-of-bounds memory.
+int32_t dl_gather(void* handle, const int64_t* indices, int64_t count,
+                  float* out_images, int32_t* out_labels,
+                  int32_t num_threads) {
   const Dataset& ds = *static_cast<Dataset*>(handle);
+  std::atomic<bool> oob{false};
   int64_t nthreads = num_threads > 0
                          ? num_threads
                          : static_cast<int64_t>(std::thread::hardware_concurrency());
@@ -62,8 +71,8 @@ void dl_gather(void* handle, const int64_t* indices, int64_t count,
   const int64_t kMinPerThread = 64;
   if (count / kMinPerThread < nthreads) nthreads = count / kMinPerThread;
   if (nthreads <= 1) {
-    gather_range(ds, indices, 0, count, out_images, out_labels);
-    return;
+    gather_range(ds, indices, 0, count, out_images, out_labels, &oob);
+    return oob.load() ? -1 : 0;
   }
   std::vector<std::thread> workers;
   workers.reserve(nthreads);
@@ -73,11 +82,12 @@ void dl_gather(void* handle, const int64_t* indices, int64_t count,
     const int64_t end = begin + per < count ? begin + per : count;
     if (begin >= end) break;
     workers.emplace_back(gather_range, std::cref(ds), indices, begin, end,
-                         out_images, out_labels);
+                         out_images, out_labels, &oob);
   }
   for (auto& w : workers) w.join();
+  return oob.load() ? -1 : 0;
 }
 
-int32_t dl_version() { return 1; }
+int32_t dl_version() { return 2; }
 
 }  // extern "C"
