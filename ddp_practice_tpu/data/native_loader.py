@@ -19,7 +19,10 @@ import numpy as np
 from ddp_practice_tpu.data.datasets import Dataset
 
 _NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
-_SO_NAME = "libddp_loader.so"
+# ABI-versioned filename (matches native/Makefile TARGET): a stale build
+# from an older ABI simply has a different name and is never picked up —
+# dlopen's per-pathname handle caching makes same-name reloads impossible.
+_SO_NAME = "libddp_loader.v2.so"
 
 _lib = None
 _lib_lock = threading.Lock()
@@ -35,12 +38,10 @@ def _load_library() -> Optional[ctypes.CDLL]:
         if _lib is not None:
             return _lib if _lib is not _UNAVAILABLE else None
         so_path = os.path.abspath(os.path.join(_NATIVE_DIR, _SO_NAME))
-        if not os.path.exists(so_path) or _stale(so_path):
-            # missing OR built from an older ABI: try one rebuild
-            if not _build_attempted:
-                _build_attempted = True
-                _try_build()
-        if not os.path.exists(so_path) or _stale(so_path):
+        if not os.path.exists(so_path) and not _build_attempted:
+            _build_attempted = True
+            _try_build()
+        if not os.path.exists(so_path):
             _lib = _UNAVAILABLE  # cache the negative result
             return None
         lib = ctypes.CDLL(so_path)
@@ -55,22 +56,14 @@ def _load_library() -> Optional[ctypes.CDLL]:
         ]
         lib.dl_gather.restype = ctypes.c_int32
         lib.dl_version.restype = ctypes.c_int32
+        if lib.dl_version() != _ABI_VERSION:  # filename/ABI drift guard
+            _lib = _UNAVAILABLE
+            return None
         _lib = lib
         return _lib
 
 
 _UNAVAILABLE = object()  # sentinel: library looked for and not usable
-
-
-def _stale(so_path: str) -> bool:
-    """True if the on-disk .so predates the current C ABI (`make` rebuilds
-    it from dataloader.cpp; a stale build must not be half-trusted)."""
-    try:
-        probe = ctypes.CDLL(so_path)
-        probe.dl_version.restype = ctypes.c_int32
-        return probe.dl_version() < _ABI_VERSION
-    except OSError:
-        return True
 
 
 def _try_build() -> None:

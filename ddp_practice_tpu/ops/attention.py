@@ -26,11 +26,25 @@ def dot_product_attention(
     causal: bool = False,
     seq_axis: Optional[str] = None,
     sp_impl: str = "ring",
+    impl: str = "xla",
 ) -> jnp.ndarray:
     """Multi-head attention; dispatches to a sequence-parallel scheme when
     `seq_axis` names a mesh axis the sequence dimension is sharded over:
     "ring" (K/V rotation, extreme lengths) or "ulysses" (all-to-all head
-    scatter, maximally fused local attention)."""
+    scatter, maximally fused local attention). `impl` picks the local
+    kernel: "xla" (fused by the XLA compiler) or "flash" (the Pallas
+    tiled online-softmax kernel, ops.flash_attention)."""
+    if impl not in ("xla", "flash"):
+        raise ValueError(f"unknown attention impl {impl!r} (want 'xla'|'flash')")
+    if impl == "flash":
+        if seq_axis is not None:
+            raise ValueError(
+                "impl='flash' is not composed with sequence parallelism yet; "
+                "use impl='xla' with sp_impl='ring'|'ulysses'"
+            )
+        from ddp_practice_tpu.ops.flash_attention import flash_attention
+
+        return flash_attention(q, k, v, causal=causal)
     if seq_axis is not None:
         if sp_impl == "ring":
             from ddp_practice_tpu.parallel.ring import ring_attention

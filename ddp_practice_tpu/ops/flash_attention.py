@@ -1,0 +1,188 @@
+"""Flash attention as a Pallas TPU kernel.
+
+The reference consumes fused CUDA kernels through torch (cuDNN/cuBLAS —
+SURVEY §2.2 "CUDA/cuDNN kernels"); the TPU-native analogue for the one op
+XLA doesn't already fuse optimally at long sequence length is a hand-tiled
+attention kernel. Forward pass (per q-block, per batch*head grid cell):
+
+    for each k/v block:                       # fori_loop, VMEM-resident
+        s   = q @ k^T * scale                 # MXU, fp32 accumulate
+        m'  = max(m, rowmax(s))               # online softmax rescale
+        acc = acc*exp(m-m') + exp(s-m') @ v   # MXU
+    out = acc / l,   lse = m + log l
+
+so the (seq x seq) score matrix never materializes in HBM — O(seq) memory
+instead of O(seq^2), one pass over K/V. Causal masking prunes whole k-blocks
+above the diagonal (the fori upper bound shrinks per q-block).
+
+Backward uses the saved logsumexp for a numerically exact dense recompute in
+XLA (einsums on the MXU). Runs compiled on TPU; `interpret=True` under the
+CPU backend so the same tests cover it everywhere (tests/conftest.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_NEG_INF = -1e30
+
+
+def _fwd_kernel(
+    q_ref, k_ref, v_ref, o_ref, lse_ref,
+    *, sm_scale, block_k, causal, q_len_hint,
+):
+    block_q, head_dim = q_ref.shape
+    seq_k = k_ref.shape[0]
+    qi = pl.program_id(1)
+
+    q = q_ref[:].astype(jnp.float32) * sm_scale
+
+    m0 = jnp.full((block_q,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    acc0 = jnp.zeros((block_q, head_dim), jnp.float32)
+
+    n_k = pl.cdiv(seq_k, block_k)
+    # bottom-right-aligned causal (matches _attention's tril offset sk-sq):
+    # query i attends keys <= i + (seq_k - seq_q)
+    causal_offset = seq_k - q_len_hint if causal else 0
+    if causal:
+        # only k-blocks intersecting the allowed triangle of this q-block
+        n_k = jnp.minimum(
+            n_k, pl.cdiv((qi + 1) * block_q + causal_offset, block_k)
+        )
+
+    def body(j, carry):
+        m, l, acc = carry
+        k = k_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(q_pos + causal_offset >= k_pos, s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1)
+        acc_new = acc * corr[:, None] + jnp.dot(
+            p, v, preferred_element_type=jnp.float32
+        )
+        return m_new, l_new, acc_new
+
+    m, l, acc = jax.lax.fori_loop(0, n_k, body, (m0, l0, acc0))
+    l_safe = jnp.maximum(l, 1e-30)
+    o_ref[:] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    lse_ref[:] = (m + jnp.log(l_safe))[:, None]  # (block_q, 1) lane-padded
+
+
+def _flash_fwd(q, k, v, *, causal, block_q, block_k, interpret):
+    """q/k/v: (bh, seq, d). Returns (out, lse)."""
+    bh, seq_q, d = q.shape
+    seq_k = k.shape[1]
+    block_q = min(block_q, seq_q)
+    block_k = min(block_k, seq_k)
+    if seq_q % block_q or seq_k % block_k:
+        raise ValueError(
+            f"flash attention needs seq divisible by block sizes: "
+            f"q {seq_q}%{block_q}, k {seq_k}%{block_k}"
+        )
+    sm_scale = 1.0 / (d ** 0.5)
+    grid = (bh, seq_q // block_q)
+    kernel = functools.partial(
+        _fwd_kernel, sm_scale=sm_scale, block_k=block_k, causal=causal,
+        q_len_hint=seq_q,
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, seq_k, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, seq_k, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, block_q, 1), lambda b, i: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((bh, seq_q, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return out, lse[..., 0]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash(q, k, v, causal, block_q, block_k):
+    interpret = jax.default_backend() == "cpu"
+    out, _ = _flash_fwd(
+        q, k, v, causal=causal, block_q=block_q, block_k=block_k,
+        interpret=interpret,
+    )
+    return out
+
+
+def _flash_vjp_fwd(q, k, v, causal, block_q, block_k):
+    interpret = jax.default_backend() == "cpu"
+    out, lse = _flash_fwd(
+        q, k, v, causal=causal, block_q=block_q, block_k=block_k,
+        interpret=interpret,
+    )
+    return out, (q, k, v, out, lse)
+
+
+def _flash_vjp_bwd(causal, block_q, block_k, res, g):
+    """Exact dense recompute using the saved logsumexp (XLA einsums)."""
+    q, k, v, out, lse = res
+    in_dtype = q.dtype
+    d = q.shape[-1]
+    sm_scale = 1.0 / (d ** 0.5)
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    gf = g.astype(jnp.float32)
+    s = jnp.einsum("bqd,bkd->bqk", qf, kf) * sm_scale
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
+        s = jnp.where(mask[None], s, _NEG_INF)
+    p = jnp.exp(s - lse[..., None])                      # exact probs
+    dv = jnp.einsum("bqk,bqd->bkd", p, gf)
+    dp = jnp.einsum("bqd,bkd->bqk", gf, vf)
+    delta = jnp.sum(gf * out.astype(jnp.float32), axis=-1)  # rowsum(do*o)
+    ds = p * (dp - delta[..., None]) * sm_scale
+    dq = jnp.einsum("bqk,bkd->bqd", ds, kf)
+    dk = jnp.einsum("bqk,bqd->bkd", ds, qf)
+    return dq.astype(in_dtype), dk.astype(in_dtype), dv.astype(in_dtype)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(
+    q: jnp.ndarray,  # (batch, seq, heads, head_dim)
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    causal: bool = False,
+    block_q: int = 128,
+    block_k: int = 128,
+) -> jnp.ndarray:
+    """Fused multi-head attention; layout-matches ops.attention._attention."""
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+
+    def fold(x, s):
+        return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, s, x.shape[-1])
+
+    out = _flash(fold(q, sq), fold(k, sk), fold(v, sk), causal, block_q, block_k)
+    return jnp.transpose(out.reshape(b, h, sq, d), (0, 2, 1, 3))

@@ -91,7 +91,7 @@ class Trainer:
             model_kwargs["num_stages"] = self.pp
             model_kwargs["num_microbatches"] = config.num_microbatches
         self.ep = mesh_shape.get(MeshConfig.AXIS_EXPERT, 1)
-        if self.ep > 1:
+        if self.ep > 1 or config.num_experts:
             # expert count must divide evenly over the 'expert' axis; default
             # rounds the model's 8 up to the nearest multiple of the axis
             n_exp = config.num_experts or ((8 + self.ep - 1) // self.ep) * self.ep

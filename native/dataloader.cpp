@@ -31,8 +31,9 @@ void gather_range(const Dataset& ds, const int64_t* indices, int64_t begin,
                   std::atomic<bool>* oob) {
   const size_t row_bytes = static_cast<size_t>(ds.sample_elems) * sizeof(float);
   for (int64_t i = begin; i < end; ++i) {
-    const int64_t src = indices[i];
-    if (src < 0 || src >= ds.n) {  // match the numpy backend's IndexError
+    int64_t src = indices[i];
+    if (src < 0) src += ds.n;      // numpy-style negative wrapping
+    if (src < 0 || src >= ds.n) {  // then numpy's IndexError contract
       oob->store(true, std::memory_order_relaxed);
       return;
     }
