@@ -73,6 +73,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--process_id", type=int, default=None)
     p.add_argument("--ckpt_dir", default=None)
     p.add_argument("--resume", action="store_true")
+    p.add_argument("--max_restarts", type=int, default=0,
+                   help="checkpoint-based restarts on training failure")
+    p.add_argument("--watchdog", type=float, default=0.0, metavar="SECS",
+                   help="fail-fast if no step completes within SECS")
+    p.add_argument("--sync_check", type=int, default=0, metavar="STEPS",
+                   help="assert cross-host driver sync every STEPS steps")
     p.add_argument("--eval_every", type=int, default=0)
     p.add_argument("--max_steps", type=int, default=0,
                    help="cap steps per epoch (smoke runs; 0 = full epoch)")
@@ -111,6 +117,9 @@ def config_from_args(args) -> TrainConfig:
         process_id=args.process_id,
         checkpoint_dir=args.ckpt_dir,
         resume=args.resume,
+        max_restarts=args.max_restarts,
+        watchdog_timeout_s=args.watchdog,
+        sync_check_every_steps=args.sync_check,
         eval_every_epochs=args.eval_every,
         max_steps_per_epoch=args.max_steps,
         log_every_steps=args.log_every,

@@ -171,6 +171,11 @@ class TrainConfig:
     checkpoint_every_epochs: int = 0   # 0 = only at end
     resume: bool = False
 
+    # failure detection / elastic recovery (absent in reference, SURVEY §5.3)
+    max_restarts: int = 0              # checkpoint-based restarts on failure
+    watchdog_timeout_s: float = 0.0    # 0 = no step watchdog
+    sync_check_every_steps: int = 0    # 0 = no cross-host driver sync checks
+
     # eval / logging
     max_steps_per_epoch: int = 0       # 0 = full epoch; >0 caps steps (smoke runs)
     eval_every_epochs: int = 0         # 0 = only at end (reference behavior)

@@ -11,13 +11,16 @@ attention kernel. Forward pass (per q-block, per batch*head grid cell):
         acc = acc*exp(m-m') + exp(s-m') @ v   # MXU
     out = acc / l,   lse = m + log l
 
-so the (seq x seq) score matrix never materializes in HBM — O(seq) memory
-instead of O(seq^2), one pass over K/V. Causal masking prunes whole k-blocks
-above the diagonal (the fori upper bound shrinks per q-block).
+so the (seq x seq) score matrix never materializes in HBM — the FORWARD is
+O(seq) memory instead of O(seq^2), one pass over K/V. Causal masking prunes
+whole k-blocks above the diagonal (the fori upper bound shrinks per q-block).
 
 Backward uses the saved logsumexp for a numerically exact dense recompute in
-XLA (einsums on the MXU). Runs compiled on TPU; `interpret=True` under the
-CPU backend so the same tests cover it everywhere (tests/conftest.py).
+XLA (einsums on the MXU) — O(seq^2) activation memory; a tiled Pallas
+backward (which the saved lse enables) is the planned follow-up, so today
+the kernel's memory win applies to inference/eval and the forward half of
+training. Runs compiled on TPU; `interpret=True` under the CPU backend so
+the same tests cover it everywhere (tests/conftest.py).
 """
 
 from __future__ import annotations
@@ -93,6 +96,12 @@ def _flash_fwd(q, k, v, *, causal, block_q, block_k, interpret):
         raise ValueError(
             f"flash attention needs seq divisible by block sizes: "
             f"q {seq_q}%{block_q}, k {seq_k}%{block_k}"
+        )
+    if causal and seq_q > seq_k:
+        raise ValueError(
+            f"causal flash attention needs seq_q <= seq_k (bottom-right "
+            f"alignment); got seq_q={seq_q}, seq_k={seq_k} — early query "
+            f"rows would attend to nothing"
         )
     sm_scale = 1.0 / (d ** 0.5)
     grid = (bh, seq_q // block_q)

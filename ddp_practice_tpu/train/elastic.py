@@ -1,0 +1,157 @@
+"""Failure detection and checkpoint-based elastic restart.
+
+The reference has neither: mp.spawn just waits on children and torchrun is
+used --standalone with no restart policy exercised (ddp_main.py:176,
+SURVEY §5.3 — "no retry, no health checks"). TPU-native stance:
+
+- **fail fast**: jax.distributed.initialize carries its own rendezvous
+  timeout; inside a run, a step watchdog detects a hung step (a stuck
+  collective, a dead host) and terminates the process so the fleet
+  scheduler / supervisor can reschedule — on TPU pods the supervisor owns
+  process lifecycles, so in-process thread respawning (the GPU elastic-agent
+  idiom) is the wrong layer.
+- **recover by checkpoint**: `run_with_restarts` re-enters training from
+  the last checkpoint (the resume path the reference lacks), bounding lost
+  work to one checkpoint interval.
+- **debug sync check** (SURVEY §5.2): JAX's SPMD model makes divergent
+  collective sequences impossible *inside* one compiled program, but hosts
+  can still drift in the Python driver loop (different step counts, skewed
+  data exhaustion). `assert_in_sync` all-gathers a fingerprint across
+  processes and raises on mismatch.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+import jax
+
+from ddp_practice_tpu.utils.logging import get_logger
+
+log = get_logger()
+
+
+class StepWatchdog:
+    """Detects a hung training step (stuck collective / dead peer).
+
+    `beat()` after every completed step; if no beat arrives within
+    `timeout_s`, `on_timeout` fires from the watchdog thread (default:
+    log CRITICAL and hard-exit so the supervisor restarts the process —
+    fail-fast, matching how TPU pod schedulers manage lifecycles).
+    """
+
+    def __init__(
+        self,
+        timeout_s: float,
+        on_timeout: Optional[Callable[[float], None]] = None,
+        first_beat_grace: float = 10.0,
+    ):
+        self.timeout_s = timeout_s
+        # until the first beat, the run is (re)compiling — XLA compile of a
+        # large sharded program routinely dwarfs a step, so the first
+        # window gets `first_beat_grace` x the step timeout
+        self.first_beat_grace = first_beat_grace
+        self._on_timeout = on_timeout or self._default_timeout
+        self._last = time.monotonic()
+        self._beaten = False
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @staticmethod
+    def _default_timeout(stalled_s: float) -> None:
+        import os
+
+        log.critical(
+            "watchdog: no step completed in %.0fs — assuming hung "
+            "collective or dead peer; exiting for supervisor restart",
+            stalled_s,
+        )
+        os._exit(42)
+
+    def start(self) -> "StepWatchdog":
+        self._last = time.monotonic()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        return self
+
+    def beat(self) -> None:
+        self._last = time.monotonic()
+        self._beaten = True
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def __enter__(self) -> "StepWatchdog":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def _run(self) -> None:
+        poll = min(1.0, self.timeout_s / 4)
+        while not self._stop.wait(poll):
+            stalled = time.monotonic() - self._last
+            limit = self.timeout_s if self._beaten else (
+                self.timeout_s * self.first_beat_grace
+            )
+            if stalled > limit:
+                self._on_timeout(stalled)
+                return
+
+
+def assert_in_sync(fingerprint: int, *, what: str = "step") -> None:
+    """Raise if `fingerprint` differs across processes (driver-loop drift).
+
+    All processes must call this at the same point — it is itself a
+    collective (process_allgather). No-op with a single process.
+    """
+    if jax.process_count() == 1:
+        return
+    from jax.experimental import multihost_utils
+    import numpy as np
+
+    all_vals = np.asarray(
+        multihost_utils.process_allgather(np.int64(fingerprint))
+    ).reshape(-1)
+    if not (all_vals == all_vals[0]).all():
+        raise RuntimeError(
+            f"hosts out of sync on {what}: process {jax.process_index()} "
+            f"sees {fingerprint}, fleet sees {all_vals.tolist()}"
+        )
+
+
+def run_with_restarts(
+    make_trainer: Callable[[bool], "object"],
+    *,
+    max_restarts: int = 0,
+    restart_delay_s: float = 0.0,
+):
+    """Run `trainer.fit()` with checkpoint-based recovery.
+
+    make_trainer(resume) builds a fresh trainer; on a failed attempt the
+    next one is built with resume=True so it restores the last checkpoint
+    (requires a checkpoint_dir for recovery to actually shorten rework).
+    Returns fit()'s summary. Re-raises after max_restarts failures.
+    """
+    attempt = 0
+    while True:
+        try:
+            trainer = make_trainer(attempt > 0)
+            return trainer.fit()
+        except KeyboardInterrupt:
+            raise
+        except Exception as e:  # noqa: BLE001 — any failure is restartable
+            attempt += 1
+            if attempt > max_restarts:
+                raise
+            log.error(
+                "training attempt %d failed (%s: %s); restarting from last "
+                "checkpoint (%d/%d)",
+                attempt, type(e).__name__, e, attempt, max_restarts,
+            )
+            if restart_delay_s:
+                time.sleep(restart_delay_s)

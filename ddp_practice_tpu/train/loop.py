@@ -161,6 +161,7 @@ class Trainer:
         # serialize step dispatch; on TPU, keep async dispatch (collectives
         # ride ICI and overlap is the point).
         self._serialize_steps = jax.default_backend() == "cpu"
+        self._watchdog = None
 
     # ------------------------------------------------------------------ #
 
@@ -173,24 +174,64 @@ class Trainer:
         last_metrics = {}
         t0 = time.perf_counter()
         images_this_epoch = 0
-        for i, batch in enumerate(it):
-            if cfg.max_steps_per_epoch and i >= cfg.max_steps_per_epoch:
-                break
-            with step_annotation(int(self.state.step)):
-                self.state, metrics = self.train_step(self.state, batch)
-            if self._serialize_steps:
-                jax.block_until_ready(metrics)
-            images_this_epoch += self.global_batch
-            if cfg.log_every_steps and (i + 1) % cfg.log_every_steps == 0:
-                last_metrics = jax.device_get(metrics)
-                if dist.is_main_process():
-                    log.info(
-                        "epoch %d step %d loss %.4f acc %.3f",
-                        epoch, i + 1,
-                        float(last_metrics["loss"]),
-                        float(last_metrics["accuracy"]),
+        # profile a steady-state window (post-compile) of the first epoch,
+        # shrunk to fit short (smoke) epochs
+        profile_window = None
+        if cfg.profile_dir and epoch == 0:
+            n = self.train_loader.steps_per_epoch
+            if cfg.max_steps_per_epoch:
+                n = min(n, cfg.max_steps_per_epoch)
+            start = min(10, max(0, n - 10))
+            stop = min(start + 10, n)
+            if stop > start:
+                profile_window = (start, stop)
+            elif dist.is_main_process():
+                log.warning(
+                    "profile_dir set but epoch has %d steps — skipping trace", n
+                )
+        profiling = False
+        try:
+            for i, batch in enumerate(it):
+                if cfg.max_steps_per_epoch and i >= cfg.max_steps_per_epoch:
+                    break
+                if profile_window and i == profile_window[0]:
+                    jax.profiler.start_trace(cfg.profile_dir)
+                    profiling = True
+                if profiling and i == profile_window[1]:
+                    jax.block_until_ready(self.state.params)
+                    jax.profiler.stop_trace()
+                    profiling = False
+                with step_annotation(int(self.state.step)):
+                    self.state, metrics = self.train_step(self.state, batch)
+                if self._serialize_steps:
+                    jax.block_until_ready(metrics)
+                if self._watchdog is not None:
+                    self._watchdog.beat()
+                if cfg.sync_check_every_steps and (
+                    (i + 1) % cfg.sync_check_every_steps == 0
+                ):
+                    from ddp_practice_tpu.train.elastic import assert_in_sync
+
+                    # host-side counter, NOT device state: detects driver-loop
+                    # drift (skewed data exhaustion, missed batches) — SURVEY §5.2
+                    assert_in_sync(
+                        epoch * self.train_loader.steps_per_epoch + i,
+                        what="driver step",
                     )
-        jax.block_until_ready(self.state.params)
+                images_this_epoch += self.global_batch
+                if cfg.log_every_steps and (i + 1) % cfg.log_every_steps == 0:
+                    last_metrics = jax.device_get(metrics)
+                    if dist.is_main_process():
+                        log.info(
+                            "epoch %d step %d loss %.4f acc %.3f",
+                            epoch, i + 1,
+                            float(last_metrics["loss"]),
+                            float(last_metrics["accuracy"]),
+                        )
+            jax.block_until_ready(self.state.params)
+        finally:
+            if profiling:  # short epoch or mid-window failure: close trace
+                jax.profiler.stop_trace()
         dt = time.perf_counter() - t0
         self._train_images += images_this_epoch
         self._train_seconds += dt
@@ -208,11 +249,15 @@ class Trainer:
             c, t = self.eval_step(self.state, batch)
             if self._serialize_steps:
                 jax.block_until_ready(c)
+            if self._watchdog is not None:
+                self._watchdog.beat()
             correct = correct + c
             total = total + t
         return float(correct) / max(float(total), 1.0)
 
     def save(self) -> None:
+        if self._watchdog is not None:
+            self._watchdog.beat()  # checkpoint IO is progress, not a hang
         if self.config.checkpoint_dir:
             ckpt.save(
                 self.config.checkpoint_dir,
@@ -226,9 +271,33 @@ class Trainer:
 
     def fit(self) -> dict:
         cfg = self.config
+        if cfg.watchdog_timeout_s:
+            from ddp_practice_tpu.train.elastic import StepWatchdog
+
+            self._watchdog = StepWatchdog(cfg.watchdog_timeout_s).start()
+        try:
+            return self._fit_inner()
+        finally:
+            if self._watchdog is not None:
+                self._watchdog.stop()
+                self._watchdog = None
+
+    def _fit_inner(self) -> dict:
+        cfg = self.config
         t_start = time.perf_counter()
         accuracy: Optional[float] = None
-        for epoch in range(cfg.epochs):
+        # after a checkpoint restore, continue from the epoch the restored
+        # step count falls in — lost work is bounded by one checkpoint
+        # interval, not replayed from epoch 0
+        steps_per_epoch = self.train_loader.steps_per_epoch
+        if cfg.max_steps_per_epoch:
+            steps_per_epoch = min(steps_per_epoch, cfg.max_steps_per_epoch)
+        start_epoch = min(int(self.state.step) // max(steps_per_epoch, 1),
+                          cfg.epochs)
+        if start_epoch and dist.is_main_process():
+            log.info("resuming at epoch %d (step %d)",
+                     start_epoch, int(self.state.step))
+        for epoch in range(start_epoch, cfg.epochs):
             if dist.is_main_process():
                 log.info("=== epoch %d / %d ===", epoch + 1, cfg.epochs)
             self.train_epoch(epoch)
@@ -263,4 +332,15 @@ class Trainer:
 
 
 def fit(config: TrainConfig) -> dict:
+    """Train once, or with checkpoint-based elastic restarts when
+    max_restarts > 0 (recovery is effective with a checkpoint_dir set)."""
+    if config.max_restarts > 0:
+        from ddp_practice_tpu.train.elastic import run_with_restarts
+
+        return run_with_restarts(
+            lambda resume: Trainer(
+                config.replace(resume=config.resume or resume)
+            ),
+            max_restarts=config.max_restarts,
+        )
     return Trainer(config).fit()

@@ -81,6 +81,15 @@ def test_dispatch_via_impl_flag():
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5)
 
 
+def test_causal_rejects_longer_queries():
+    """seq_q > seq_k causal has no sound bottom-right alignment: reject."""
+    rng = np.random.default_rng(7)
+    q = jnp.asarray(rng.normal(size=(1, 256, 2, 64)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(1, 128, 2, 64)), jnp.float32)
+    with pytest.raises(ValueError, match="seq_q <= seq_k"):
+        flash_attention(q, k, k, causal=True)
+
+
 def test_indivisible_seq_rejected():
     q, k, v = _qkv(s=100, seed=5)
     with pytest.raises(ValueError):
