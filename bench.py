@@ -29,49 +29,69 @@ def main(argv=None) -> int:
     p.add_argument("--precision", default="bf16", choices=["fp32", "bf16"])
     p.add_argument("--model", default="convnet")
     p.add_argument("--dataset", default="mnist")
-    p.add_argument("--warmup", type=int, default=20)
-    p.add_argument("--steps", type=int, default=200)
+    p.add_argument("--warmup", type=int, default=64)
+    p.add_argument("--steps", type=int, default=640)
+    p.add_argument("--steps_per_call", type=int, default=32,
+                   help="K optimizer steps per jitted call (1 = off)")
     args = p.parse_args(argv)
 
     import jax
 
     from ddp_practice_tpu.config import MeshConfig, TrainConfig
-    from ddp_practice_tpu.data.loader import prefetch_to_device
+    from ddp_practice_tpu.data.loader import prefetch_chunked, prefetch_to_device
     from ddp_practice_tpu.train.loop import Trainer
 
+    k = max(1, args.steps_per_call)
     cfg = TrainConfig(
         model=args.model,
         dataset=args.dataset,
         batch_size=args.batch_size,
         precision=args.precision,
         log_every_steps=0,
+        steps_per_call=k,
         mesh=MeshConfig(data=-1),
     )
     trainer = Trainer(cfg)
     n_chips = jax.device_count()
 
     def batches():
+        """Endless stream of device batches: stacked chunks when k > 1."""
         epoch = 0
         while True:
             trainer.train_loader.set_epoch(epoch)
-            yield from prefetch_to_device(
-                iter(trainer.train_loader), trainer.batch_shardings, size=2
-            )
+            if k > 1:
+                it = prefetch_chunked(
+                    iter(trainer.train_loader), k,
+                    trainer.batch_shardings, trainer.stacked_shardings, size=2,
+                )
+                for tag, b in it:
+                    if tag == "chunk":  # drop the sub-k epoch tail
+                        yield b
+            else:
+                yield from prefetch_to_device(
+                    iter(trainer.train_loader), trainer.batch_shardings, size=2
+                )
             epoch += 1
 
+    step_fn = trainer.chunk_step if k > 1 else trainer.train_step
+    n_calls = -(-args.steps // k)
+
     it = batches()
-    state = trainer.state
-    for _ in range(args.warmup):
-        state, metrics = trainer.train_step(state, next(it))
-    jax.block_until_ready(state.params)
+    try:
+        state = trainer.state
+        for _ in range(max(args.warmup // k, 2)):
+            state, metrics = step_fn(state, next(it))
+        jax.block_until_ready(state.params)
 
-    t0 = time.perf_counter()
-    for _ in range(args.steps):
-        state, metrics = trainer.train_step(state, next(it))
-    jax.block_until_ready(state.params)
-    dt = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for _ in range(n_calls):
+            state, metrics = step_fn(state, next(it))
+        jax.block_until_ready(state.params)
+        dt = time.perf_counter() - t0
+    finally:
+        it.close()  # stop the prefetch producer thread before interpreter exit
 
-    ips = args.steps * trainer.global_batch / dt
+    ips = n_calls * k * trainer.global_batch / dt
     ips_per_chip = ips / n_chips
     print(
         json.dumps(

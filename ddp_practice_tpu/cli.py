@@ -85,6 +85,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--log_every", type=int, default=100)
     p.add_argument("--profile_dir", default=None)
     p.add_argument("--loader", default="auto", choices=["auto", "native", "python"])
+    p.add_argument("--steps_per_call", type=int, default=1,
+                   help="K optimizer steps per jitted call (amortizes host "
+                        "dispatch + H2D for small models)")
     p.add_argument("--json", action="store_true", help="print summary as JSON")
     return p
 
@@ -125,6 +128,7 @@ def config_from_args(args) -> TrainConfig:
         log_every_steps=args.log_every,
         profile_dir=args.profile_dir,
         loader_backend=args.loader,
+        steps_per_call=args.steps_per_call,
     )
 
 

@@ -185,6 +185,9 @@ class TrainConfig:
     # input pipeline
     loader_backend: str = "auto"       # auto | native | python
     prefetch: int = 2
+    # K optimizer steps per jitted call (lax.scan over stacked batches);
+    # amortizes host dispatch + H2D latency for small models. 1 = off.
+    steps_per_call: int = 1
     shuffle_eval: bool = False  # the reference baseline shuffles eval; don't (SURVEY §2.5)
 
     def precision_policy(self) -> PrecisionPolicy:

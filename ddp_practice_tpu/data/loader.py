@@ -14,7 +14,6 @@ path is the always-available fallback.
 
 from __future__ import annotations
 
-import collections
 from typing import Iterator, Optional
 
 import numpy as np
@@ -98,27 +97,117 @@ def _make_gather(backend: str, dataset: Dataset):
     return lambda idx: (dataset.images[idx], dataset.labels[idx])
 
 
+def _to_global(batch, sharding):
+    """Host batch dict -> globally sharded jax.Arrays.
+
+    Single-process: `jax.device_put` with the NamedSharding (measured ~3.6x
+    cheaper than make_array_from_process_local_data for small batches).
+    Multi-process: each host contributes its local shard via
+    make_array_from_process_local_data.
+    """
+    import jax
+
+    single = jax.process_count() == 1
+    out = {}
+    for k, v in batch.items():
+        sh = sharding[k] if isinstance(sharding, dict) else sharding
+        arr = np.asarray(v)
+        if single:
+            out[k] = jax.device_put(arr, sh)
+        else:
+            out[k] = jax.make_array_from_process_local_data(sh, arr)
+    return out
+
+
+def _threaded_prefetch(host_iterator, to_device, *, size: int):
+    """Overlap host-side batch assembly with device execution: a producer
+    thread fills a bounded queue with HOST batches; the consumer (main)
+    thread issues the device transfer — JAX's async dispatch then overlaps
+    the H2D with in-flight steps (the pin_memory role of the reference,
+    origin_main.py:96,60-61). Device APIs are only touched from the main
+    thread: backend clients are not guaranteed thread-safe against
+    concurrent execution dispatch.
+    """
+    import queue as queue_mod
+    import threading
+
+    q: "queue_mod.Queue" = queue_mod.Queue(maxsize=max(size, 1))
+    stop = threading.Event()
+    errors = []
+    _DONE = object()
+
+    def producer():
+        try:
+            for item in host_iterator:
+                while not stop.is_set():
+                    try:
+                        q.put(item, timeout=0.1)
+                        break
+                    except queue_mod.Full:
+                        continue
+                if stop.is_set():
+                    return
+        except BaseException as e:  # surfaced on the consumer side
+            errors.append(e)
+        finally:
+            while not stop.is_set():
+                try:
+                    q.put(_DONE, timeout=0.1)
+                    break
+                except queue_mod.Full:
+                    continue
+
+    thread = threading.Thread(target=producer, daemon=True, name="prefetch")
+    thread.start()
+    try:
+        while True:
+            item = q.get()
+            if item is _DONE:
+                if errors:
+                    raise errors[0]
+                return
+            yield to_device(item)
+    finally:
+        stop.set()
+
+
 def prefetch_to_device(iterator, sharding, *, size: int = 2):
     """Form globally sharded jax.Arrays from local batches, keeping `size`
-    batches in flight — the TPU analogue of pin_memory+async H2D
-    (origin_main.py:96,60-61).
+    batches in flight on a background thread.
 
     `sharding` maps batch keys to `jax.sharding.NamedSharding`s (a single
     sharding is broadcast to all keys).
     """
-    import jax
+    yield from _threaded_prefetch(
+        iterator, lambda b: _to_global(b, sharding), size=size
+    )
 
-    def to_global(batch):
-        out = {}
-        for k, v in batch.items():
-            sh = sharding[k] if isinstance(sharding, dict) else sharding
-            out[k] = jax.make_array_from_process_local_data(sh, np.asarray(v))
-        return out
 
-    queue = collections.deque()
-    for batch in iterator:
-        queue.append(to_global(batch))
-        if len(queue) > size:
-            yield queue.popleft()
-    while queue:
-        yield queue.popleft()
+def prefetch_chunked(iterator, num_steps, batch_sharding, stacked_sharding,
+                     *, size: int = 2):
+    """Prefetch for K-steps-per-call training (`make_chunked_train_step`):
+    groups of `num_steps` host batches are np.stack-ed and transferred as
+    ONE (K, batch, ...) array — one H2D per K steps. The epoch tail that
+    doesn't fill a group is yielded as single batches.
+
+    Yields ("chunk", stacked_device_batch) and ("single", device_batch).
+    """
+
+    def host_iter():
+        buf = []
+        for b in iterator:
+            buf.append(b)
+            if len(buf) == num_steps:
+                yield ("chunk", {
+                    k: np.stack([x[k] for x in buf]) for k in buf[0]
+                })
+                buf = []
+        for b in buf:
+            yield ("single", b)
+
+    def to_device(item):
+        tag, batch = item
+        sh = stacked_sharding if tag == "chunk" else batch_sharding
+        return (tag, _to_global(batch, sh))
+
+    yield from _threaded_prefetch(host_iter(), to_device, size=size)

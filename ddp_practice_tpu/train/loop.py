@@ -138,6 +138,23 @@ class Trainer:
             state_shardings=self.state_shardings,
             batch_shardings=self.batch_shardings,
         )
+        self.chunk_step = None
+        if config.steps_per_call > 1:
+            from ddp_practice_tpu.train.steps import (
+                make_chunked_train_step,
+                stack_shardings,
+            )
+
+            self.stacked_shardings = stack_shardings(self.batch_shardings)
+            self.chunk_step = make_chunked_train_step(
+                self.model,
+                self.tx,
+                num_steps=config.steps_per_call,
+                label_smoothing=config.label_smoothing,
+                mesh=self.mesh,
+                state_shardings=self.state_shardings,
+                batch_shardings=self.batch_shardings,
+            )
         self.eval_step = make_eval_step(
             self.model,
             mesh=self.mesh,
@@ -168,9 +185,22 @@ class Trainer:
     def train_epoch(self, epoch: int) -> dict:
         cfg = self.config
         self.train_loader.set_epoch(epoch)  # ≡ sampler.set_epoch (ddp_main.py:160)
-        it = prefetch_to_device(
-            iter(self.train_loader), self.batch_shardings, size=cfg.prefetch
-        )
+        k = max(1, cfg.steps_per_call if self.chunk_step is not None else 1)
+        if k > 1:
+            from ddp_practice_tpu.data.loader import prefetch_chunked
+
+            items = prefetch_chunked(
+                iter(self.train_loader), k,
+                self.batch_shardings, self.stacked_shardings,
+                size=cfg.prefetch,
+            )
+        else:
+            items = (
+                ("single", b) for b in prefetch_to_device(
+                    iter(self.train_loader), self.batch_shardings,
+                    size=cfg.prefetch,
+                )
+            )
         last_metrics = {}
         t0 = time.perf_counter()
         images_this_epoch = 0
@@ -190,46 +220,74 @@ class Trainer:
                     "profile_dir set but epoch has %d steps — skipping trace", n
                 )
         profiling = False
+        steps_done = 0
         try:
-            for i, batch in enumerate(it):
-                if cfg.max_steps_per_epoch and i >= cfg.max_steps_per_epoch:
+            for tag, batch in items:
+                if cfg.max_steps_per_epoch and steps_done >= cfg.max_steps_per_epoch:
                     break
-                if profile_window and i == profile_window[0]:
-                    jax.profiler.start_trace(cfg.profile_dir)
-                    profiling = True
-                if profiling and i == profile_window[1]:
+                if profiling and steps_done >= profile_window[1]:
                     jax.block_until_ready(self.state.params)
                     jax.profiler.stop_trace()
                     profiling = False
+                    profile_window = None
+                # start once anywhere past the window start (chunked runs
+                # only visit multiples of k, which may skip the window)
+                if profile_window and not profiling and (
+                    steps_done >= profile_window[0]
+                ):
+                    jax.profiler.start_trace(cfg.profile_dir)
+                    profiling = True
                 with step_annotation(int(self.state.step)):
-                    self.state, metrics = self.train_step(self.state, batch)
+                    remaining = (
+                        cfg.max_steps_per_epoch - steps_done
+                        if cfg.max_steps_per_epoch else None
+                    )
+                    if tag == "chunk" and (remaining is None or remaining >= k):
+                        self.state, metrics = self.chunk_step(self.state, batch)
+                        inc = k
+                    elif tag == "chunk":
+                        # step cap mid-chunk: run the tail as single steps so
+                        # the cap (and the resume-epoch math) stays exact
+                        for j in range(remaining):
+                            sub = jax.tree.map(lambda v: v[j], batch)
+                            self.state, metrics = self.train_step(self.state, sub)
+                        inc = remaining
+                    else:
+                        self.state, metrics = self.train_step(self.state, batch)
+                        inc = 1
                 if self._serialize_steps:
                     jax.block_until_ready(metrics)
                 if self._watchdog is not None:
                     self._watchdog.beat()
+                prev = steps_done
+                steps_done += inc
                 if cfg.sync_check_every_steps and (
-                    (i + 1) % cfg.sync_check_every_steps == 0
+                    prev // cfg.sync_check_every_steps
+                    != steps_done // cfg.sync_check_every_steps
                 ):
                     from ddp_practice_tpu.train.elastic import assert_in_sync
 
                     # host-side counter, NOT device state: detects driver-loop
                     # drift (skewed data exhaustion, missed batches) — SURVEY §5.2
                     assert_in_sync(
-                        epoch * self.train_loader.steps_per_epoch + i,
+                        epoch * self.train_loader.steps_per_epoch + steps_done,
                         what="driver step",
                     )
-                images_this_epoch += self.global_batch
-                if cfg.log_every_steps and (i + 1) % cfg.log_every_steps == 0:
+                images_this_epoch += self.global_batch * inc
+                if cfg.log_every_steps and (
+                    prev // cfg.log_every_steps != steps_done // cfg.log_every_steps
+                ):
                     last_metrics = jax.device_get(metrics)
                     if dist.is_main_process():
                         log.info(
                             "epoch %d step %d loss %.4f acc %.3f",
-                            epoch, i + 1,
+                            epoch, steps_done,
                             float(last_metrics["loss"]),
                             float(last_metrics["accuracy"]),
                         )
             jax.block_until_ready(self.state.params)
         finally:
+            items.close()  # stop the prefetch producer thread promptly
             if profiling:  # short epoch or mid-window failure: close trace
                 jax.profiler.stop_trace()
         dt = time.perf_counter() - t0
@@ -245,14 +303,17 @@ class Trainer:
         )
         correct = jnp.zeros((), jnp.float32)
         total = jnp.zeros((), jnp.float32)
-        for batch in it:
-            c, t = self.eval_step(self.state, batch)
-            if self._serialize_steps:
-                jax.block_until_ready(c)
-            if self._watchdog is not None:
-                self._watchdog.beat()
-            correct = correct + c
-            total = total + t
+        try:
+            for batch in it:
+                c, t = self.eval_step(self.state, batch)
+                if self._serialize_steps:
+                    jax.block_until_ready(c)
+                if self._watchdog is not None:
+                    self._watchdog.beat()
+                correct = correct + c
+                total = total + t
+        finally:
+            it.close()  # stop the prefetch producer thread promptly
         return float(correct) / max(float(total), 1.0)
 
     def save(self) -> None:

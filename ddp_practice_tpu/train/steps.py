@@ -26,20 +26,9 @@ from ddp_practice_tpu.ops.losses import accuracy_counts, cross_entropy
 from ddp_practice_tpu.train.state import TrainState
 
 
-def make_train_step(
-    model,
-    tx,
-    *,
-    label_smoothing: float = 0.0,
-    mesh=None,
-    state_shardings=None,
-    batch_shardings=None,
-):
-    """Build the jitted train step.
-
-    When mesh/shardings are given, they pin input/output layouts (GSPMD);
-    the state buffer is donated so parameters update in place in HBM.
-    """
+def _train_step_fn(model, tx, label_smoothing: float):
+    """The pure (state, batch) -> (state, metrics) function both the
+    per-step and the scan-chunked factories jit."""
 
     def train_step(state: TrainState, batch):
         has_bn = state.batch_stats is not None
@@ -89,6 +78,24 @@ def make_train_step(
         )
         return new_state, metrics
 
+    return train_step
+
+
+def make_train_step(
+    model,
+    tx,
+    *,
+    label_smoothing: float = 0.0,
+    mesh=None,
+    state_shardings=None,
+    batch_shardings=None,
+):
+    """Build the jitted train step.
+
+    When mesh/shardings are given, they pin input/output layouts (GSPMD);
+    the state buffer is donated so parameters update in place in HBM.
+    """
+    train_step = _train_step_fn(model, tx, label_smoothing)
     if mesh is not None and state_shardings is not None:
         from ddp_practice_tpu.parallel.mesh import replicated
 
@@ -100,6 +107,61 @@ def make_train_step(
             donate_argnums=0,
         )
     return jax.jit(train_step, donate_argnums=0)
+
+
+def stack_shardings(batch_shardings):
+    """Sharding for (num_steps, batch, ...) stacked batches: leading scan
+    dim replicated, inner dims as the per-batch shardings. Single source of
+    truth for make_chunked_train_step, the Trainer, and prefetch_chunked
+    callers — the jit in_shardings and the device_put layout must agree or
+    every chunk pays a reshard."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def one(sh):
+        return NamedSharding(sh.mesh, P(None, *sh.spec))
+
+    return jax.tree.map(
+        one, batch_shardings, is_leaf=lambda x: isinstance(x, NamedSharding)
+    )
+
+
+def make_chunked_train_step(
+    model,
+    tx,
+    *,
+    num_steps: int,
+    label_smoothing: float = 0.0,
+    mesh=None,
+    state_shardings=None,
+    batch_shardings=None,
+):
+    """Build a jitted K-steps-per-call train step: `lax.scan` over batches
+    stacked on a leading (num_steps, ...) dim.
+
+    For small models the per-step cost is host dispatch + H2D latency, not
+    device compute (the reference pays the same per-step H2D, pinned-memory
+    copies at origin_main.py:60-61); scanning K optimizer steps inside one
+    XLA program amortizes both by K. Identical math to K calls of
+    make_train_step. Returned metrics are the final step's.
+    """
+    step_fn = _train_step_fn(model, tx, label_smoothing)
+
+    def chunk_step(state, batches):
+        state, ms = jax.lax.scan(step_fn, state, batches)
+        return state, jax.tree.map(lambda v: v[-1], ms)
+
+    if mesh is not None and state_shardings is not None:
+        from ddp_practice_tpu.parallel.mesh import replicated
+
+        rep = replicated(mesh)
+        stacked = stack_shardings(batch_shardings)
+        return jax.jit(
+            chunk_step,
+            in_shardings=(state_shardings, stacked),
+            out_shardings=(state_shardings, rep),
+            donate_argnums=0,
+        )
+    return jax.jit(chunk_step, donate_argnums=0)
 
 
 def make_eval_step(model, *, mesh=None, state_shardings=None, batch_shardings=None):
