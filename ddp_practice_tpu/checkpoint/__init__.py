@@ -1,23 +1,42 @@
-"""Checkpointing: save AND restore (the reference only saves).
+"""Checkpointing: crash-safe save AND restore (the reference only saves).
 
 Reference contract: rank-0-only `torch.save({"model": ..., "scaler": ...})`
 once at end of training (origin_main.py:113, ddp_main.py:165-169); no load
-path exists (SURVEY §2.5). Here: process-0 writes the full train-state
-pytree plus a manifest carrying step count and the precision-policy name
-(the slot where the reference kept GradScaler state — with bf16 there is no
-scaler, but the schema keeps the field for continuity), and `restore`
-rebuilds a sharded state on any mesh.
+path exists (SURVEY §2.5). Here: the full train-state pytree plus a
+manifest carrying step count and the precision-policy name (the slot where
+the reference kept GradScaler state — with bf16 there is no scaler, but the
+schema keeps the field for continuity), and `restore` rebuilds a sharded
+state on any mesh.
+
+Crash safety (the load-bearing property for train/elastic.py — a torn save
+at exactly the moment recovery matters would otherwise destroy the only
+good checkpoint):
+
+- each save goes to `<dir>/step_<N>/`, written first into a `tmp.` prefix
+  and atomically `os.rename`d into place (manifest.json is written last
+  inside the temp dir, so a complete `step_*/manifest.json` implies a
+  complete checkpoint);
+- previous checkpoints are retained (`keep_last`, default 3) and pruned
+  oldest-first only after the new one is complete;
+- `restore` picks the newest *complete* step dir, ignoring temp debris.
+
+Multi-host: gathering is collective — EVERY process calls save(); leaves
+whose shards span hosts (FSDP/TP state) are all-gathered to host memory
+via multihost_utils, then only process 0 writes, and all processes
+barrier before returning so a restart can't read a half-written dir.
 
 Format: one .npz of flattened leaves keyed by pytree path + manifest.json.
-Self-contained (no orbax API surface), multi-host-safe: only process 0
-writes; every process reads.
+Self-contained (no orbax API surface). The single-file layout of early
+development (leaves.npz directly in `directory`) still restores.
 """
 
 from __future__ import annotations
 
 import json
 import os
-from typing import Any, Optional
+import re
+import shutil
+from typing import Any, List, Optional
 
 import jax
 import numpy as np
@@ -25,36 +44,137 @@ from jax.tree_util import keystr, tree_flatten_with_path, tree_unflatten
 
 _LEAVES = "leaves.npz"
 _MANIFEST = "manifest.json"
+_STEP_RE = re.compile(r"^step_(\d+)$")
+_SCHEMA_VERSION = 2
 
 
-def save(directory: str, state: Any, *, extra: Optional[dict] = None) -> None:
-    """Write state on process 0 (the rank-0 gate of ddp_main.py:165-169)."""
-    if jax.process_index() != 0:
-        return
-    os.makedirs(directory, exist_ok=True)
-    paths_and_leaves, treedef = tree_flatten_with_path(state)
+def _leaf_to_host(leaf) -> np.ndarray:
+    """Bring a (possibly multi-host-sharded) leaf to host memory.
+
+    With FSDP/TP rules, params and optimizer state shard across processes;
+    `device_get` alone raises on non-addressable shards, so those leaves
+    are all-gathered first (a collective — all processes participate)."""
+    if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+        from jax.experimental import multihost_utils
+
+        leaf = multihost_utils.process_allgather(leaf, tiled=True)
+    return np.asarray(jax.device_get(leaf))
+
+
+def _complete_steps(directory: str) -> List[int]:
+    """Step numbers of complete checkpoints, ascending."""
+    if not os.path.isdir(directory):
+        return []
+    steps = []
+    for name in os.listdir(directory):
+        m = _STEP_RE.match(name)
+        if m and os.path.exists(os.path.join(directory, name, _MANIFEST)):
+            steps.append(int(m.group(1)))
+    return sorted(steps)
+
+
+def _resolve(directory: str) -> Optional[str]:
+    """Directory actually holding leaves.npz/manifest.json, or None.
+
+    step_N dirs win over a legacy root-level checkpoint: any step_N was
+    written after the legacy file (this writer only produces step dirs),
+    so preferring legacy would silently resume pre-upgrade state."""
+    steps = _complete_steps(directory)
+    if steps:
+        return os.path.join(directory, f"step_{steps[-1]}")
+    if os.path.exists(os.path.join(directory, _MANIFEST)) and os.path.exists(
+        os.path.join(directory, _LEAVES)
+    ):
+        return directory  # legacy single-checkpoint layout
+    return None
+
+
+def save(
+    directory: str,
+    state: Any,
+    *,
+    extra: Optional[dict] = None,
+    step: Optional[int] = None,
+    keep_last: int = 3,
+) -> str:
+    """Write a new checkpoint under `directory` (crash-safe, retained).
+
+    ALL processes must call this (leaf gathering is collective); only
+    process 0 touches the filesystem (the rank-0 gate of
+    ddp_main.py:165-169). Returns the final checkpoint path.
+    """
+    extra = dict(extra or {})
+    if step is None:
+        step = int(extra.get("step", 0))
+    extra.setdefault("step", step)
+
+    paths_and_leaves, _ = tree_flatten_with_path(state)
     arrays = {}
     names = []
     for i, (path, leaf) in enumerate(paths_and_leaves):
-        name = f"leaf_{i}"
         names.append(keystr(path))
-        arrays[name] = np.asarray(jax.device_get(leaf))
-    np.savez(os.path.join(directory, _LEAVES), **arrays)
-    manifest = {"paths": names, "extra": extra or {}}
-    with open(os.path.join(directory, _MANIFEST), "w") as f:
-        json.dump(manifest, f, indent=2)
+        arrays[f"leaf_{i}"] = _leaf_to_host(leaf)
+
+    final = os.path.join(directory, f"step_{step}")
+    if jax.process_index() == 0:
+        os.makedirs(directory, exist_ok=True)
+        tmp = os.path.join(directory, f"tmp.step_{step}.{os.getpid()}")
+        if os.path.isdir(tmp):
+            shutil.rmtree(tmp)
+        os.makedirs(tmp)
+        np.savez(os.path.join(tmp, _LEAVES), **arrays)
+        manifest = {
+            "schema_version": _SCHEMA_VERSION,
+            "paths": names,
+            "extra": extra,
+        }
+        # manifest last: its presence marks the checkpoint complete
+        with open(os.path.join(tmp, _MANIFEST), "w") as f:
+            json.dump(manifest, f, indent=2)
+        if os.path.isdir(final):
+            # re-save at the same step (e.g. the end-of-fit save landing on
+            # the last periodic save's step): move the old dir aside before
+            # the swap so no crash instant leaves step_N deleted with the
+            # replacement still under an ignored tmp. name
+            old = f"{final}.old.{os.getpid()}"
+            os.rename(final, old)
+            os.rename(tmp, final)  # atomic on POSIX (same filesystem)
+            shutil.rmtree(old, ignore_errors=True)
+        else:
+            os.rename(tmp, final)  # atomic on POSIX (same filesystem)
+        # prune only after the new checkpoint is durable
+        steps = _complete_steps(directory)
+        for old in steps[:-keep_last] if keep_last > 0 else []:
+            shutil.rmtree(
+                os.path.join(directory, f"step_{old}"), ignore_errors=True
+            )
+        # sweep stale debris from crashed earlier saves
+        for name in os.listdir(directory):
+            if name.startswith("tmp.step_") or ".old." in name:
+                shutil.rmtree(os.path.join(directory, name), ignore_errors=True)
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        # no process may return (and possibly restart+restore) before the
+        # checkpoint is fully on disk
+        multihost_utils.sync_global_devices(f"ckpt_save_{step}")
+    return final
 
 
 def restore(directory: str, target: Any, *, shardings: Any = None) -> Any:
-    """Rebuild `target`-structured state from a checkpoint.
+    """Rebuild `target`-structured state from the newest complete checkpoint.
 
-    Leaves are matched by position with path-string verification. With
-    `shardings` (a matching pytree of NamedSharding), leaves are placed
-    sharded — so a checkpoint written on one mesh restores onto another
-    (e.g. single-chip -> v4-8).
+    Leaves are matched by position with path-string verification (parameter
+    renames across framework versions are rejected loudly, not silently
+    misassigned). With `shardings` (a matching pytree of NamedSharding),
+    leaves are placed sharded — so a checkpoint written on one mesh
+    restores onto another (e.g. single-chip -> v4-8).
     """
-    data = np.load(os.path.join(directory, _LEAVES))
-    with open(os.path.join(directory, _MANIFEST)) as f:
+    src = _resolve(directory)
+    if src is None:
+        raise FileNotFoundError(f"no complete checkpoint under {directory!r}")
+    data = np.load(os.path.join(src, _LEAVES))
+    with open(os.path.join(src, _MANIFEST)) as f:
         manifest = json.load(f)
     paths_and_leaves, treedef = tree_flatten_with_path(target)
     if len(paths_and_leaves) != len(manifest["paths"]):
@@ -81,14 +201,17 @@ def restore(directory: str, target: Any, *, shardings: Any = None) -> Any:
 
 
 def latest_manifest(directory: str) -> Optional[dict]:
-    path = os.path.join(directory, _MANIFEST)
-    if not os.path.exists(path):
+    src = _resolve(directory)
+    if src is None:
         return None
-    with open(path) as f:
+    with open(os.path.join(src, _MANIFEST)) as f:
         return json.load(f)
 
 
+def all_steps(directory: str) -> List[int]:
+    """Steps of all retained complete checkpoints (ascending)."""
+    return _complete_steps(directory)
+
+
 def exists(directory: str) -> bool:
-    return os.path.exists(os.path.join(directory, _LEAVES)) and os.path.exists(
-        os.path.join(directory, _MANIFEST)
-    )
+    return _resolve(directory) is not None

@@ -55,6 +55,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--expert", type=int, default=1, help="expert-parallel degree")
     p.add_argument("--sp_impl", default="ring", choices=["ring", "ulysses"],
                    help="sequence-parallel attention scheme")
+    p.add_argument("--attn_impl", default="xla", choices=["xla", "flash"],
+                   help="local attention kernel (flash = Pallas tiled)")
     p.add_argument("--microbatches", type=int, default=4,
                    help="GPipe microbatches per step (pipe > 1)")
     p.add_argument("--num_experts", type=int, default=0,
@@ -113,6 +115,7 @@ def config_from_args(args) -> TrainConfig:
         ),
         fsdp=args.fsdp,
         sp_impl=args.sp_impl,
+        attn_impl=args.attn_impl,
         num_microbatches=args.microbatches,
         num_experts=args.num_experts,
         coordinator_address=args.coordinator,

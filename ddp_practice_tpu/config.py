@@ -156,6 +156,9 @@ class TrainConfig:
     fsdp: bool = False
     # sequence-parallel attention scheme when mesh.seq > 1
     sp_impl: str = "ring"              # ring | ulysses
+    # local attention kernel: "xla" (compiler-fused) | "flash" (Pallas tiled
+    # kernel, ops/flash_attention.py) — composes with ring/ulysses
+    attn_impl: str = "xla"
     # GPipe microbatches per step when mesh.pipe > 1
     num_microbatches: int = 4
     # MoE expert count when mesh.expert > 1 (0 = auto: 8 rounded up to a

@@ -14,7 +14,7 @@ from typing import Optional
 from ddp_practice_tpu.config import PrecisionPolicy
 from ddp_practice_tpu.models.convnet import ConvNet
 from ddp_practice_tpu.models.resnet import ResNet, ResNet18, ResNet50
-from ddp_practice_tpu.models.vit import ViT, ViTTiny
+from ddp_practice_tpu.models.vit import ViT, ViTBase, ViTTiny
 from ddp_practice_tpu.models.pipeline_vit import PipelinedViT
 from ddp_practice_tpu.models.vit_moe import ViTMoE
 
@@ -93,6 +93,16 @@ def _vit_tiny(*, num_classes, policy, axis_name, **kw):
     )
 
 
+@register("vit_base")
+def _vit_base(*, num_classes, policy, axis_name, **kw):
+    return ViTBase(
+        num_classes=num_classes,
+        dtype=policy.compute_dtype,
+        param_dtype=policy.param_dtype,
+        **kw,
+    )
+
+
 @register("vit_tiny_moe")
 def _vit_tiny_moe(*, num_classes, policy, axis_name, **kw):
     kw.setdefault("hidden_dim", 192)
@@ -130,6 +140,7 @@ __all__ = [
     "ResNet50",
     "ViT",
     "ViTTiny",
+    "ViTBase",
     "PipelinedViT",
     "ViTMoE",
 ]

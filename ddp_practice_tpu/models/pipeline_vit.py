@@ -47,6 +47,7 @@ class PipelinedViT:
         remat: bool = True,
         seq_axis: Optional[str] = None,  # registry uniformity; SP not composed here
         sp_impl: str = "ring",           # accepted+ignored, like seq_axis
+        attn_impl: str = "xla",
         axis_name: Optional[str] = None,
     ):
         if depth % max(num_stages, 1) != 0:
@@ -63,7 +64,8 @@ class PipelinedViT:
             param_dtype=param_dtype,
         )
         self.block = EncoderBlock(
-            num_heads, mlp_dim, dtype=dtype, param_dtype=param_dtype
+            num_heads, mlp_dim, dtype=dtype, param_dtype=param_dtype,
+            attn_impl=attn_impl,
         )
         self.head = ViTHead(
             num_classes=num_classes, dtype=dtype, param_dtype=param_dtype

@@ -94,6 +94,7 @@ class SelfAttention(nn.Module):
     param_dtype: jnp.dtype = jnp.float32
     seq_axis: Optional[str] = None  # mesh axis for sequence parallelism
     sp_impl: str = "ring"           # "ring" | "ulysses"
+    attn_impl: str = "xla"          # "xla" | "flash" (Pallas kernel)
 
     @nn.compact
     def __call__(self, x):
@@ -108,7 +109,8 @@ class SelfAttention(nn.Module):
         )(x)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         out = dot_product_attention(
-            q, k, v, seq_axis=self.seq_axis, sp_impl=self.sp_impl
+            q, k, v, seq_axis=self.seq_axis, sp_impl=self.sp_impl,
+            impl=self.attn_impl,
         )
         out = nn.DenseGeneral(
             d,
@@ -127,6 +129,7 @@ class EncoderBlock(nn.Module):
     param_dtype: jnp.dtype = jnp.float32
     seq_axis: Optional[str] = None
     sp_impl: str = "ring"
+    attn_impl: str = "xla"
 
     @nn.compact
     def __call__(self, x):
@@ -137,6 +140,7 @@ class EncoderBlock(nn.Module):
             param_dtype=self.param_dtype,
             seq_axis=self.seq_axis,
             sp_impl=self.sp_impl,
+            attn_impl=self.attn_impl,
             name="attn",
         )(y)
         x = x + y
@@ -158,6 +162,7 @@ class ViT(nn.Module):
     param_dtype: jnp.dtype = jnp.float32
     seq_axis: Optional[str] = None
     sp_impl: str = "ring"
+    attn_impl: str = "xla"
     axis_name: Optional[str] = None  # accepted for registry uniformity (no BN)
 
     @nn.compact
@@ -177,6 +182,7 @@ class ViT(nn.Module):
                 param_dtype=self.param_dtype,
                 seq_axis=self.seq_axis,
                 sp_impl=self.sp_impl,
+                attn_impl=self.attn_impl,
                 name=f"block{i}",
             )(x)
         return ViTHead(
@@ -192,4 +198,12 @@ def ViTTiny(**kw):
     kw.setdefault("depth", 12)
     kw.setdefault("num_heads", 3)
     kw.setdefault("mlp_dim", 768)
+    return ViT(**kw)
+
+
+def ViTBase(**kw):
+    kw.setdefault("hidden_dim", 768)
+    kw.setdefault("depth", 12)
+    kw.setdefault("num_heads", 12)
+    kw.setdefault("mlp_dim", 3072)
     return ViT(**kw)

@@ -29,6 +29,7 @@ class MoEEncoderBlock(nn.Module):
     param_dtype: jnp.dtype = jnp.float32
     seq_axis: Optional[str] = None
     sp_impl: str = "ring"
+    attn_impl: str = "xla"
     use_moe: bool = True
 
     @nn.compact
@@ -40,6 +41,7 @@ class MoEEncoderBlock(nn.Module):
             param_dtype=self.param_dtype,
             seq_axis=self.seq_axis,
             sp_impl=self.sp_impl,
+            attn_impl=self.attn_impl,
             name="attn",
         )(y)
         x = x + y
@@ -77,6 +79,7 @@ class ViTMoE(nn.Module):
     param_dtype: jnp.dtype = jnp.float32
     seq_axis: Optional[str] = None
     sp_impl: str = "ring"
+    attn_impl: str = "xla"
     axis_name: Optional[str] = None  # registry uniformity (no BN)
 
     @nn.compact
@@ -99,6 +102,7 @@ class ViTMoE(nn.Module):
                 param_dtype=self.param_dtype,
                 seq_axis=self.seq_axis,
                 sp_impl=self.sp_impl,
+                attn_impl=self.attn_impl,
                 use_moe=(i % self.moe_every == self.moe_every - 1),
                 name=f"block{i}",
             )(x)

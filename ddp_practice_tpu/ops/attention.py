@@ -33,28 +33,30 @@ def dot_product_attention(
     "ring" (K/V rotation, extreme lengths) or "ulysses" (all-to-all head
     scatter, maximally fused local attention). `impl` picks the local
     kernel: "xla" (fused by the XLA compiler) or "flash" (the Pallas
-    tiled online-softmax kernel, ops.flash_attention)."""
+    tiled online-softmax kernel, ops.flash_attention) — and composes with
+    both sequence-parallel schemes (flash runs as the per-block local
+    attention inside ring, and as the full-sequence attention after
+    Ulysses' head scatter)."""
     if impl not in ("xla", "flash"):
         raise ValueError(f"unknown attention impl {impl!r} (want 'xla'|'flash')")
-    if impl == "flash":
-        if seq_axis is not None:
-            raise ValueError(
-                "impl='flash' is not composed with sequence parallelism yet; "
-                "use impl='xla' with sp_impl='ring'|'ulysses'"
-            )
-        from ddp_practice_tpu.ops.flash_attention import flash_attention
-
-        return flash_attention(q, k, v, causal=causal)
     if seq_axis is not None:
         if sp_impl == "ring":
             from ddp_practice_tpu.parallel.ring import ring_attention
 
-            return ring_attention(q, k, v, axis_name=seq_axis, causal=causal)
+            return ring_attention(
+                q, k, v, axis_name=seq_axis, causal=causal, impl=impl
+            )
         if sp_impl == "ulysses":
             from ddp_practice_tpu.parallel.ulysses import ulysses_attention
 
-            return ulysses_attention(q, k, v, axis_name=seq_axis, causal=causal)
+            return ulysses_attention(
+                q, k, v, axis_name=seq_axis, causal=causal, impl=impl
+            )
         raise ValueError(f"unknown sp_impl {sp_impl!r} (want 'ring'|'ulysses')")
+    if impl == "flash":
+        from ddp_practice_tpu.ops.flash_attention import flash_attention
+
+        return flash_attention(q, k, v, causal=causal)
     return _attention(q, k, v, causal=causal)
 
 

@@ -1,4 +1,4 @@
-"""Flash attention as a Pallas TPU kernel.
+"""Flash attention as Pallas TPU kernels — forward AND tiled backward.
 
 The reference consumes fused CUDA kernels through torch (cuDNN/cuBLAS —
 SURVEY §2.2 "CUDA/cuDNN kernels"); the TPU-native analogue for the one op
@@ -11,16 +11,28 @@ attention kernel. Forward pass (per q-block, per batch*head grid cell):
         acc = acc*exp(m-m') + exp(s-m') @ v   # MXU
     out = acc / l,   lse = m + log l
 
-so the (seq x seq) score matrix never materializes in HBM — the FORWARD is
-O(seq) memory instead of O(seq^2), one pass over K/V. Causal masking prunes
-whole k-blocks above the diagonal (the fori upper bound shrinks per q-block).
+so the (seq x seq) score matrix never materializes in HBM — O(seq) memory,
+one pass over K/V. Causal masking prunes whole k-blocks above the diagonal.
 
-Backward uses the saved logsumexp for a numerically exact dense recompute in
-XLA (einsums on the MXU) — O(seq^2) activation memory; a tiled Pallas
-backward (which the saved lse enables) is the planned follow-up, so today
-the kernel's memory win applies to inference/eval and the forward half of
-training. Runs compiled on TPU; `interpret=True` under the CPU backend so
-the same tests cover it everywhere (tests/conftest.py).
+Backward is tiled the same way (FlashAttention-2 scheme), recomputing
+p = exp(s - lse) blockwise from the saved logsumexp:
+
+    delta = rowsum(do * o)                    # XLA, cheap
+    dKdV kernel (grid over k-blocks): for each q-block:
+        p = exp(q@k^T*scale - lse);  dv += p^T @ do
+        ds = p * (do @ v^T - delta); dk += ds^T @ (q*scale)
+    dQ kernel (grid over q-blocks): for each k-block:
+        dq += (ds @ k) * scale
+
+so training memory is O(seq) end to end. `flash_attention_with_lse`
+additionally exposes lse as a differentiable output — the lse cotangent
+folds into delta (d lse/d s = p, so ds gains p*g_lse, i.e. delta -= g_lse)
+— which is what lets ring attention use this kernel as its per-block local
+attention and merge normalized partials across ring steps
+(parallel/ring.py).
+
+Runs compiled on TPU; `interpret=True` under the CPU backend so the same
+tests cover it everywhere (tests/conftest.py).
 """
 
 from __future__ import annotations
@@ -86,10 +98,7 @@ def _fwd_kernel(
     lse_ref[:] = (m + jnp.log(l_safe))[:, None]  # (block_q, 1) lane-padded
 
 
-def _flash_fwd(q, k, v, *, causal, block_q, block_k, interpret):
-    """q/k/v: (bh, seq, d). Returns (out, lse)."""
-    bh, seq_q, d = q.shape
-    seq_k = k.shape[1]
+def _check_blocks(seq_q, seq_k, block_q, block_k, causal):
     block_q = min(block_q, seq_q)
     block_k = min(block_k, seq_k)
     if seq_q % block_q or seq_k % block_k:
@@ -103,6 +112,14 @@ def _flash_fwd(q, k, v, *, causal, block_q, block_k, interpret):
             f"alignment); got seq_q={seq_q}, seq_k={seq_k} — early query "
             f"rows would attend to nothing"
         )
+    return block_q, block_k
+
+
+def _flash_fwd(q, k, v, *, causal, block_q, block_k, interpret):
+    """q/k/v: (bh, seq, d). Returns (out, lse)."""
+    bh, seq_q, d = q.shape
+    seq_k = k.shape[1]
+    block_q, block_k = _check_blocks(seq_q, seq_k, block_q, block_k, causal)
     sm_scale = 1.0 / (d ** 0.5)
     grid = (bh, seq_q // block_q)
     kernel = functools.partial(
@@ -130,51 +147,222 @@ def _flash_fwd(q, k, v, *, causal, block_q, block_k, interpret):
     return out, lse[..., 0]
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _flash(q, k, v, causal, block_q, block_k):
-    interpret = jax.default_backend() == "cpu"
-    out, _ = _flash_fwd(
-        q, k, v, causal=causal, block_q=block_q, block_k=block_k,
-        interpret=interpret,
+def _dkdv_kernel(
+    q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref, dk_ref, dv_ref,
+    *, sm_scale, block_q, causal, q_len_hint,
+):
+    """Grid cell: one k/v block; loops over q blocks (FlashAttention-2)."""
+    block_k, head_dim = k_ref.shape
+    seq_q = q_ref.shape[0]
+    ki = pl.program_id(1)
+
+    k = k_ref[:].astype(jnp.float32)
+    v = v_ref[:].astype(jnp.float32)
+
+    dk0 = jnp.zeros((block_k, head_dim), jnp.float32)
+    dv0 = jnp.zeros((block_k, head_dim), jnp.float32)
+
+    n_q = pl.cdiv(seq_q, block_q)
+    causal_offset = (k_ref.shape[0] * pl.num_programs(1)) - q_len_hint \
+        if causal else 0
+    q_start = 0
+    if causal:
+        # first q-block whose last row can see this k-block:
+        # q_pos + offset >= k_pos  =>  q_pos >= ki*block_k - offset
+        q_start = jnp.maximum(0, (ki * block_k - causal_offset) // block_q)
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[pl.ds(i * block_q, block_q), :].astype(jnp.float32) * sm_scale
+        do = do_ref[pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[pl.ds(i * block_q, block_q), :]  # (block_q, 1) fp32
+        delta = delta_ref[pl.ds(i * block_q, block_q), :]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(q_pos + causal_offset >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse)                       # exact probs (block)
+        dv = dv + jnp.dot(p.T, do, preferred_element_type=jnp.float32)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dk = dk + jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
+        return dk, dv
+
+    dk, dv = jax.lax.fori_loop(q_start, n_q, body, (dk0, dv0))
+    dk_ref[:] = dk.astype(dk_ref.dtype)
+    dv_ref[:] = dv.astype(dv_ref.dtype)
+
+
+def _dq_kernel(
+    q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref, dq_ref,
+    *, sm_scale, block_k, causal, q_len_hint,
+):
+    """Grid cell: one q block; loops over k blocks."""
+    block_q, head_dim = q_ref.shape
+    seq_k = k_ref.shape[0]
+    qi = pl.program_id(1)
+
+    q = q_ref[:].astype(jnp.float32) * sm_scale
+    do = do_ref[:].astype(jnp.float32)
+    lse = lse_ref[:]           # (block_q, 1) fp32
+    delta = delta_ref[:]
+
+    dq0 = jnp.zeros((block_q, head_dim), jnp.float32)
+    n_k = pl.cdiv(seq_k, block_k)
+    causal_offset = seq_k - q_len_hint if causal else 0
+    if causal:
+        n_k = jnp.minimum(
+            n_k, pl.cdiv((qi + 1) * block_q + causal_offset, block_k)
+        )
+
+    def body(j, dq):
+        k = k_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(q_pos + causal_offset >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        return dq + jnp.dot(ds, k, preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(0, n_k, body, dq0)
+    dq_ref[:] = (dq * sm_scale).astype(dq_ref.dtype)
+
+
+def _flash_bwd(q, k, v, do, lse, delta, *, causal, block_q, block_k,
+               interpret):
+    """Tiled dq/dk/dv. delta = rowsum(do*o) - g_lse, fp32 (bh, seq_q)."""
+    bh, seq_q, d = q.shape
+    seq_k = k.shape[1]
+    block_q, block_k = _check_blocks(seq_q, seq_k, block_q, block_k, causal)
+    sm_scale = 1.0 / (d ** 0.5)
+    lse3 = lse[..., None].astype(jnp.float32)
+    delta3 = delta[..., None].astype(jnp.float32)
+
+    dkdv = functools.partial(
+        _dkdv_kernel, sm_scale=sm_scale, block_q=block_q, causal=causal,
+        q_len_hint=seq_q,
     )
-    return out
+    dk, dv = pl.pallas_call(
+        dkdv,
+        grid=(bh, seq_k // block_k),
+        in_specs=[
+            pl.BlockSpec((None, seq_q, d), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((None, seq_q, d), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((None, seq_q, 1), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((None, seq_q, 1), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((None, block_k, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((None, block_k, d), lambda b, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_k, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((None, block_k, d), lambda b, j: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        interpret=interpret,
+    )(q, do, lse3, delta3, k, v)
+
+    dqk = functools.partial(
+        _dq_kernel, sm_scale=sm_scale, block_k=block_k, causal=causal,
+        q_len_hint=seq_q,
+    )
+    dq = pl.pallas_call(
+        dqk,
+        grid=(bh, seq_q // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, block_q, 1), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, block_q, 1), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, seq_k, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, seq_k, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=interpret,
+    )(q, do, lse3, delta3, k, v)
+    return dq, dk, dv
 
 
-def _flash_vjp_fwd(q, k, v, causal, block_q, block_k):
-    interpret = jax.default_backend() == "cpu"
+def _interpret() -> bool:
+    return jax.default_backend() == "cpu"
+
+
+# --------------------------------------------------------------------- #
+# Differentiable entry points.
+# _flash_lse returns (out, lse), both differentiable; the lse cotangent
+# folds into delta (see module docstring). flash_attention drops lse.
+# --------------------------------------------------------------------- #
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash_lse(q, k, v, causal, block_q, block_k):
     out, lse = _flash_fwd(
         q, k, v, causal=causal, block_q=block_q, block_k=block_k,
-        interpret=interpret,
+        interpret=_interpret(),
     )
-    return out, (q, k, v, out, lse)
+    return out, lse
 
 
-def _flash_vjp_bwd(causal, block_q, block_k, res, g):
-    """Exact dense recompute using the saved logsumexp (XLA einsums)."""
+def _flash_lse_vjp_fwd(q, k, v, causal, block_q, block_k):
+    out, lse = _flash_fwd(
+        q, k, v, causal=causal, block_q=block_q, block_k=block_k,
+        interpret=_interpret(),
+    )
+    return (out, lse), (q, k, v, out, lse)
+
+
+def _flash_lse_vjp_bwd(causal, block_q, block_k, res, g):
     q, k, v, out, lse = res
-    in_dtype = q.dtype
-    d = q.shape[-1]
-    sm_scale = 1.0 / (d ** 0.5)
-    qf = q.astype(jnp.float32)
-    kf = k.astype(jnp.float32)
-    vf = v.astype(jnp.float32)
-    gf = g.astype(jnp.float32)
-    s = jnp.einsum("bqd,bkd->bqk", qf, kf) * sm_scale
-    if causal:
-        sq, sk = s.shape[-2], s.shape[-1]
-        mask = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
-        s = jnp.where(mask[None], s, _NEG_INF)
-    p = jnp.exp(s - lse[..., None])                      # exact probs
-    dv = jnp.einsum("bqk,bqd->bkd", p, gf)
-    dp = jnp.einsum("bqd,bkd->bqk", gf, vf)
-    delta = jnp.sum(gf * out.astype(jnp.float32), axis=-1)  # rowsum(do*o)
-    ds = p * (dp - delta[..., None]) * sm_scale
-    dq = jnp.einsum("bqk,bkd->bqd", ds, kf)
-    dk = jnp.einsum("bqk,bqd->bkd", ds, qf)
-    return dq.astype(in_dtype), dk.astype(in_dtype), dv.astype(in_dtype)
+    g_out, g_lse = g
+    g_out = g_out.astype(q.dtype)
+    delta = jnp.sum(
+        g_out.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
+    )
+    if g_lse is not None and not isinstance(
+        g_lse, jax.custom_derivatives.SymbolicZero
+    ):
+        delta = delta - g_lse.astype(jnp.float32)
+    dq, dk, dv = _flash_bwd(
+        q, k, v, g_out, lse, delta,
+        causal=causal, block_q=block_q, block_k=block_k,
+        interpret=_interpret(),
+    )
+    return dq, dk, dv
 
 
-_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+_flash_lse.defvjp(_flash_lse_vjp_fwd, _flash_lse_vjp_bwd)
+
+
+def flash_attention_with_lse(
+    q: jnp.ndarray,  # (batch_heads, seq, head_dim)
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    causal: bool = False,
+    block_q: int = 128,
+    block_k: int = 128,
+):
+    """Fused attention over folded (b*h, s, d) layout, returning (out, lse).
+
+    lse is a differentiable output — the building block ring attention uses
+    to merge per-ring-step partials (parallel/ring.py)."""
+    return _flash_lse(q, k, v, causal, block_q, block_k)
 
 
 def flash_attention(
@@ -193,5 +381,7 @@ def flash_attention(
     def fold(x, s):
         return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, s, x.shape[-1])
 
-    out = _flash(fold(q, sq), fold(k, sk), fold(v, sk), causal, block_q, block_k)
+    out, _ = _flash_lse(
+        fold(q, sq), fold(k, sk), fold(v, sk), causal, block_q, block_k
+    )
     return jnp.transpose(out.reshape(b, h, sq, d), (0, 2, 1, 3))

@@ -58,9 +58,17 @@ def ring_attention(
     axis_name: str,
     causal: bool = False,
     mesh=None,
+    impl: str = "xla",
 ) -> jnp.ndarray:
+    """impl='xla': inline blockwise einsums (online softmax). impl='flash':
+    the Pallas kernel (ops.flash_attention) runs each local q x k-block
+    attention, returning (out, lse); partials merge across ring steps in
+    logsumexp space — O(local seq) memory with the fused kernel's HBM
+    profile, composing the two long-context features."""
     if _axis_bound(axis_name):
-        return _ring_attention_local(q, k, v, axis_name=axis_name, causal=causal)
+        return _ring_attention_local(
+            q, k, v, axis_name=axis_name, causal=causal, impl=impl
+        )
     mesh = mesh or get_current_mesh()
     if mesh is None:
         raise ValueError(
@@ -72,7 +80,8 @@ def ring_attention(
     spec = P(MeshConfig.AXIS_DATA, axis_name, MeshConfig.AXIS_TENSOR, None)
     fn = jax.shard_map(
         functools.partial(
-            _ring_attention_local, axis_name=axis_name, causal=causal
+            _ring_attention_local, axis_name=axis_name, causal=causal,
+            impl=impl,
         ),
         mesh=mesh,
         in_specs=(spec, spec, spec),
@@ -82,7 +91,84 @@ def ring_attention(
     return fn(q, k, v)
 
 
-def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool):
+def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
+                          impl: str = "xla"):
+    if impl == "flash":
+        return _ring_flash_local(q, k, v, axis_name=axis_name, causal=causal)
+    if impl != "xla":
+        raise ValueError(f"unknown attention impl {impl!r} (want 'xla'|'flash')")
+    return _ring_xla_local(q, k, v, axis_name=axis_name, causal=causal)
+
+
+def _ring_flash_local(q, k, v, *, axis_name: str, causal: bool):
+    """Ring attention with the Pallas flash kernel as the local attention.
+
+    Each ring step computes flash attention of the (resident) local queries
+    against the currently-held K/V block, yielding normalized (o_i, lse_i);
+    partials merge exactly:
+
+        m = max(lse, lse_i); w = exp(lse - m); w_i = exp(lse_i - m)
+        o <- (w*o + w_i*o_i) / (w + w_i);  lse <- m + log(w + w_i)
+
+    Causality across blocks resolves by block index (this device holds
+    global q positions [my_idx*sq, ...)): earlier blocks attend fully,
+    the diagonal block runs the kernel's causal mask, later blocks are
+    skipped (lse = -inf) — gradients flow through the kernel's tiled
+    backward plus the (differentiable) merge."""
+    from ddp_practice_tpu.ops.flash_attention import flash_attention_with_lse
+
+    in_dtype = q.dtype
+    axis_size = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    b, sq, h, dh = q.shape
+
+    def fold(x):
+        return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, x.shape[1], dh)
+
+    qf, kf, vf = fold(q), fold(k), fold(v)
+    o0 = jnp.zeros((b * h, sq, dh), jnp.float32)
+    lse0 = jnp.full((b * h, sq), _NEG_INF, jnp.float32)
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    def attend(kb, vb, kblock):
+        def run(causal_flag):
+            def f(args):
+                o, lse = flash_attention_with_lse(*args, causal=causal_flag)
+                return o.astype(jnp.float32), lse
+            return f
+
+        if not causal:
+            return run(False)((qf, kb, vb))
+
+        def masked(args):
+            return (jnp.zeros((b * h, sq, dh), jnp.float32),
+                    jnp.full((b * h, sq), _NEG_INF, jnp.float32))
+
+        idx = jnp.where(kblock == my_idx, 1, jnp.where(kblock < my_idx, 2, 0))
+        return lax.switch(idx, [masked, run(True), run(False)], (qf, kb, vb))
+
+    def body(carry, step):
+        o, lse, kb, vb = carry
+        kblock = (my_idx - step) % axis_size
+        oi, lsei = attend(kb, vb, kblock)
+        m = jnp.maximum(lse, lsei)
+        w1 = jnp.exp(lse - m)
+        w2 = jnp.exp(lsei - m)
+        denom = w1 + w2
+        o = (o * w1[..., None] + oi * w2[..., None]) / denom[..., None]
+        lse = m + jnp.log(denom)
+        kb = lax.ppermute(kb, axis_name, perm)
+        vb = lax.ppermute(vb, axis_name, perm)
+        return (o, lse, kb, vb), None
+
+    (o, _, _, _), _ = lax.scan(
+        body, (o0, lse0, kf, vf), jnp.arange(axis_size)
+    )
+    o = jnp.transpose(o.reshape(b, h, sq, dh), (0, 2, 1, 3))
+    return o.astype(in_dtype)
+
+
+def _ring_xla_local(q, k, v, *, axis_name: str, causal: bool):
     """Blockwise attention on local shards; K/V ring-rotated each step."""
     in_dtype = q.dtype
     axis_size = lax.psum(1, axis_name)  # trace-time constant under shard_map

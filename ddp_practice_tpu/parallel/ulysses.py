@@ -30,10 +30,17 @@ from ddp_practice_tpu.config import MeshConfig
 from ddp_practice_tpu.parallel.ring import _axis_bound, get_current_mesh
 
 
-def ulysses_attention(q, k, v, *, axis_name: str, causal: bool = False, mesh=None):
-    """All-to-all sequence-parallel attention; same signature as ring."""
+def ulysses_attention(q, k, v, *, axis_name: str, causal: bool = False,
+                      mesh=None, impl: str = "xla"):
+    """All-to-all sequence-parallel attention; same signature as ring.
+
+    `impl` picks the local full-sequence attention after the head scatter:
+    'xla' (fused dense) or 'flash' (the Pallas tiled kernel — O(seq)
+    memory over the gathered sequence)."""
     if _axis_bound(axis_name):
-        return _ulysses_local(q, k, v, axis_name=axis_name, causal=causal)
+        return _ulysses_local(
+            q, k, v, axis_name=axis_name, causal=causal, impl=impl
+        )
     mesh = mesh or get_current_mesh()
     if mesh is None:
         raise ValueError(
@@ -42,7 +49,9 @@ def ulysses_attention(q, k, v, *, axis_name: str, causal: bool = False, mesh=Non
         )
     spec = P(MeshConfig.AXIS_DATA, axis_name, MeshConfig.AXIS_TENSOR, None)
     fn = jax.shard_map(
-        functools.partial(_ulysses_local, axis_name=axis_name, causal=causal),
+        functools.partial(
+            _ulysses_local, axis_name=axis_name, causal=causal, impl=impl
+        ),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
@@ -51,7 +60,7 @@ def ulysses_attention(q, k, v, *, axis_name: str, causal: bool = False, mesh=Non
     return fn(q, k, v)
 
 
-def _ulysses_local(q, k, v, *, axis_name: str, causal: bool):
+def _ulysses_local(q, k, v, *, axis_name: str, causal: bool, impl: str = "xla"):
     from ddp_practice_tpu.ops.attention import _attention
 
     axis_size = lax.psum(1, axis_name)
@@ -72,5 +81,12 @@ def _ulysses_local(q, k, v, *, axis_name: str, causal: bool):
     qg = gather_seq_scatter_heads(q)
     kg = gather_seq_scatter_heads(k)
     vg = gather_seq_scatter_heads(v)
-    out = _attention(qg, kg, vg, causal=causal)
+    if impl == "flash":
+        from ddp_practice_tpu.ops.flash_attention import flash_attention
+
+        out = flash_attention(qg, kg, vg, causal=causal)
+    elif impl == "xla":
+        out = _attention(qg, kg, vg, causal=causal)
+    else:
+        raise ValueError(f"unknown attention impl {impl!r} (want 'xla'|'flash')")
     return scatter_seq_gather_heads(out)

@@ -84,6 +84,10 @@ class Trainer:
         if self.sp > 1:
             model_kwargs["seq_axis"] = MeshConfig.AXIS_SEQ
             model_kwargs["sp_impl"] = config.sp_impl
+        if config.attn_impl != "xla":
+            # only attention models accept this; a conv model raises loudly
+            # rather than silently ignoring the requested kernel
+            model_kwargs["attn_impl"] = config.attn_impl
         if self.pp > 1:
             # pipeline-capable models take the stage count from the mesh; a
             # non-pipeline model with mesh.pipe > 1 fails loudly here rather
