@@ -78,7 +78,13 @@ def _resolve(directory: str) -> Optional[str]:
 
     step_N dirs win over a legacy root-level checkpoint: any step_N was
     written after the legacy file (this writer only produces step dirs),
-    so preferring legacy would silently resume pre-upgrade state."""
+    so preferring legacy would silently resume pre-upgrade state.
+
+    Last resort: a *complete* (manifest present) dir under a temp or
+    rename-aside name. A crash in the same-step re-save window can leave
+    the only complete copies as tmp.step_N.*/step_N.old.* — both written
+    with manifest last, so completeness still implies integrity — and
+    refusing them would strand a recoverable run with no checkpoint."""
     steps = _complete_steps(directory)
     if steps:
         return os.path.join(directory, f"step_{steps[-1]}")
@@ -86,7 +92,16 @@ def _resolve(directory: str) -> Optional[str]:
         os.path.join(directory, _LEAVES)
     ):
         return directory  # legacy single-checkpoint layout
-    return None
+    best, best_step = None, -1
+    if os.path.isdir(directory):
+        for name in os.listdir(directory):
+            if not (name.startswith("tmp.step_") or ".old." in name):
+                continue
+            m = re.search(r"step_(\d+)", name)
+            if m and os.path.exists(os.path.join(directory, name, _MANIFEST)):
+                if int(m.group(1)) > best_step:
+                    best, best_step = name, int(m.group(1))
+    return os.path.join(directory, best) if best else None
 
 
 def save(

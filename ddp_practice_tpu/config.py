@@ -177,6 +177,13 @@ class TrainConfig:
     # failure detection / elastic recovery (absent in reference, SURVEY §5.3)
     max_restarts: int = 0              # checkpoint-based restarts on failure
     watchdog_timeout_s: float = 0.0    # 0 = no step watchdog
+    # force a device-progress probe (scalar readback of the current step's
+    # metrics) every N steps — the watchdog beats only on CONFIRMED device
+    # progress, never on dispatch (async dispatch outruns a hung collective).
+    # Independent of N, a probe also fires whenever half the watchdog
+    # timeout passes without one, so slow steps can't starve the watchdog
+    # into a spurious firing. 0 = time-based probing only.
+    watchdog_probe_every_steps: int = 50
     sync_check_every_steps: int = 0    # 0 = no cross-host driver sync checks
 
     # eval / logging

@@ -45,13 +45,22 @@ class PipelinedViT:
         num_microbatches: int = 4,
         pipe_axis: str = MeshConfig.AXIS_PIPE,
         remat: bool = True,
-        seq_axis: Optional[str] = None,  # registry uniformity; SP not composed here
-        sp_impl: str = "ring",           # accepted+ignored, like seq_axis
+        seq_axis: Optional[str] = None,
+        sp_impl: str = "ring",
         attn_impl: str = "xla",
         axis_name: Optional[str] = None,
     ):
         if depth % max(num_stages, 1) != 0:
             raise ValueError(f"depth {depth} % stages {num_stages} != 0")
+        if seq_axis is not None:
+            # fail loudly rather than train without the requested sequence
+            # parallelism: the encoder stack runs inside the pipeline
+            # shard_map, where the GSPMD-side SP wrappers don't apply
+            raise ValueError(
+                "PipelinedViT does not compose sequence parallelism with "
+                "the pipeline yet; use mesh.seq=1 with pipe>1 (supported "
+                "combinations: README 'Parallelism composition')"
+            )
         self.depth = depth
         self.num_stages = num_stages
         self.num_microbatches = num_microbatches

@@ -80,6 +80,36 @@ class StepWatchdog:
         self._last = time.monotonic()
         self._beaten = True
 
+    def seconds_since_beat(self) -> float:
+        return time.monotonic() - self._last
+
+    def probe_due(self) -> bool:
+        """True when the next progress confirmation should not wait any
+        longer: past half the timeout without a beat. Callers use this to
+        couple probe cadence to the timeout, so a step-count probe
+        interval can never starve the watchdog into a spurious firing on
+        a healthy-but-slow run."""
+        return self.seconds_since_beat() > self.timeout_s / 2
+
+    def probe(self, value, fetch=None) -> None:
+        """Record progress only after `value` resolves on the host.
+
+        Under async dispatch a jit call returns before the device runs it
+        (and on some PJRT transports even `block_until_ready` does not
+        fence — BENCHMARKS.md), so beating after dispatch would let a hung
+        collective go undetected while the host keeps enqueueing. Fetching
+        a scalar from a step's metrics cannot complete until that step —
+        and, by data dependence, every step before it — actually executed;
+        if the device is hung, this call blocks, beats stop, and the
+        watchdog thread fires on schedule.
+        """
+        if fetch is None:
+            import jax
+
+            fetch = jax.device_get
+        fetch(value)
+        self.beat()
+
     def stop(self) -> None:
         self._stop.set()
         if self._thread is not None:

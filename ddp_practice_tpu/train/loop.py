@@ -94,6 +94,17 @@ class Trainer:
             # than silently training unpipelined
             model_kwargs["num_stages"] = self.pp
             model_kwargs["num_microbatches"] = config.num_microbatches
+            if mesh_shape.get(MeshConfig.AXIS_TENSOR, 1) > 1:
+                # TP rules deliberately leave pipeline block params' inner
+                # dims replicated (sharding_rules._vit_pipe_rule); training
+                # with --tensor>1 --pipe>1 would silently not be
+                # tensor-parallel, so refuse instead
+                raise ValueError(
+                    "tensor parallelism is not composed into the pipeline "
+                    "shard_map yet: use tensor>1 with pipe=1, or pipe>1 "
+                    "with tensor=1 (supported combinations: README "
+                    "'Parallelism composition')"
+                )
         self.ep = mesh_shape.get(MeshConfig.AXIS_EXPERT, 1)
         if self.ep > 1 or config.num_experts:
             # expert count must divide evenly over the 'expert' axis; default
@@ -206,6 +217,7 @@ class Trainer:
                 )
             )
         last_metrics = {}
+        final_metrics = None
         t0 = time.perf_counter()
         images_this_epoch = 0
         # profile a steady-state window (post-compile) of the first epoch,
@@ -261,10 +273,18 @@ class Trainer:
                         inc = 1
                 if self._serialize_steps:
                     jax.block_until_ready(metrics)
-                if self._watchdog is not None:
-                    self._watchdog.beat()
                 prev = steps_done
                 steps_done += inc
+                probe_steps = cfg.watchdog_probe_every_steps
+                if self._watchdog is not None and (
+                    self._watchdog.probe_due()  # never starve past timeout/2
+                    or (probe_steps and prev // probe_steps
+                        != steps_done // probe_steps)
+                ):
+                    # confirmed device progress, not dispatch: fetch a
+                    # scalar from this step's metrics (blocks until the
+                    # whole chain up to it has executed)
+                    self._watchdog.probe(metrics["loss"])
                 if cfg.sync_check_every_steps and (
                     prev // cfg.sync_check_every_steps
                     != steps_done // cfg.sync_check_every_steps
@@ -278,10 +298,13 @@ class Trainer:
                         what="driver step",
                     )
                 images_this_epoch += self.global_batch * inc
+                final_metrics = metrics
                 if cfg.log_every_steps and (
                     prev // cfg.log_every_steps != steps_done // cfg.log_every_steps
                 ):
                     last_metrics = jax.device_get(metrics)
+                    if self._watchdog is not None:
+                        self._watchdog.beat()  # the device_get confirmed progress
                     if dist.is_main_process():
                         log.info(
                             "epoch %d step %d loss %.4f acc %.3f",
@@ -290,6 +313,13 @@ class Trainer:
                             float(last_metrics["accuracy"]),
                         )
             jax.block_until_ready(self.state.params)
+            if final_metrics is not None:
+                # a scalar readback is the only progress signal that fences
+                # on every transport (block_until_ready may not —
+                # BENCHMARKS.md), so epoch timing closes on it
+                jax.device_get(final_metrics["loss"])
+                if self._watchdog is not None:
+                    self._watchdog.beat()
         finally:
             items.close()  # stop the prefetch producer thread promptly
             if profiling:  # short epoch or mid-window failure: close trace
@@ -308,17 +338,26 @@ class Trainer:
         correct = jnp.zeros((), jnp.float32)
         total = jnp.zeros((), jnp.float32)
         try:
+            n_eval = 0
             for batch in it:
                 c, t = self.eval_step(self.state, batch)
                 if self._serialize_steps:
                     jax.block_until_ready(c)
-                if self._watchdog is not None:
-                    self._watchdog.beat()
                 correct = correct + c
                 total = total + t
+                n_eval += 1
+                probe_steps = self.config.watchdog_probe_every_steps
+                if self._watchdog is not None and (
+                    self._watchdog.probe_due()
+                    or (probe_steps and n_eval % probe_steps == 0)
+                ):
+                    self._watchdog.probe(c)
         finally:
             it.close()  # stop the prefetch producer thread promptly
-        return float(correct) / max(float(total), 1.0)
+        acc = float(correct) / max(float(total), 1.0)  # readback = confirmed
+        if self._watchdog is not None:
+            self._watchdog.beat()
+        return acc
 
     def save(self) -> None:
         if self._watchdog is not None:

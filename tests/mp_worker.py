@@ -1,0 +1,180 @@
+"""Worker for the real multi-process tests (tests/test_multiprocess.py).
+
+Runs as 2 actual OS processes that rendezvous through
+`jax.distributed.initialize` on the CPU platform (2 local devices each, 4
+global) — the torchrun-equivalent contract of the reference
+(ddp_main_torchrun.py:102-104): every process calls the collectives, only
+process 0 performs side effects. Exercises the code paths no single-process
+test can reach:
+
+- `jax.distributed.initialize` with an explicit coordinator
+  (parallel/dist.py),
+- the per-process `ShardSpec` local slice feeding
+  `jax.make_array_from_process_local_data` (data/loader.py `_to_global`
+  multi-process branch),
+- `assert_in_sync`'s allgather branch, both agreeing and firing on a
+  mismatch (train/elastic.py),
+- process-0-only checkpoint writes with the collective leaf gather for
+  multi-host-sharded (FSDP) state and the post-save barrier
+  (checkpoint/__init__.py).
+
+Prints ALL_OK as the last line on success; any assertion kills the exit
+code, which the parent test asserts on.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def main() -> int:
+    coord, nproc_s, pid_s, workdir = sys.argv[1:5]
+    nproc, pid = int(nproc_s), int(pid_s)
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 2)
+
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    from ddp_practice_tpu.parallel import dist
+
+    dist.initialize(coord, nproc, pid)
+    assert jax.process_count() == nproc, jax.process_count()
+    assert jax.process_index() == pid
+    assert jax.device_count() == 2 * nproc, jax.device_count()
+    print(f"[{pid}] distributed up: {jax.device_count()} global devices")
+
+    # --- assert_in_sync agreeing fingerprints: passes on every process ---
+    from ddp_practice_tpu.train.elastic import assert_in_sync
+
+    assert_in_sync(4242, what="mp test")
+    print(f"[{pid}] sync-match ok")
+
+    # --- sharded input pipeline: ShardSpec slice -> global jax.Array ---
+    from ddp_practice_tpu.config import MeshConfig
+    from ddp_practice_tpu.data import DataLoader, ShardSpec
+    from ddp_practice_tpu.data.datasets import synthetic_image_classification
+    from ddp_practice_tpu.data.loader import prefetch_to_device
+    from ddp_practice_tpu.data.sharding import epoch_indices
+    from ddp_practice_tpu.parallel.mesh import batch_sharding, build_mesh
+
+    ds = synthetic_image_classification(
+        n=64, image_shape=(8, 8, 1), num_classes=10, seed=7
+    )
+    gbs = 16
+    loader = DataLoader(
+        ds, global_batch_size=gbs,
+        shard=ShardSpec(dist.process_index(), dist.process_count()),
+        seed=3407, shuffle=True,
+    )
+    loader.set_epoch(1)
+    mesh = build_mesh(MeshConfig(data=-1))
+    bsh = batch_sharding(mesh)
+    # expected global order is host-computable on every process (same seed)
+    order = epoch_indices(64, seed=3407, epoch=1, shuffle=True)
+    it = prefetch_to_device(iter(loader), bsh, size=2)
+    try:
+        for step, batch in enumerate(it):
+            assert batch["label"].shape[0] == gbs  # global shape
+            assert not batch["label"].is_fully_addressable  # spans processes
+            got = multihost_utils.process_allgather(batch["label"], tiled=True)
+            want = ds.labels[order[step * gbs:(step + 1) * gbs]]
+            np.testing.assert_array_equal(np.asarray(got), want)
+    finally:
+        it.close()
+    print(f"[{pid}] sharded loader ok")
+
+    # --- 2-process training with process-0-only checkpoint writes ---
+    from ddp_practice_tpu.config import TrainConfig
+    from ddp_practice_tpu.train.loop import Trainer
+
+    ck = os.path.join(workdir, "ck")
+    cfg = TrainConfig(
+        model="convnet",
+        dataset="synthetic",
+        batch_size=8,  # per replica x 4 devices = 32 global
+        epochs=1,
+        max_steps_per_epoch=4,
+        optimizer="adam",
+        learning_rate=1e-3,
+        log_every_steps=0,
+        checkpoint_dir=ck,
+        coordinator_address=coord,
+        num_processes=nproc,
+        process_id=pid,
+        mesh=MeshConfig(data=-1),
+    )
+    trainer = Trainer(cfg)
+    summary = trainer.fit()
+    assert summary["steps"] == 4, summary
+    # every process sees the checkpoint (shared FS); the save barrier
+    # guarantees it is complete before any process returns
+    from ddp_practice_tpu import checkpoint as ckpt
+
+    assert ckpt.exists(ck)
+    man = ckpt.latest_manifest(ck)
+    assert man["extra"]["step"] == 4, man
+    # replicated params identical across processes after synced training
+    leaf = jax.tree_util.tree_leaves(trainer.state.params)[0]
+    host_leaf = np.asarray(jax.device_get(leaf)).ravel()[:8]
+    gathered = multihost_utils.process_allgather(host_leaf)
+    np.testing.assert_allclose(gathered[0], gathered[1], rtol=0, atol=0)
+    print(f"[{pid}] train + process-0 checkpoint ok")
+
+    # --- FSDP-sharded state: collective gather inside ckpt.save ---
+    from ddp_practice_tpu.models import create_model
+    from ddp_practice_tpu.parallel.fsdp import fsdp_rules
+    from ddp_practice_tpu.parallel.mesh import shard_state
+    from ddp_practice_tpu.train import create_state, make_optimizer
+
+    import jax.numpy as jnp
+
+    model = create_model("convnet")
+    tx = make_optimizer(TrainConfig())
+
+    def init_fn(r):
+        return create_state(
+            model, tx, rng=r, sample_input=jnp.zeros((4, 28, 28, 1))
+        )
+
+    abstract = jax.eval_shape(init_fn, jax.random.PRNGKey(0))
+    shardings = shard_state(
+        abstract, mesh, fsdp_rules(2 * nproc, None, min_leaf_size=64)
+    )
+    state = jax.jit(init_fn, out_shardings=shardings)(jax.random.PRNGKey(0))
+    big = [
+        leaf for leaf in jax.tree_util.tree_leaves(state.params)
+        if leaf.size >= 64
+    ]
+    assert any(not leaf.is_fully_addressable for leaf in big), \
+        "expected some FSDP leaves to span processes"
+    ck2 = os.path.join(workdir, "ck_fsdp")
+    ckpt.save(ck2, state, step=1)  # collective: all processes call
+    restored = ckpt.restore(ck2, abstract)
+    ref = multihost_utils.process_allgather(big[0], tiled=True)
+    leaves = jax.tree_util.tree_leaves(state.params)
+    big_idx = next(i for i, l in enumerate(leaves) if l is big[0])
+    got = np.asarray(jax.tree_util.tree_leaves(restored.params)[big_idx])
+    np.testing.assert_allclose(got, np.asarray(ref))
+    print(f"[{pid}] fsdp sharded save/restore ok")
+
+    # --- assert_in_sync MUST fire on divergent fingerprints ---
+    fired = False
+    try:
+        assert_in_sync(1000 + pid, what="deliberate mismatch")
+    except RuntimeError as e:
+        fired = True
+        assert "out of sync" in str(e)
+    assert fired, "assert_in_sync did not detect the mismatch"
+    print(f"[{pid}] sync-mismatch detection ok")
+
+    print("ALL_OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

