@@ -37,6 +37,9 @@ def build_parser() -> argparse.ArgumentParser:
                             "vit_tiny_moe", "vit_tiny_pipe"])
     p.add_argument("--dataset", default="mnist")
     p.add_argument("--data_dir", default="./data")
+    p.add_argument("--synthetic_size", type=int, default=0,
+                   help="synthetic-fallback corpus size (train split; "
+                        "0 = per-dataset default)")
     p.add_argument("--lr", type=float, default=1e-4)
     p.add_argument("--optimizer", default="sgd", choices=["sgd", "adam", "adamw"])
     p.add_argument("--momentum", type=float, default=0.0)
@@ -99,6 +102,7 @@ def config_from_args(args) -> TrainConfig:
         model=args.model,
         dataset=args.dataset,
         data_dir=args.data_dir,
+        synthetic_size=args.synthetic_size,
         epochs=args.epochs,
         batch_size=args.batch_size,
         learning_rate=args.lr,

@@ -130,6 +130,9 @@ class TrainConfig:
     dataset: str = "mnist"
     data_dir: str = "./data"
     num_classes: int = 10
+    # override the synthetic-fallback corpus size (train split; eval gets
+    # ~1/6, the MNIST train:test ratio). 0 = per-dataset default.
+    synthetic_size: int = 0
 
     # optimization (reference defaults: origin_main.py:37-52, ddp_main.py:125)
     epochs: int = 3
@@ -177,12 +180,16 @@ class TrainConfig:
     # failure detection / elastic recovery (absent in reference, SURVEY §5.3)
     max_restarts: int = 0              # checkpoint-based restarts on failure
     watchdog_timeout_s: float = 0.0    # 0 = no step watchdog
-    # force a device-progress probe (scalar readback of the current step's
-    # metrics) every N steps — the watchdog beats only on CONFIRMED device
-    # progress, never on dispatch (async dispatch outruns a hung collective).
-    # Independent of N, a probe also fires whenever half the watchdog
-    # timeout passes without one, so slow steps can't starve the watchdog
-    # into a spurious firing. 0 = time-based probing only.
+    # force a device-progress probe every N steps — the watchdog beats only
+    # on CONFIRMED device progress, never on dispatch (async dispatch
+    # outruns a hung collective). A probe fetches the OLDEST unconfirmed
+    # step's metrics scalar (one rung past the last confirmed point), so it
+    # blocks for at most ~one step of device time even when the host has
+    # dispatched far ahead — the watchdog fires only when NO step completes
+    # within the timeout, not when the host merely outruns a healthy
+    # device. Independent of N, a probe also fires whenever half the
+    # watchdog timeout passes without one, so slow steps can't starve the
+    # watchdog into a spurious firing. 0 = time-based probing only.
     watchdog_probe_every_steps: int = 50
     sync_check_every_steps: int = 0    # 0 = no cross-host driver sync checks
 

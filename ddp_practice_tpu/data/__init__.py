@@ -6,13 +6,22 @@ a deterministic (seed, epoch)-keyed global shuffle, per-host strided shards,
 and device placement through `jax.make_array_from_process_local_data`.
 """
 
-from ddp_practice_tpu.data.datasets import Dataset, load_dataset
+from ddp_practice_tpu.data.datasets import (
+    Dataset,
+    load_array_dataset,
+    load_dataset,
+    synthetic_imagenet_corpus,
+    write_array_dataset,
+)
 from ddp_practice_tpu.data.sharding import ShardSpec, epoch_indices
 from ddp_practice_tpu.data.loader import DataLoader
 
 __all__ = [
     "Dataset",
     "load_dataset",
+    "load_array_dataset",
+    "write_array_dataset",
+    "synthetic_imagenet_corpus",
     "ShardSpec",
     "epoch_indices",
     "DataLoader",

@@ -27,15 +27,28 @@ _MNIST_FILES = {
 
 @dataclasses.dataclass
 class Dataset:
-    """An in-memory dataset: images in [0,1] float32 NHWC, integer labels."""
+    """An array-backed dataset: NHWC images, integer labels.
 
-    images: np.ndarray  # (N, H, W, C) float32 in [0, 1]
+    Two storage contracts, distinguished by dtype:
+
+    - ``float32`` in [0, 1] — the reference's post-`ToTensor()` layout
+      (origin_main.py:89); fine for MNIST/CIFAR-sized data held in RAM.
+    - ``uint8`` in [0, 255] — raw pixels, 4x smaller in RAM *and* over
+      H2D; normalization to [0,1] happens on device inside the jitted
+      step (train/steps.py), where XLA fuses it into the first conv.
+      ``images`` may be an ``np.memmap`` so ImageNet-scale corpora
+      stream from disk through the OS page cache instead of
+      materializing in host memory.
+    """
+
+    images: np.ndarray  # (N, H, W, C) float32 in [0,1] or uint8 in [0,255]
     labels: np.ndarray  # (N,) int32
     num_classes: int
     name: str = "dataset"
 
     def __post_init__(self):
         assert self.images.ndim == 4, self.images.shape
+        assert self.images.dtype in (np.float32, np.uint8), self.images.dtype
         assert len(self.images) == len(self.labels)
 
     def __len__(self) -> int:
@@ -155,6 +168,24 @@ def load_dataset(
             seed=seed, split_seed=(0 if split == "train" else 1),
             name=f"cifar10-synthetic-{split}",
         )
+    if name == "imagenet":
+        real = os.path.join(data_dir, "imagenet-arrays")
+        # accept the conventional 'val' name for the held-out split
+        candidates = (split, "val") if split == "test" else (split,)
+        for cand in candidates:
+            if _array_dataset_exists(real, cand):
+                return load_array_dataset(real, cand)
+        if os.path.isdir(real):
+            # a real corpus exists but not this split: refuse rather than
+            # silently mixing real training with synthetic-noise eval
+            raise FileNotFoundError(
+                f"{real} exists but has no complete "
+                f"{' or '.join(candidates)!s} split; expected "
+                f"<split>-images.npy + <split>-labels.npy + meta.json"
+            )
+        n = synthetic_size or (16384 if split == "train" else 2048)
+        root = os.path.join(data_dir, f"imagenet-synthetic-{n}-s{seed}")
+        return synthetic_imagenet_corpus(root, split, n=n, seed=seed)
     if name.startswith("synthetic"):
         n = synthetic_size or (4096 if split == "train" else 1024)
         return synthetic_image_classification(
@@ -163,6 +194,237 @@ def load_dataset(
             name=f"{name}-{split}",
         )
     raise ValueError(f"unknown dataset {name!r}")
+
+
+# --------------------------------------------------------------------- #
+# Array-record corpus: the ImageNet-scale storage format.
+#
+# A corpus directory holds `{split}-images.npy` (uint8, N x H x W x C) and
+# `{split}-labels.npy` (int32, N) plus `meta.json`. `.npy` because
+# `np.load(mmap_mode="r")` memory-maps it directly: batch gather touches
+# only the pages it indexes, so a ~150 GB ImageNet-sized corpus streams
+# through the OS page cache — nothing is ever materialized as fp32 in RAM
+# (the reference leans on torchvision + DataLoader workers for this role,
+# origin_main.py:88-107). Writes are chunked through a writer memmap and
+# finished with os.replace, so a crashed writer never leaves a readable
+# but torn corpus behind.
+# --------------------------------------------------------------------- #
+
+
+_STALE_TMP_AGE_S = 3600.0
+
+
+def _sweep_stale_tmps(root: str) -> None:
+    """Remove tmp files abandoned by crashed writers (a killed worker's
+    finally never runs, and its full-size memmap would otherwise sit on
+    the data disk forever). Age-gated so live concurrent writers — which
+    use pid-unique names and touch their files continuously — are never
+    swept."""
+    import time
+
+    now = time.time()
+    for name in os.listdir(root):
+        if ".tmp." not in name:
+            continue
+        p = os.path.join(root, name)
+        try:
+            if now - os.path.getmtime(p) > _STALE_TMP_AGE_S:
+                os.remove(p)
+        except OSError:
+            pass
+
+
+def _array_dataset_exists(root: str, split: str) -> bool:
+    return all(
+        os.path.exists(os.path.join(root, f))
+        for f in (f"{split}-images.npy", f"{split}-labels.npy", "meta.json")
+    )
+
+
+def write_array_dataset(
+    root: str,
+    split: str,
+    chunks,
+    *,
+    n: int,
+    image_shape: Tuple[int, int, int],
+    num_classes: int,
+    name: str = "array",
+    extra_meta: Optional[dict] = None,
+) -> None:
+    """Stream `chunks` of (uint8 images, labels) into an array-record corpus.
+
+    Peak host memory is one chunk regardless of `n`: chunks are copied
+    straight into a writer memmap. Files appear under their final names
+    only when complete (tmp + os.replace), `meta.json` last. Tmp names are
+    pid-unique, so concurrent writers (e.g. the per-host processes of a
+    multi-host run racing to generate the same synthetic corpus) never
+    truncate each other's mapping; deterministic generators make the
+    last-rename-wins outcome byte-identical.
+    """
+    import json
+
+    import uuid
+
+    os.makedirs(root, exist_ok=True)
+    _sweep_stale_tmps(root)
+    # host-unique suffix: PIDs collide across hosts on a shared filesystem
+    tag = f"{os.getpid()}.{uuid.uuid4().hex[:8]}"
+    img_tmp = os.path.join(root, f".{split}-images.npy.tmp.{tag}")
+    lbl_tmp = os.path.join(root, f".{split}-labels.npy.tmp.{tag}")
+    done = False
+    try:
+        images = np.lib.format.open_memmap(
+            img_tmp, mode="w+", dtype=np.uint8, shape=(n,) + tuple(image_shape)
+        )
+        labels = np.lib.format.open_memmap(
+            lbl_tmp, mode="w+", dtype=np.int32, shape=(n,)
+        )
+        written = 0
+        for img_chunk, lbl_chunk in chunks:
+            img_chunk = np.asarray(img_chunk)
+            lbl_chunk = np.asarray(lbl_chunk, dtype=np.int32)
+            if img_chunk.dtype != np.uint8:
+                raise ValueError(f"chunk dtype {img_chunk.dtype}, expected uint8")
+            k = len(img_chunk)
+            # exact-shape checks: numpy assignment would happily broadcast a
+            # mis-shaped chunk into a silently corrupted corpus
+            if img_chunk.shape[1:] != tuple(image_shape):
+                raise ValueError(
+                    f"chunk image shape {img_chunk.shape[1:]}, "
+                    f"expected {tuple(image_shape)}"
+                )
+            if lbl_chunk.shape != (k,):
+                raise ValueError(
+                    f"chunk labels shape {lbl_chunk.shape}, expected ({k},)"
+                )
+            if written + k > n:
+                raise ValueError(f"chunks exceed declared n={n}")
+            images[written : written + k] = img_chunk
+            labels[written : written + k] = lbl_chunk
+            written += k
+            # mmap writes do not update mtime; touch so a concurrent
+            # writer's stale-tmp sweep never reaps a live slow writer
+            os.utime(img_tmp)
+            os.utime(lbl_tmp)
+        if written != n:
+            raise ValueError(f"chunks provided {written} samples, declared n={n}")
+        images.flush()
+        labels.flush()
+        del images, labels  # close the writer maps before rename
+        os.replace(img_tmp, os.path.join(root, f"{split}-images.npy"))
+        os.replace(lbl_tmp, os.path.join(root, f"{split}-labels.npy"))
+        done = True
+    finally:
+        if not done:  # a failed writer must not strand a full-size tmp
+            for p in (img_tmp, lbl_tmp):
+                try:
+                    os.remove(p)
+                except OSError:
+                    pass
+    meta_path = os.path.join(root, "meta.json")
+    meta = {"num_classes": num_classes, "name": name, "splits": {}}
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            meta = json.load(f)
+    meta["num_classes"] = num_classes
+    meta["name"] = name
+    meta.setdefault("splits", {})[split] = {
+        "n": n, "image_shape": list(image_shape),
+        **({"gen": extra_meta} if extra_meta else {}),
+    }
+    tmp = f"{meta_path}.tmp.{tag}"
+    with open(tmp, "w") as f:
+        json.dump(meta, f, indent=1)
+    os.replace(tmp, meta_path)
+
+
+def load_array_dataset(root: str, split: str, *, mmap: bool = True) -> Dataset:
+    """Open an array-record corpus split; `mmap=True` (default) streams
+    pixels from disk on access instead of loading them into RAM."""
+    import json
+
+    with open(os.path.join(root, "meta.json")) as f:
+        meta = json.load(f)
+    mode = "r" if mmap else None
+    images = np.load(os.path.join(root, f"{split}-images.npy"), mmap_mode=mode)
+    labels = np.asarray(
+        np.load(os.path.join(root, f"{split}-labels.npy")), dtype=np.int32
+    )
+    return Dataset(
+        images=images,
+        labels=labels,
+        num_classes=int(meta["num_classes"]),
+        name=f"{meta.get('name', 'array')}-{split}",
+    )
+
+
+def synthetic_imagenet_corpus(
+    root: str,
+    split: str,
+    *,
+    n: int,
+    image_shape: Tuple[int, int, int] = (224, 224, 3),
+    num_classes: int = 1000,
+    seed: int = 3407,
+    noise: float = 0.35,
+    chunk_size: int = 256,
+) -> Dataset:
+    """ImageNet-shaped synthetic corpus, generated to disk once and
+    memory-mapped thereafter.
+
+    Same template+noise construction as `synthetic_image_classification`
+    (learnable, deterministic in (seed, split)) but streamed: class
+    templates live at 1/16 resolution and are upsampled per chunk, so
+    generation and loading both run in O(chunk) host memory — the property
+    the fp32 in-RAM path fundamentally lacks at this scale.
+    """
+    gen_params = {
+        "seed": seed, "noise": noise, "num_classes": num_classes,
+        "split": split,
+    }
+    if _array_dataset_exists(root, split):
+        import json
+
+        with open(os.path.join(root, "meta.json")) as f:
+            meta = json.load(f)
+        cached = meta.get("splits", {}).get(split, {})
+        # cache hit only when every generation parameter matches — a corpus
+        # from a different seed/noise/class-count must not be silently reused
+        if (
+            cached.get("n") == n
+            and tuple(cached.get("image_shape", ())) == tuple(image_shape)
+            and cached.get("gen") == gen_params
+        ):
+            return load_array_dataset(root, split)
+    h, w, c = image_shape
+    th, tw = max(1, h // 16), max(1, w // 16)
+    template_rng = np.random.default_rng(np.random.SeedSequence([seed, 0xDA7A]))
+    templates = template_rng.uniform(
+        0.0, 1.0, size=(num_classes, th, tw, c)
+    ).astype(np.float32)
+    split_seed = 0 if split == "train" else 1
+    rng = np.random.default_rng(np.random.SeedSequence([seed, split_seed, 0x1A6E]))
+    labels = rng.integers(0, num_classes, size=n).astype(np.int32)
+    ry, rx = -(-h // th), -(-w // tw)  # repeat factors, then crop
+
+    def chunks():
+        for start in range(0, n, chunk_size):
+            lbl = labels[start : start + chunk_size]
+            t = templates[lbl]
+            t = np.repeat(np.repeat(t, ry, axis=1), rx, axis=2)[:, :h, :w, :]
+            img = t + noise * rng.standard_normal(t.shape, dtype=np.float32)
+            yield (
+                np.clip(img * 255.0, 0.0, 255.0).astype(np.uint8),
+                lbl,
+            )
+
+    write_array_dataset(
+        root, split, chunks(), n=n, image_shape=image_shape,
+        num_classes=num_classes, name="imagenet-synthetic",
+        extra_meta=gen_params,
+    )
+    return load_array_dataset(root, split)
 
 
 def _load_cifar10(data_dir: str, split: str) -> Optional[Dataset]:

@@ -22,14 +22,14 @@ _NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
 # ABI-versioned filename (matches native/Makefile TARGET): a stale build
 # from an older ABI simply has a different name and is never picked up —
 # dlopen's per-pathname handle caching makes same-name reloads impossible.
-_SO_NAME = "libddp_loader.v2.so"
+_SO_NAME = "libddp_loader.v3.so"
 
 _lib = None
 _lib_lock = threading.Lock()
 _build_attempted = False
 
 
-_ABI_VERSION = 2  # keep in sync with dl_version() in native/dataloader.cpp
+_ABI_VERSION = 3  # keep in sync with dl_version() in native/dataloader.cpp
 
 
 def _load_library() -> Optional[ctypes.CDLL]:
@@ -48,6 +48,7 @@ def _load_library() -> Optional[ctypes.CDLL]:
         lib.dl_create.restype = ctypes.c_void_p
         lib.dl_create.argtypes = [
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int32,
         ]
         lib.dl_destroy.argtypes = [ctypes.c_void_p]
         lib.dl_gather.argtypes = [
@@ -84,14 +85,20 @@ def _try_build() -> None:
 class _NativeGather:
     """Callable gather backed by the C++ library.
 
-    Holds contiguous fp32/int32 views of the dataset for the library's
-    zero-copy wrap; keeps them referenced for the handle's lifetime.
+    Wraps the dataset's own storage zero-copy in its own dtype: fp32
+    arrays stay fp32, uint8 stays uint8 (4x less memory traffic), and a
+    memmapped corpus is wrapped at its mapped address — the C++ memcpy
+    then streams pages from disk through the OS page cache. References
+    are held for the handle's lifetime.
     """
 
     def __init__(self, lib: ctypes.CDLL, dataset: Dataset):
         self._lib = lib
-        self._images = np.ascontiguousarray(dataset.images, dtype=np.float32)
+        # already-contiguous arrays (incl. .npy memmaps) pass through as
+        # views — no copy, no fp32 materialization
+        self._images = np.ascontiguousarray(dataset.images)
         self._labels = np.ascontiguousarray(dataset.labels, dtype=np.int32)
+        self._dtype = self._images.dtype
         self._sample_shape = self._images.shape[1:]
         self._sample_elems = int(np.prod(self._sample_shape))
         self._handle = lib.dl_create(
@@ -99,12 +106,13 @@ class _NativeGather:
             self._labels.ctypes.data_as(ctypes.c_void_p),
             len(self._images),
             self._sample_elems,
+            self._dtype.itemsize,
         )
 
     def __call__(self, indices: np.ndarray):
         idx = np.ascontiguousarray(indices, dtype=np.int64)
         n = len(idx)
-        out_images = np.empty((n,) + self._sample_shape, np.float32)
+        out_images = np.empty((n,) + self._sample_shape, self._dtype)
         out_labels = np.empty((n,), np.int32)
         status = self._lib.dl_gather(
             self._handle,

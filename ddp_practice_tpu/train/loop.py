@@ -14,7 +14,6 @@ step is one compiled XLA program; throughput is reported as images/sec/chip
 
 from __future__ import annotations
 
-import time
 from typing import Optional
 
 import jax
@@ -32,10 +31,25 @@ from ddp_practice_tpu.parallel.ring import set_current_mesh
 from ddp_practice_tpu.parallel.sharding_rules import param_sharding_rules
 from ddp_practice_tpu.train.state import create_state, make_optimizer
 from ddp_practice_tpu.train.steps import make_eval_step, make_train_step
-from ddp_practice_tpu.utils.logging import get_logger
-from ddp_practice_tpu.utils.profiling import step_annotation
+from ddp_practice_tpu.utils.logging import get_logger, main_process_only
+from ddp_practice_tpu.utils.profiling import profile_region, step_annotation
+from ddp_practice_tpu.utils.timing import Timer
 
 log = get_logger()
+
+
+def _future_ready(x) -> bool:
+    """Best-effort completion check for a device scalar (False when the
+    runtime can't say — the probe then just confirms this older rung)."""
+    try:
+        return bool(x.is_ready())
+    except (AttributeError, RuntimeError):
+        return False
+
+# side effects on process 0 only (ddp_main.py:158-169); collectives and
+# device work above these gates still run on every process
+info0 = main_process_only(log.info)
+warn0 = main_process_only(log.warning)
 
 
 class Trainer:
@@ -57,10 +71,13 @@ class Trainer:
         self.global_batch = config.batch_size * self.dp
         shard = ShardSpec(dist.process_index(), dist.process_count())
         self.train_ds = load_dataset(
-            config.dataset, config.data_dir, "train", seed=config.seed
+            config.dataset, config.data_dir, "train", seed=config.seed,
+            synthetic_size=config.synthetic_size or None,
         )
         self.eval_ds = load_dataset(
-            config.dataset, config.data_dir, "test", seed=config.seed
+            config.dataset, config.data_dir, "test", seed=config.seed,
+            synthetic_size=(max(config.synthetic_size // 6, 1)
+                            if config.synthetic_size else None),
         )
         self.train_loader = DataLoader(
             self.train_ds,
@@ -181,9 +198,8 @@ class Trainer:
             self.state = ckpt.restore(
                 config.checkpoint_dir, self.state, shardings=self.state_shardings
             )
-            if dist.is_main_process():
-                log.info("resumed from %s at step %d",
-                         config.checkpoint_dir, int(self.state.step))
+            info0("resumed from %s at step %d",
+                  config.checkpoint_dir, int(self.state.step))
 
         self._train_images = 0
         self._train_seconds = 0.0
@@ -194,6 +210,50 @@ class Trainer:
         # ride ICI and overlap is the point).
         self._serialize_steps = jax.default_backend() == "cpu"
         self._watchdog = None
+        # ladder of per-step scalar futures (see _probe_if_due)
+        from collections import deque
+
+        self._pending = deque()
+
+    def _track(self, scalar) -> None:
+        """Record one step's scalar metric future on the progress ladder."""
+        if self._watchdog is not None:
+            self._pending.append(scalar)
+
+    def _probe_if_due(self, prev: int, cur: int) -> None:
+        """Watchdog probe on CONFIRMED device progress, when due: either the
+        starvation rule (half the timeout without a beat) or a step-count
+        boundary of watchdog_probe_every_steps crossed between prev and cur
+        (boundary crossing, not modulo: chunked steps advance by K).
+
+        The probe fetches the OLDEST unconfirmed step's scalar, never the
+        newest: under async dispatch the host runs arbitrarily far ahead of
+        the device, and fetching the newest step's metrics would block on
+        the entire in-flight backlog — a healthy-but-behind device would
+        then look hung and be killed. Fetching one rung past the last
+        confirmed point blocks for at most one step of device time, so the
+        watchdog fires exactly when NO step completes within the timeout.
+        Already-completed rungs are skipped via is_ready() (if is_ready
+        under-reports, probes just re-confirm older rungs — detection
+        stays monotone, only delayed)."""
+        n = self.config.watchdog_probe_every_steps
+        if self._watchdog is None or not self._pending:
+            return
+        if self._watchdog.probe_due() or (n and prev // n != cur // n):
+            while len(self._pending) > 1 and _future_ready(self._pending[0]):
+                self._pending.popleft()
+            self._watchdog.probe(self._pending.popleft())
+
+    def _drain_pending(self) -> None:
+        """Confirm every remaining ladder rung (beating on each) before an
+        end-of-phase fence: the monolithic block_until_ready/device_get at
+        epoch or eval end waits on the whole in-flight backlog, and without
+        intermediate beats a healthy-but-behind device would look hung."""
+        if self._watchdog is None:
+            self._pending.clear()
+            return
+        while self._pending:
+            self._watchdog.probe(self._pending.popleft())
 
     # ------------------------------------------------------------------ #
 
@@ -218,7 +278,8 @@ class Trainer:
             )
         last_metrics = {}
         final_metrics = None
-        t0 = time.perf_counter()
+        self._pending.clear()
+        timer = Timer()
         images_this_epoch = 0
         # profile a steady-state window (post-compile) of the first epoch,
         # shrunk to fit short (smoke) epochs
@@ -231,10 +292,8 @@ class Trainer:
             stop = min(start + 10, n)
             if stop > start:
                 profile_window = (start, stop)
-            elif dist.is_main_process():
-                log.warning(
-                    "profile_dir set but epoch has %d steps — skipping trace", n
-                )
+            else:
+                warn0("profile_dir set but epoch has %d steps — skipping trace", n)
         profiling = False
         steps_done = 0
         try:
@@ -275,16 +334,8 @@ class Trainer:
                     jax.block_until_ready(metrics)
                 prev = steps_done
                 steps_done += inc
-                probe_steps = cfg.watchdog_probe_every_steps
-                if self._watchdog is not None and (
-                    self._watchdog.probe_due()  # never starve past timeout/2
-                    or (probe_steps and prev // probe_steps
-                        != steps_done // probe_steps)
-                ):
-                    # confirmed device progress, not dispatch: fetch a
-                    # scalar from this step's metrics (blocks until the
-                    # whole chain up to it has executed)
-                    self._watchdog.probe(metrics["loss"])
+                self._track(metrics["loss"])
+                self._probe_if_due(prev, steps_done)
                 if cfg.sync_check_every_steps and (
                     prev // cfg.sync_check_every_steps
                     != steps_done // cfg.sync_check_every_steps
@@ -305,13 +356,13 @@ class Trainer:
                     last_metrics = jax.device_get(metrics)
                     if self._watchdog is not None:
                         self._watchdog.beat()  # the device_get confirmed progress
-                    if dist.is_main_process():
-                        log.info(
-                            "epoch %d step %d loss %.4f acc %.3f",
-                            epoch, steps_done,
-                            float(last_metrics["loss"]),
-                            float(last_metrics["accuracy"]),
-                        )
+                    info0(
+                        "epoch %d step %d loss %.4f acc %.3f",
+                        epoch, steps_done,
+                        float(last_metrics["loss"]),
+                        float(last_metrics["accuracy"]),
+                    )
+            self._drain_pending()  # rung-by-rung: beats during the wait
             jax.block_until_ready(self.state.params)
             if final_metrics is not None:
                 # a scalar readback is the only progress signal that fences
@@ -324,7 +375,7 @@ class Trainer:
             items.close()  # stop the prefetch producer thread promptly
             if profiling:  # short epoch or mid-window failure: close trace
                 jax.profiler.stop_trace()
-        dt = time.perf_counter() - t0
+        dt = timer.elapsed()
         self._train_images += images_this_epoch
         self._train_seconds += dt
         return {"epoch_seconds": dt, "images": images_this_epoch}
@@ -337,23 +388,23 @@ class Trainer:
         )
         correct = jnp.zeros((), jnp.float32)
         total = jnp.zeros((), jnp.float32)
+        self._pending.clear()
         try:
-            n_eval = 0
-            for batch in it:
-                c, t = self.eval_step(self.state, batch)
-                if self._serialize_steps:
-                    jax.block_until_ready(c)
-                correct = correct + c
-                total = total + t
-                n_eval += 1
-                probe_steps = self.config.watchdog_probe_every_steps
-                if self._watchdog is not None and (
-                    self._watchdog.probe_due()
-                    or (probe_steps and n_eval % probe_steps == 0)
-                ):
-                    self._watchdog.probe(c)
+            # trace annotation: eval separates from train on device timelines
+            with profile_region("eval"):
+                n_eval = 0
+                for batch in it:
+                    c, t = self.eval_step(self.state, batch)
+                    if self._serialize_steps:
+                        jax.block_until_ready(c)
+                    correct = correct + c
+                    total = total + t
+                    n_eval += 1
+                    self._track(c)
+                    self._probe_if_due(n_eval - 1, n_eval)
         finally:
             it.close()  # stop the prefetch producer thread promptly
+        self._drain_pending()  # rung-by-rung: beats during the wait
         acc = float(correct) / max(float(total), 1.0)  # readback = confirmed
         if self._watchdog is not None:
             self._watchdog.beat()
@@ -388,7 +439,7 @@ class Trainer:
 
     def _fit_inner(self) -> dict:
         cfg = self.config
-        t_start = time.perf_counter()
+        timer = Timer()
         accuracy: Optional[float] = None
         # after a checkpoint restore, continue from the epoch the restored
         # step count falls in — lost work is bounded by one checkpoint
@@ -398,23 +449,21 @@ class Trainer:
             steps_per_epoch = min(steps_per_epoch, cfg.max_steps_per_epoch)
         start_epoch = min(int(self.state.step) // max(steps_per_epoch, 1),
                           cfg.epochs)
-        if start_epoch and dist.is_main_process():
-            log.info("resuming at epoch %d (step %d)",
-                     start_epoch, int(self.state.step))
+        if start_epoch:
+            info0("resuming at epoch %d (step %d)",
+                  start_epoch, int(self.state.step))
         for epoch in range(start_epoch, cfg.epochs):
-            if dist.is_main_process():
-                log.info("=== epoch %d / %d ===", epoch + 1, cfg.epochs)
+            info0("=== epoch %d / %d ===", epoch + 1, cfg.epochs)
             self.train_epoch(epoch)
             if cfg.eval_every_epochs and (epoch + 1) % cfg.eval_every_epochs == 0:
                 accuracy = self.evaluate()
-                if dist.is_main_process():
-                    log.info("Accuracy is %.2f%%", accuracy * 100.0)
+                info0("Accuracy is %.2f%%", accuracy * 100.0)
             if cfg.checkpoint_every_epochs and (epoch + 1) % cfg.checkpoint_every_epochs == 0:
                 self.save()
         if accuracy is None or not cfg.eval_every_epochs:
             accuracy = self.evaluate()
         self.save()
-        elapsed = time.perf_counter() - t_start
+        elapsed = timer.elapsed()
         ips = self._train_images / max(self._train_seconds, 1e-9)
         summary = {
             "accuracy": accuracy,
@@ -426,12 +475,11 @@ class Trainer:
             "global_batch": self.global_batch,
             "devices": jax.device_count(),
         }
-        if dist.is_main_process():
-            # the reference's three parity-visible lines (SURVEY §5.5)
-            log.info("Accuracy is %.2f%%", accuracy * 100.0)
-            log.info("time elapsed: %.2fs", elapsed)
-            log.info("throughput: %.1f images/sec (%.1f /chip)",
-                     ips, ips / jax.device_count())
+        # the reference's three parity-visible lines (SURVEY §5.5)
+        info0("Accuracy is %.2f%%", accuracy * 100.0)
+        info0("time elapsed: %.2fs", elapsed)
+        info0("throughput: %.1f images/sec (%.1f /chip)",
+              ips, ips / jax.device_count())
         return summary
 
 

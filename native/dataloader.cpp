@@ -20,16 +20,19 @@
 namespace {
 
 struct Dataset {
-  const float* images;    // (n, sample_elems) row-major
+  const uint8_t* images;  // (n, sample_elems) row-major, elem_bytes each
   const int32_t* labels;  // (n,)
   int64_t n;
-  int64_t sample_elems;
+  int64_t row_bytes;      // sample_elems * elem_bytes
 };
 
+// Byte-level rows: the same gather serves fp32 (MNIST/CIFAR in RAM) and
+// uint8 (ImageNet-scale memmap) storage; for a memmapped corpus the
+// memcpy's source reads fault pages in from disk, so this doubles as the
+// streaming read path.
 void gather_range(const Dataset& ds, const int64_t* indices, int64_t begin,
-                  int64_t end, float* out_images, int32_t* out_labels,
+                  int64_t end, uint8_t* out_images, int32_t* out_labels,
                   std::atomic<bool>* oob) {
-  const size_t row_bytes = static_cast<size_t>(ds.sample_elems) * sizeof(float);
   for (int64_t i = begin; i < end; ++i) {
     int64_t src = indices[i];
     if (src < 0) src += ds.n;      // numpy-style negative wrapping
@@ -37,8 +40,9 @@ void gather_range(const Dataset& ds, const int64_t* indices, int64_t begin,
       oob->store(true, std::memory_order_relaxed);
       return;
     }
-    std::memcpy(out_images + i * ds.sample_elems,
-                ds.images + src * ds.sample_elems, row_bytes);
+    std::memcpy(out_images + i * ds.row_bytes,
+                ds.images + src * ds.row_bytes,
+                static_cast<size_t>(ds.row_bytes));
     out_labels[i] = ds.labels[src];
   }
 }
@@ -48,9 +52,11 @@ void gather_range(const Dataset& ds, const int64_t* indices, int64_t begin,
 extern "C" {
 
 // Wraps caller-owned arrays; caller guarantees their lifetime.
-void* dl_create(const float* images, const int32_t* labels, int64_t n,
-                int64_t sample_elems) {
-  return new Dataset{images, labels, n, sample_elems};
+// elem_bytes is the per-element width (4 for fp32, 1 for uint8).
+void* dl_create(const void* images, const int32_t* labels, int64_t n,
+                int64_t sample_elems, int32_t elem_bytes) {
+  return new Dataset{static_cast<const uint8_t*>(images), labels, n,
+                     sample_elems * elem_bytes};
 }
 
 void dl_destroy(void* handle) { delete static_cast<Dataset*>(handle); }
@@ -60,8 +66,9 @@ void dl_destroy(void* handle) { delete static_cast<Dataset*>(handle); }
 // success, -1 if any index is out of [0, n) — mirroring the numpy
 // backend's IndexError instead of reading out-of-bounds memory.
 int32_t dl_gather(void* handle, const int64_t* indices, int64_t count,
-                  float* out_images, int32_t* out_labels,
+                  void* out_images_v, int32_t* out_labels,
                   int32_t num_threads) {
+  uint8_t* out_images = static_cast<uint8_t*>(out_images_v);
   const Dataset& ds = *static_cast<Dataset*>(handle);
   std::atomic<bool> oob{false};
   int64_t nthreads = num_threads > 0
@@ -89,6 +96,6 @@ int32_t dl_gather(void* handle, const int64_t* indices, int64_t count,
   return oob.load() ? -1 : 0;
 }
 
-int32_t dl_version() { return 2; }
+int32_t dl_version() { return 3; }
 
 }  // extern "C"

@@ -57,10 +57,16 @@ def test_two_process_distributed(tmp_path):
     except subprocess.TimeoutExpired:
         for p in procs:
             p.kill()
-        pytest.fail(
-            "multi-process workers timed out\n"
-            + "\n".join(p.stdout.read() if p.stdout else "" for p in procs)
-        )
+        # collect what each worker said: communicate() after kill for the
+        # hung ones; workers that already finished have closed pipes, so
+        # their captured output comes from `outputs`
+        for p in procs[len(outputs):]:
+            try:
+                out, _ = p.communicate(timeout=30)
+            except (subprocess.SubprocessError, ValueError, OSError):
+                out = "<no output captured>"
+            outputs.append(out)
+        pytest.fail("multi-process workers timed out\n" + "\n".join(outputs))
     for i, (p, out) in enumerate(zip(procs, outputs)):
         assert p.returncode == 0, f"worker {i} rc={p.returncode}\n{out}"
         assert "ALL_OK" in out, f"worker {i} did not reach ALL_OK\n{out}"
