@@ -39,7 +39,7 @@ _SUITE = {
         image_shape=(32, 32, 3), batch_size=256, steps_per_call=16, calls=6,
     ),
     "resnet50": dict(
-        image_shape=(224, 224, 3), num_classes=1000, batch_size=64,
+        image_shape=(224, 224, 3), num_classes=1000, batch_size=128,
         steps_per_call=8, calls=4, pool_size=512,
     ),
 }

@@ -92,7 +92,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--loader", default="auto", choices=["auto", "native", "python"])
     p.add_argument("--steps_per_call", type=int, default=1,
                    help="K optimizer steps per jitted call (amortizes host "
-                        "dispatch + H2D for small models)")
+                        "dispatch + H2D for small models); -1 = the whole "
+                        "epoch per call (device-resident data only)")
+    p.add_argument("--data_placement", default="auto",
+                   choices=["auto", "host", "device"],
+                   help="corpus home: device = upload once to HBM, epochs "
+                        "driven by index grids alone; host = stream batches; "
+                        "auto = device when single-process and it fits")
+    p.add_argument("--compile_cache", default="auto",
+                   help="persistent XLA compilation cache dir (repeat runs "
+                        "skip compile); auto = ~/.cache/ddp_practice_tpu/xla, "
+                        "off = disable")
     p.add_argument("--json", action="store_true", help="print summary as JSON")
     return p
 
@@ -136,6 +146,8 @@ def config_from_args(args) -> TrainConfig:
         profile_dir=args.profile_dir,
         loader_backend=args.loader,
         steps_per_call=args.steps_per_call,
+        data_placement=args.data_placement,
+        compilation_cache=args.compile_cache,
     )
 
 

@@ -204,8 +204,33 @@ class TrainConfig:
     prefetch: int = 2
     # K optimizer steps per jitted call (lax.scan over stacked batches);
     # amortizes host dispatch + H2D latency for small models. 1 = off.
+    # -1 = the whole epoch per call (device-resident data only: the scan
+    # gathers batches from HBM, so no per-chunk feeding is needed).
     steps_per_call: int = 1
+    # where the corpus lives during training: "host" streams batches (the
+    # DataLoader/prefetch path), "device" uploads the whole uint8 corpus to
+    # HBM once and sends only per-epoch index grids (single-process only),
+    # "auto" picks device when single-process and the corpus fits
+    # resident_max_bytes. Same batches and math either way; agreement is
+    # to float noise (different XLA programs associate reductions
+    # differently — tests/test_resident.py pins the bound).
+    data_placement: str = "auto"       # auto | host | device
+    resident_max_bytes: int = 256 * 1024 * 1024
+    # persistent XLA compilation cache: repeat runs skip compile entirely
+    # (measured on the parity run: ~20-30 s cold -> 6-15 s warm, PARITY.md).
+    # "auto" = ~/.cache/ddp_practice_tpu/xla (or $JAX_COMPILATION_CACHE_DIR
+    # when set); "off" disables; any other value is used as the directory.
+    compilation_cache: str = "auto"
     shuffle_eval: bool = False  # the reference baseline shuffles eval; don't (SURVEY §2.5)
+
+    def __post_init__(self):
+        if self.steps_per_call == -1 or self.steps_per_call >= 1:
+            return
+        raise ValueError(
+            f"steps_per_call={self.steps_per_call}: must be >= 1 (K steps "
+            "per dispatch) or exactly -1 (whole epoch per dispatch, "
+            "device-resident data only)"
+        )
 
     def precision_policy(self) -> PrecisionPolicy:
         return PrecisionPolicy.from_name(self.precision)

@@ -63,14 +63,30 @@ class DataLoader:
     def __len__(self) -> int:
         return self.steps_per_epoch
 
-    def __iter__(self) -> Iterator[dict]:
+    def epoch_plan(self) -> tuple:
+        """The epoch's GLOBAL batch plan as (indices, weights) grids of
+        shape (steps, global_batch) — the exact order __iter__ walks, before
+        per-process slicing. Device-resident training feeds these grids
+        straight to make_resident_train_step/make_resident_eval_step: the
+        sampler semantics (seed/epoch permutation, wrap-padding with zero
+        eval weights) stay in this one place."""
+        order, weights = self._epoch_order()
+        b = self.global_batch_size
+        return (
+            order.reshape(-1, b).astype(np.int32),
+            weights.reshape(-1, b),
+        )
+
+    def _epoch_order(self) -> tuple:
         n = len(self.dataset)
         order = epoch_indices(n, seed=self.seed, epoch=self._epoch, shuffle=self.shuffle)
         if self.drop_last:
             usable = (n // self.global_batch_size) * self.global_batch_size
-            order, weights = order[:usable], np.ones(usable, dtype=np.float32)
-        else:
-            order, weights = pad_to_multiple(order, self.global_batch_size)
+            return order[:usable], np.ones(usable, dtype=np.float32)
+        return pad_to_multiple(order, self.global_batch_size)
+
+    def __iter__(self) -> Iterator[dict]:
+        order, weights = self._epoch_order()
         sl = self.shard.local_slice(self.global_batch_size)
         for start in range(0, len(order), self.global_batch_size):
             gidx = order[start : start + self.global_batch_size]

@@ -52,9 +52,33 @@ info0 = main_process_only(log.info)
 warn0 = main_process_only(log.warning)
 
 
+def _enable_compilation_cache(setting: str) -> None:
+    """Point XLA's persistent compilation cache somewhere durable so repeat
+    runs skip compile (the dominant cost of short runs: the parity
+    experiment drops 28.5 s -> 10.0 s warm, PARITY.md). The reference has
+    no equivalent — CUDA kernels arrive precompiled; XLA programs are
+    compiled per (program, shapes) and this cache is the TPU-native answer.
+    Idempotent; respects an explicit $JAX_COMPILATION_CACHE_DIR."""
+    if setting == "off":
+        return
+    import os
+
+    path = setting
+    if setting == "auto":
+        path = os.environ.get("JAX_COMPILATION_CACHE_DIR") or os.path.join(
+            os.path.expanduser("~"), ".cache", "ddp_practice_tpu", "xla"
+        )
+    try:
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+    except (OSError, AttributeError) as e:  # unwritable dir: run uncached
+        log.warning("compilation cache disabled: %s", e)
+
+
 class Trainer:
     def __init__(self, config: TrainConfig):
         self.config = config
+        _enable_compilation_cache(config.compilation_cache)
         dist.initialize(
             config.coordinator_address, config.num_processes, config.process_id
         )
@@ -193,6 +217,63 @@ class Trainer:
             state_shardings=self.state_shardings,
             batch_shardings=self.batch_shardings,
         )
+        # device-resident data: corpus uploaded to HBM once, epochs driven
+        # by index grids alone (no per-batch H2D) — see _train_epoch_resident
+        self.resident_train_step = None
+        self.resident_eval_step = None
+        if self._use_resident_data():
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from ddp_practice_tpu.parallel.mesh import replicated
+            from ddp_practice_tpu.train.steps import (
+                make_resident_eval_step,
+                make_resident_train_step,
+            )
+
+            rep = replicated(self.mesh)
+            self._grid_sharding = NamedSharding(
+                self.mesh, P(None, MeshConfig.AXIS_DATA)
+            )
+            self._train_data = {
+                "image": jax.device_put(np.asarray(self.train_ds.images), rep),
+                "label": jax.device_put(np.asarray(self.train_ds.labels), rep),
+            }
+            self._eval_data = {
+                "image": jax.device_put(np.asarray(self.eval_ds.images), rep),
+                "label": jax.device_put(np.asarray(self.eval_ds.labels), rep),
+            }
+            self.resident_train_step = make_resident_train_step(
+                self.model,
+                self.tx,
+                label_smoothing=config.label_smoothing,
+                mesh=self.mesh,
+                state_shardings=self.state_shardings,
+            )
+            self.resident_eval_step = make_resident_eval_step(
+                self.model,
+                mesh=self.mesh,
+                state_shardings=self.state_shardings,
+            )
+        elif config.steps_per_call == -1:
+            raise ValueError(
+                "steps_per_call=-1 (whole epoch per dispatch) needs "
+                "device-resident data; got data_placement="
+                f"{config.data_placement!r}"
+                + (" in a multi-process run" if dist.process_count() > 1 else "")
+                + " — use data_placement='device' (single process) or a "
+                "positive steps_per_call"
+            )
+        self.chunk_eval_step = None
+        if config.steps_per_call > 1:
+            from ddp_practice_tpu.train.steps import make_chunked_eval_step
+
+            self.chunk_eval_step = make_chunked_eval_step(
+                self.model,
+                num_steps=config.steps_per_call,
+                mesh=self.mesh,
+                state_shardings=self.state_shardings,
+                batch_shardings=self.batch_shardings,
+            )
 
         if config.resume and config.checkpoint_dir and ckpt.exists(config.checkpoint_dir):
             self.state = ckpt.restore(
@@ -257,30 +338,216 @@ class Trainer:
 
     # ------------------------------------------------------------------ #
 
-    def train_epoch(self, epoch: int) -> dict:
+    def _use_resident_data(self) -> bool:
+        """Decide the corpus's home. 'device' demands it (and single-process
+        addressability); 'auto' takes it when it fits; 'host' never."""
         cfg = self.config
-        self.train_loader.set_epoch(epoch)  # ≡ sampler.set_epoch (ddp_main.py:160)
-        k = max(1, cfg.steps_per_call if self.chunk_step is not None else 1)
+        if cfg.data_placement == "host":
+            return False
+        multi = dist.process_count() > 1
+        if cfg.data_placement == "device":
+            if multi:
+                raise ValueError(
+                    "data_placement='device' requires a single process: the "
+                    "whole corpus must be addressable to upload it; "
+                    "multi-host runs stream with data_placement='host'"
+                )
+            return True
+        if cfg.data_placement != "auto":
+            raise ValueError(
+                f"unknown data_placement {cfg.data_placement!r} "
+                "(auto | host | device)"
+            )
+        nbytes = sum(
+            ds.images.nbytes + ds.labels.nbytes
+            for ds in (self.train_ds, self.eval_ds)
+        )
+        return not multi and nbytes <= cfg.resident_max_bytes
+
+    def _resident_group(self, total_steps: int) -> int:
+        """Steps per dispatch in resident mode: the whole epoch at
+        steps_per_call=-1, else the configured chunk (min 1).
+
+        With a watchdog enabled, the group is capped at
+        watchdog_probe_every_steps: the watchdog's contract is that a
+        probe blocks for at most ~one dispatch group of device time
+        (_probe_if_due), so a whole-epoch group would turn every probe
+        into an epoch-long blocking wait with no beats — a timeout
+        shorter than compile+epoch would then kill a healthy run.
+        Bounded groups keep hang detection and dispatch amortization
+        both honest."""
+        k = self.config.steps_per_call
+        g = max(total_steps, 1) if k == -1 else max(k, 1)
+        if self.config.watchdog_timeout_s:
+            g = min(g, max(self.config.watchdog_probe_every_steps, 1))
+        return g
+
+    def _after_train_group(self, epoch: int, prev: int, steps_done: int,
+                           metrics) -> None:
+        """Post-dispatch bookkeeping shared by the host and resident train
+        loops: progress ladder + watchdog probe, cross-host driver sync
+        check, and the log-every readback (which doubles as a confirmed-
+        progress beat). Boundary-crossing tests, not modulo: groups
+        advance by K."""
+        cfg = self.config
+        self._track(metrics["loss"])
+        self._probe_if_due(prev, steps_done)
+        if cfg.sync_check_every_steps and (
+            prev // cfg.sync_check_every_steps
+            != steps_done // cfg.sync_check_every_steps
+        ):
+            from ddp_practice_tpu.train.elastic import assert_in_sync
+
+            # host-side counter, NOT device state: detects driver-loop
+            # drift (skewed data exhaustion, missed batches) — SURVEY §5.2
+            assert_in_sync(
+                epoch * self.train_loader.steps_per_epoch + steps_done,
+                what="driver step",
+            )
+        if cfg.log_every_steps and (
+            prev // cfg.log_every_steps != steps_done // cfg.log_every_steps
+        ):
+            m = jax.device_get(metrics)
+            if self._watchdog is not None:
+                self._watchdog.beat()  # the device_get confirmed progress
+            info0(
+                "epoch %d step %d loss %.4f acc %.3f",
+                epoch, steps_done, float(m["loss"]), float(m["accuracy"]),
+            )
+
+    def _close_train_epoch(self, final_metrics) -> None:
+        """End-of-epoch fence shared by both train loops: drain the probe
+        ladder rung by rung (beats during the wait), then close timing on
+        a scalar readback — the only progress signal that fences on every
+        transport (block_until_ready may not — BENCHMARKS.md)."""
+        self._drain_pending()
+        jax.block_until_ready(self.state.params)
+        if final_metrics is not None:
+            jax.device_get(final_metrics["loss"])
+            if self._watchdog is not None:
+                self._watchdog.beat()
+
+    def _train_epoch_resident(self, epoch: int) -> dict:
+        """One epoch against the HBM-resident corpus: the only H2D traffic
+        is the (steps, batch) int32 index grid (~4·S·B bytes — for MNIST at
+        bs 32, ~240 KB/epoch vs ~47 MB of pixels), sliced into groups of
+        `_resident_group` rows per dispatch. With steps_per_call=-1 the
+        epoch is ONE XLA call. Numerically equivalent to the host path:
+        same (seed, epoch) plan (DataLoader.epoch_plan), same batches, same
+        math — agreement is to float noise (the two compile as different
+        XLA programs, so reductions associate differently; <= 2 ulps
+        measured, tests/test_resident.py).
+
+        With profile_dir, the trace covers the whole first epoch (the first
+        group includes compile; use bench.py for steady-state traces)."""
+        cfg = self.config
+        self.train_loader.set_epoch(epoch)
+        idx, _ = self.train_loader.epoch_plan()
+        if cfg.max_steps_per_epoch:
+            idx = idx[: cfg.max_steps_per_epoch]
+        total = len(idx)
+        g = self._resident_group(total)
+        final_metrics = None
+        self._pending.clear()
+        timer = Timer()
+        # host-side global step base for trace labels (resume-aware); the
+        # state is quiescent at epoch start so this readback is free
+        step_base = int(self.state.step)
+        steps_done = 0
+        profiling = False
+        if cfg.profile_dir and epoch == 0:
+            jax.profiler.start_trace(cfg.profile_dir)
+            profiling = True
+        try:
+            for g0 in range(0, total, g):
+                rows = jax.device_put(idx[g0 : g0 + g], self._grid_sharding)
+                with step_annotation(step_base + steps_done):
+                    self.state, metrics = self.resident_train_step(
+                        self.state, self._train_data, rows
+                    )
+                if self._serialize_steps:
+                    jax.block_until_ready(metrics)
+                inc = min(g, total - g0)
+                prev = steps_done
+                steps_done += inc
+                final_metrics = metrics
+                self._after_train_group(epoch, prev, steps_done, metrics)
+            self._close_train_epoch(final_metrics)
+        finally:
+            if profiling:
+                jax.profiler.stop_trace()
+        dt = timer.elapsed()
+        images = self.global_batch * steps_done
+        self._train_images += images
+        self._train_seconds += dt
+        return {"epoch_seconds": dt, "images": images}
+
+    def _evaluate_resident(self) -> float:
+        """Exact global accuracy from the HBM-resident eval corpus; the
+        padded tail carries zero weights in the plan grid, so the weighted
+        counts match the host path bit for bit."""
+        idx, w = self.eval_loader.epoch_plan()
+        total_rows = len(idx)
+        g = self._resident_group(total_rows)
+        correct = jnp.zeros((), jnp.float32)
+        total = jnp.zeros((), jnp.float32)
+        self._pending.clear()
+        with profile_region("eval"):
+            n_eval = 0
+            for g0 in range(0, total_rows, g):
+                di = jax.device_put(idx[g0 : g0 + g], self._grid_sharding)
+                dw = jax.device_put(w[g0 : g0 + g], self._grid_sharding)
+                c, t = self.resident_eval_step(
+                    self.state, self._eval_data, di, dw
+                )
+                if self._serialize_steps:
+                    jax.block_until_ready(c)
+                correct = correct + c
+                total = total + t
+                prev = n_eval
+                n_eval += min(g, total_rows - g0)
+                self._track(c)
+                self._probe_if_due(prev, n_eval)
+        self._drain_pending()
+        acc = float(correct) / max(float(total), 1.0)
+        if self._watchdog is not None:
+            self._watchdog.beat()
+        return acc
+
+    def _tagged_batches(self, loader, k: int):
+        """Prefetched ("chunk"|"single", device_batch) stream: K-stacked
+        chunks when k > 1, per-batch otherwise — one selection point for
+        both the train and eval loops."""
         if k > 1:
             from ddp_practice_tpu.data.loader import prefetch_chunked
 
-            items = prefetch_chunked(
-                iter(self.train_loader), k,
+            return prefetch_chunked(
+                iter(loader), k,
                 self.batch_shardings, self.stacked_shardings,
-                size=cfg.prefetch,
+                size=self.config.prefetch,
             )
-        else:
-            items = (
-                ("single", b) for b in prefetch_to_device(
-                    iter(self.train_loader), self.batch_shardings,
-                    size=cfg.prefetch,
-                )
+        return (
+            ("single", b) for b in prefetch_to_device(
+                iter(loader), self.batch_shardings,
+                size=self.config.prefetch,
             )
-        last_metrics = {}
+        )
+
+    def train_epoch(self, epoch: int) -> dict:
+        if self.resident_train_step is not None:
+            return self._train_epoch_resident(epoch)
+        cfg = self.config
+        self.train_loader.set_epoch(epoch)  # ≡ sampler.set_epoch (ddp_main.py:160)
+        k = max(1, cfg.steps_per_call if self.chunk_step is not None else 1)
+        items = self._tagged_batches(self.train_loader, k)
         final_metrics = None
         self._pending.clear()
         timer = Timer()
         images_this_epoch = 0
+        # host-side global step base for trace labels (resume-aware); the
+        # state is quiescent at epoch start, and a host counter — unlike
+        # int(self.state.step) per group — never blocks on in-flight steps
+        step_base = int(self.state.step)
         # profile a steady-state window (post-compile) of the first epoch,
         # shrunk to fit short (smoke) epochs
         profile_window = None
@@ -312,7 +579,7 @@ class Trainer:
                 ):
                     jax.profiler.start_trace(cfg.profile_dir)
                     profiling = True
-                with step_annotation(int(self.state.step)):
+                with step_annotation(step_base + steps_done):
                     remaining = (
                         cfg.max_steps_per_epoch - steps_done
                         if cfg.max_steps_per_epoch else None
@@ -334,43 +601,10 @@ class Trainer:
                     jax.block_until_ready(metrics)
                 prev = steps_done
                 steps_done += inc
-                self._track(metrics["loss"])
-                self._probe_if_due(prev, steps_done)
-                if cfg.sync_check_every_steps and (
-                    prev // cfg.sync_check_every_steps
-                    != steps_done // cfg.sync_check_every_steps
-                ):
-                    from ddp_practice_tpu.train.elastic import assert_in_sync
-
-                    # host-side counter, NOT device state: detects driver-loop
-                    # drift (skewed data exhaustion, missed batches) — SURVEY §5.2
-                    assert_in_sync(
-                        epoch * self.train_loader.steps_per_epoch + steps_done,
-                        what="driver step",
-                    )
                 images_this_epoch += self.global_batch * inc
                 final_metrics = metrics
-                if cfg.log_every_steps and (
-                    prev // cfg.log_every_steps != steps_done // cfg.log_every_steps
-                ):
-                    last_metrics = jax.device_get(metrics)
-                    if self._watchdog is not None:
-                        self._watchdog.beat()  # the device_get confirmed progress
-                    info0(
-                        "epoch %d step %d loss %.4f acc %.3f",
-                        epoch, steps_done,
-                        float(last_metrics["loss"]),
-                        float(last_metrics["accuracy"]),
-                    )
-            self._drain_pending()  # rung-by-rung: beats during the wait
-            jax.block_until_ready(self.state.params)
-            if final_metrics is not None:
-                # a scalar readback is the only progress signal that fences
-                # on every transport (block_until_ready may not —
-                # BENCHMARKS.md), so epoch timing closes on it
-                jax.device_get(final_metrics["loss"])
-                if self._watchdog is not None:
-                    self._watchdog.beat()
+                self._after_train_group(epoch, prev, steps_done, metrics)
+            self._close_train_epoch(final_metrics)
         finally:
             items.close()  # stop the prefetch producer thread promptly
             if profiling:  # short epoch or mid-window failure: close trace
@@ -382,10 +616,15 @@ class Trainer:
 
     def evaluate(self) -> float:
         """Global exact accuracy; all processes participate in the reduction
-        (the all-ranks-call-the-collective contract, ddp_main.py:164,108-109)."""
-        it = prefetch_to_device(
-            iter(self.eval_loader), self.batch_shardings, size=self.config.prefetch
-        )
+        (the all-ranks-call-the-collective contract, ddp_main.py:164,108-109).
+
+        With steps_per_call > 1, K eval batches run per dispatch (scan),
+        mirroring the chunked train path; the padded-tail weights keep the
+        result exact either way."""
+        if self.resident_eval_step is not None:
+            return self._evaluate_resident()
+        k = max(1, self.config.steps_per_call if self.chunk_eval_step else 1)
+        it = self._tagged_batches(self.eval_loader, k)
         correct = jnp.zeros((), jnp.float32)
         total = jnp.zeros((), jnp.float32)
         self._pending.clear()
@@ -393,15 +632,21 @@ class Trainer:
             # trace annotation: eval separates from train on device timelines
             with profile_region("eval"):
                 n_eval = 0
-                for batch in it:
-                    c, t = self.eval_step(self.state, batch)
+                for tag, batch in it:
+                    if tag == "chunk":
+                        c, t = self.chunk_eval_step(self.state, batch)
+                        inc = k
+                    else:
+                        c, t = self.eval_step(self.state, batch)
+                        inc = 1
                     if self._serialize_steps:
                         jax.block_until_ready(c)
                     correct = correct + c
                     total = total + t
-                    n_eval += 1
+                    prev = n_eval
+                    n_eval += inc
                     self._track(c)
-                    self._probe_if_due(n_eval - 1, n_eval)
+                    self._probe_if_due(prev, n_eval)
         finally:
             it.close()  # stop the prefetch producer thread promptly
         self._drain_pending()  # rung-by-rung: beats during the wait

@@ -94,6 +94,62 @@ def test_prefetch_chunked_order_and_tail(devices):
     np.testing.assert_array_equal(np.asarray(got[2][1]["label"]), np.full(8, 8))
 
 
+def test_chunked_eval_matches_per_batch(devices):
+    """K-batches-per-call eval sums the same weighted counts as the
+    per-batch step, including a weighted (padded) tail batch."""
+    from ddp_practice_tpu.train.steps import make_chunked_eval_step, make_eval_step
+
+    mesh = build_mesh(MeshConfig(data=8))
+    cfg = TrainConfig(optimizer="sgd", learning_rate=1e-2)
+    model = create_model("convnet")
+    tx = make_optimizer(cfg)
+
+    def init_fn(r):
+        return create_state(model, tx, rng=r, sample_input=jnp.zeros((1, 28, 28, 1)))
+
+    abstract = jax.eval_shape(init_fn, jax.random.PRNGKey(0))
+    shardings = shard_state(abstract, mesh, None)
+    state = jax.jit(init_fn, out_shardings=shardings)(jax.random.PRNGKey(0))
+    bsh = batch_sharding(mesh)
+    eval_step = make_eval_step(model, mesh=mesh, state_shardings=shardings,
+                               batch_shardings=bsh)
+    chunk_eval = make_chunked_eval_step(
+        model, num_steps=4, mesh=mesh, state_shardings=shardings,
+        batch_shardings=bsh,
+    )
+
+    batches = [_batch(8, seed=100 + s) for s in range(4)]
+    batches[-1]["weight"][5:] = 0.0  # padded tail
+    c_ref = t_ref = 0.0
+    for b in batches:
+        c, t = eval_step(state, {k: jnp.asarray(v) for k, v in b.items()})
+        c_ref += float(c)
+        t_ref += float(t)
+    stacked = {
+        k: jnp.asarray(np.stack([b[k] for b in batches])) for k in batches[0]
+    }
+    c_chunk, t_chunk = chunk_eval(state, stacked)
+    assert t_ref == float(t_chunk) == 8 * 3 + 5
+    np.testing.assert_allclose(c_ref, float(c_chunk), rtol=1e-6)
+
+
+def test_trainer_chunked_eval_end_to_end(devices):
+    """Trainer.evaluate with steps_per_call > 1 equals the per-batch path."""
+    from ddp_practice_tpu.train.loop import Trainer
+
+    base = dict(
+        dataset="synthetic", epochs=1, batch_size=4, optimizer="adam",
+        learning_rate=1e-3, log_every_steps=0, max_steps_per_epoch=4,
+        mesh=MeshConfig(data=-1),
+        data_placement="host",  # this test is about the host chunk path
+    )
+    # evaluate at identical (seeded) init: isolates the eval path — train
+    # parity between chunked and single steps is proven separately above
+    acc_chunk = Trainer(TrainConfig(steps_per_call=4, **base)).evaluate()
+    acc_plain = Trainer(TrainConfig(**base)).evaluate()
+    assert acc_chunk == acc_plain
+
+
 def test_trainer_chunked_epoch(devices):
     """Trainer with steps_per_call > 1 trains the same number of steps."""
     from ddp_practice_tpu.train.loop import Trainer
@@ -102,6 +158,7 @@ def test_trainer_chunked_epoch(devices):
         dataset="synthetic", epochs=1, batch_size=4, optimizer="adam",
         learning_rate=1e-3, log_every_steps=0, steps_per_call=4,
         max_steps_per_epoch=12, mesh=MeshConfig(data=-1),
+        data_placement="host",
     )
     tr = Trainer(cfg)
     tr.train_epoch(0)
@@ -117,6 +174,7 @@ def test_trainer_chunked_step_cap_not_divisible(devices):
         dataset="synthetic", epochs=1, batch_size=4, optimizer="adam",
         learning_rate=1e-3, log_every_steps=0, steps_per_call=4,
         max_steps_per_epoch=10, mesh=MeshConfig(data=-1),
+        data_placement="host",
     )
     tr = Trainer(cfg)
     tr.train_epoch(0)

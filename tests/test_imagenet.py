@@ -178,6 +178,7 @@ def test_trainer_end_to_end_on_imagenet_corpus(tmp_path):
         batch_size=2,
         max_steps_per_epoch=2,
         log_every_steps=0,
+        data_placement="host",  # this test is about memmap STREAMING
     )
     trainer = Trainer(cfg)
     assert isinstance(trainer.train_ds.images, np.memmap)
