@@ -42,6 +42,18 @@ _SUITE = {
         image_shape=(224, 224, 3), num_classes=1000, batch_size=128,
         steps_per_call=8, calls=4, pool_size=512,
     ),
+    # long-context LM entries (kind="lm" -> bench_lm_train: tokens/sec +
+    # MFU; causal flash attention). Not in the default list — run with
+    # `--models lm_long` / `--models lm_8k`.
+    "lm_long": dict(
+        kind="lm", seq_len=2048, batch_size=8, steps_per_call=4, calls=4,
+    ),
+    "lm_8k": dict(
+        kind="lm", seq_len=8192, batch_size=2, steps_per_call=2, calls=3,
+    ),
+    "lm_16k": dict(
+        kind="lm", seq_len=16384, batch_size=1, steps_per_call=2, calls=3,
+    ),
 }
 
 
@@ -55,7 +67,7 @@ def main(argv=None) -> int:
     p.add_argument("--calls", type=int, default=0, help="override")
     args = p.parse_args(argv)
 
-    from ddp_practice_tpu.benchmarks import bench_train
+    from ddp_practice_tpu.benchmarks import bench_lm_train, bench_train
 
     results = []
     errors = []
@@ -65,6 +77,7 @@ def main(argv=None) -> int:
         p.error(f"no bench config for {unknown}; known: {sorted(_SUITE)}")
     for name in names:
         kw = dict(_SUITE[name])
+        kind = kw.pop("kind", "image")
         kw["precision"] = args.precision
         if args.batch_size:
             kw["batch_size"] = args.batch_size
@@ -73,7 +86,12 @@ def main(argv=None) -> int:
         if args.calls:
             kw["calls"] = args.calls
         try:
-            results.append(bench_train(name, **kw))
+            if kind == "lm":
+                r = bench_lm_train("lm_base", **kw)
+                r["model"] = name
+                results.append(r)
+            else:
+                results.append(bench_train(name, **kw))
         except Exception:  # noqa: BLE001 — a failed model must not kill the line
             errors.append({"model": name, "error": traceback.format_exc(limit=3)})
 
@@ -85,6 +103,13 @@ def main(argv=None) -> int:
         return 1
 
     head = results[0]
+    head_rate = head.get(
+        "images_per_sec_per_chip", head.get("tokens_per_sec_per_chip", 0.0)
+    )
+    head_unit = (
+        "images/sec/chip" if "images_per_sec_per_chip" in head
+        else "tokens/sec/chip"
+    )
     convnet = next((r for r in results if r["model"] == "convnet"), None)
     if convnet:
         vs_baseline = round(
@@ -96,11 +121,9 @@ def main(argv=None) -> int:
             "publishes no transformer numbers"
         )
     else:
-        vs_baseline = round(
-            head["images_per_sec_per_chip"] / REFERENCE_IMAGES_PER_SEC, 3
-        )
+        vs_baseline = round(head_rate / REFERENCE_IMAGES_PER_SEC, 3)
         vs_note = (
-            f"CROSS-MODEL ratio: {head['model']} images/sec over the "
+            f"CROSS-MODEL ratio: {head['model']} {head_unit} over the "
             "reference's ConvNet/MNIST ~7,923 img/s (README.md:201) — no "
             "convnet entry ran in this invocation; rerun with "
             "--models convnet,... for the like-for-like number"
@@ -111,8 +134,8 @@ def main(argv=None) -> int:
             f"{head['precision']}, {head['n_chips']} chip(s), "
             f"{head['device_kind']})"
         ),
-        "value": head["images_per_sec_per_chip"],
-        "unit": "images/sec/chip",
+        "value": head_rate,
+        "unit": head_unit,
         "vs_baseline": vs_baseline,
         "vs_baseline_note": vs_note,
         "extras": results[1:],

@@ -194,3 +194,146 @@ def bench_train(
         return out
     finally:
         set_current_mesh(None)
+
+
+def bench_lm_train(
+    model_name: str = "lm_base",
+    *,
+    seq_len: int = 2048,
+    vocab_size: int = 32768,
+    batch_size: int = 8,
+    steps_per_call: int = 4,
+    calls: int = 4,
+    warmup_calls: int = 1,
+    precision: str = "bf16",
+    attn_impl: str = "flash",
+    optimizer: str = "adamw",
+    learning_rate: float = 3e-4,
+    model_kwargs: Optional[dict] = None,
+    seed: int = 0,
+) -> dict:
+    """Steady-state LM training throughput at long sequence length:
+    tokens/sec/chip + MFU. Same fenced-timing methodology as bench_train;
+    token batches are drawn on device (randint — measuring compute rate,
+    not convergence). Default kernel is the Pallas flash path: at seq 2k+
+    the O(seq^2) dense score materialization is exactly what the tiled
+    kernel exists to avoid."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from ddp_practice_tpu.config import MeshConfig, PrecisionPolicy, TrainConfig
+    from ddp_practice_tpu.models import create_model
+    from ddp_practice_tpu.parallel.mesh import (
+        batch_sharding,
+        build_mesh,
+        replicated,
+        shard_state,
+    )
+    from ddp_practice_tpu.parallel.ring import set_current_mesh
+    from ddp_practice_tpu.parallel.sharding_rules import param_sharding_rules
+    from ddp_practice_tpu.train.state import create_state, make_optimizer
+    from ddp_practice_tpu.train.steps import _lm_train_step_fn
+    from ddp_practice_tpu.utils.flops import chip_peak_flops, lm_train_flops_per_token
+
+    mesh = build_mesh(MeshConfig(data=-1))
+    set_current_mesh(mesh)
+    try:
+        policy = PrecisionPolicy.from_name(precision)
+        kwargs = dict(
+            vocab_size=vocab_size, max_len=seq_len, attn_impl=attn_impl
+        )
+        kwargs.update(model_kwargs or {})
+        model = create_model(model_name, policy=policy, **kwargs)
+        tcfg = TrainConfig(
+            model=model_name, optimizer=optimizer, learning_rate=learning_rate
+        )
+        tx = make_optimizer(tcfg)
+
+        sample = jnp.zeros((batch_size, seq_len), jnp.int32)
+
+        def init_fn(r):
+            return create_state(model, tx, rng=r, sample_input=sample)
+
+        abstract = jax.eval_shape(init_fn, jax.random.PRNGKey(seed))
+        rules = param_sharding_rules(model_name)
+        state_shardings = shard_state(abstract, mesh, rules)
+        state = jax.jit(init_fn, out_shardings=state_shardings)(
+            jax.random.PRNGKey(seed)
+        )
+
+        rep = replicated(mesh)
+        bsh = batch_sharding(mesh)
+        step_fn = _lm_train_step_fn(model, tx)
+        base_key = jax.random.PRNGKey(seed + 1)
+        k_steps = steps_per_call
+
+        def chunk(state):
+            def body(st, key):
+                tokens = jax.random.randint(
+                    key, (batch_size, seq_len + 1), 0, vocab_size,
+                    dtype=jnp.int32,
+                )
+                batch = {"tokens": lax.with_sharding_constraint(tokens, bsh)}
+                return step_fn(st, batch)
+
+            keys = jax.random.split(
+                jax.random.fold_in(base_key, state.step), k_steps
+            )
+            state, ms = lax.scan(body, state, keys)
+            return state, jax.tree.map(lambda v: v[-1], ms)
+
+        jchunk = jax.jit(
+            chunk,
+            donate_argnums=0,
+            in_shardings=(state_shardings,),
+            out_shardings=(state_shardings, rep),
+        )
+
+        import time
+
+        for _ in range(max(warmup_calls, 1)):
+            state, metrics = jchunk(state)
+        _fence = float(metrics["loss"])
+
+        t0 = time.perf_counter()
+        for _ in range(calls):
+            state, metrics = jchunk(state)
+        final_loss = float(metrics["loss"])
+        dt = time.perf_counter() - t0
+
+        n_chips = jax.device_count()
+        tokens = calls * k_steps * batch_size * seq_len
+        tps = tokens / dt
+        tps_chip = tps / n_chips
+        device_kind = jax.devices()[0].device_kind
+        flops_tok = lm_train_flops_per_token(
+            hidden_dim=model.hidden_dim, depth=model.depth,
+            mlp_dim=model.mlp_dim, vocab_size=vocab_size, seq_len=seq_len,
+            causal=True,
+        )
+        out = {
+            "model": model_name,
+            "seq_len": seq_len,
+            "vocab_size": vocab_size,
+            "batch_size": batch_size,
+            "steps_per_call": k_steps,
+            "precision": precision,
+            "attn_impl": attn_impl,
+            "device_kind": device_kind,
+            "n_chips": n_chips,
+            "tokens_per_sec": round(tps, 1),
+            "tokens_per_sec_per_chip": round(tps_chip, 1),
+            "ms_per_step": round(dt / (calls * k_steps) * 1e3, 3),
+            "final_loss": round(final_loss, 4),
+            "train_flops_per_token": flops_tok,
+        }
+        tflops_chip = tps_chip * flops_tok / 1e12
+        out["tflops_per_chip"] = round(tflops_chip, 2)
+        peak = chip_peak_flops(device_kind)
+        if peak:
+            out["mfu_pct"] = round(100.0 * tflops_chip * 1e12 / peak, 2)
+            out["peak_bf16_tflops"] = peak / 1e12
+        return out
+    finally:
+        set_current_mesh(None)

@@ -17,6 +17,7 @@ from ddp_practice_tpu.models.resnet import ResNet, ResNet18, ResNet50
 from ddp_practice_tpu.models.vit import ViT, ViTBase, ViTTiny
 from ddp_practice_tpu.models.pipeline_vit import PipelinedViT
 from ddp_practice_tpu.models.vit_moe import ViTMoE
+from ddp_practice_tpu.models.lm import LMBase, LMTiny, TransformerLM
 
 _REGISTRY = {}
 
@@ -117,6 +118,26 @@ def _vit_tiny_moe(*, num_classes, policy, axis_name, **kw):
     )
 
 
+@register("lm_tiny")
+def _lm_tiny(*, num_classes, policy, axis_name, **kw):
+    # LMs have a vocab, not classes: num_classes/axis_name are accepted for
+    # registry uniformity and ignored (vocab_size is an explicit kwarg)
+    return LMTiny(
+        dtype=policy.compute_dtype,
+        param_dtype=policy.param_dtype,
+        **kw,
+    )
+
+
+@register("lm_base")
+def _lm_base(*, num_classes, policy, axis_name, **kw):
+    return LMBase(
+        dtype=policy.compute_dtype,
+        param_dtype=policy.param_dtype,
+        **kw,
+    )
+
+
 @register("vit_tiny_pipe")
 def _vit_tiny_pipe(*, num_classes, policy, axis_name, **kw):
     kw.setdefault("hidden_dim", 192)
@@ -143,4 +164,7 @@ __all__ = [
     "ViTBase",
     "PipelinedViT",
     "ViTMoE",
+    "TransformerLM",
+    "LMTiny",
+    "LMBase",
 ]

@@ -1,0 +1,109 @@
+"""Decoder-only transformer language model — the long-context flagship.
+
+Nothing like this exists in the reference (a 2-conv MNIST CNN,
+origin_main.py:9-31); this is the model family that exercises the
+framework's long-context machinery at the scale it was built for:
+causal attention through `ops.attention.dot_product_attention`, so one
+flag each selects the Pallas flash kernel (`attn_impl="flash"`, O(seq)
+training memory) and sequence parallelism over the 'seq' mesh axis
+(`seq_axis=...`, ring K/V rotation or Ulysses head scatter) — the same
+composition matrix as the ViTs, now with the future masked.
+
+TPU notes: the block stack reuses `models.vit.EncoderBlock` (pre-LN,
+causal=True), so the tensor-parallel PartitionSpec rules that match the
+ViT param names (`parallel/sharding_rules.py`) apply unchanged. The
+embedding table and the (untied) output projection both shard over
+'tensor' by name. Logits are fp32 (softmax stability under bf16 compute).
+
+Wired surfaces: `bench.py --models lm_long` (tokens/sec + MFU at long
+sequence on the real chip), `__graft_entry__.dryrun_multichip` (dp x sp
+causal ring + flash case), `train/steps.py make_lm_train_step` (next-token
+loss), `tests/test_lm.py`.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ddp_practice_tpu.models.vit import EncoderBlock
+
+
+class TransformerLM(nn.Module):
+    vocab_size: int = 256           # byte-level by default
+    max_len: int = 2048
+    hidden_dim: int = 256
+    depth: int = 4
+    num_heads: int = 8
+    mlp_dim: int = 1024
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+    seq_axis: Optional[str] = None  # mesh axis for sequence parallelism
+    sp_impl: str = "ring"
+    attn_impl: str = "xla"
+    axis_name: Optional[str] = None  # registry uniformity (no BN anywhere)
+
+    @nn.compact
+    def __call__(self, tokens, *, train: bool = False):
+        """tokens (batch, seq) int32 -> logits (batch, seq, vocab) fp32."""
+        b, s = tokens.shape
+        if s > self.max_len:
+            raise ValueError(f"sequence {s} exceeds max_len {self.max_len}")
+        x = nn.Embed(
+            self.vocab_size,
+            self.hidden_dim,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            name="tok_embed",
+        )(tokens)
+        pos = self.param(
+            "pos_embed",
+            nn.initializers.normal(stddev=0.02),
+            (1, self.max_len, self.hidden_dim),
+            self.param_dtype,
+        )
+        x = x + pos[:, :s].astype(self.dtype)
+        for i in range(self.depth):
+            x = EncoderBlock(
+                self.num_heads,
+                self.mlp_dim,
+                dtype=self.dtype,
+                param_dtype=self.param_dtype,
+                seq_axis=self.seq_axis,
+                sp_impl=self.sp_impl,
+                attn_impl=self.attn_impl,
+                causal=True,
+                name=f"block{i}",
+            )(x)
+        x = nn.LayerNorm(
+            dtype=self.dtype, param_dtype=self.param_dtype, name="ln_f"
+        )(x)
+        logits = nn.Dense(
+            self.vocab_size,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            name="lm_head",
+        )(x)
+        return logits.astype(jnp.float32)
+
+
+def LMTiny(**kw):
+    """Test-sized decoder (d=256, L=4): the LM numerics/composition pin."""
+    kw.setdefault("hidden_dim", 256)
+    kw.setdefault("depth", 4)
+    kw.setdefault("num_heads", 8)
+    kw.setdefault("mlp_dim", 1024)
+    return TransformerLM(**kw)
+
+
+def LMBase(**kw):
+    """Bench-sized decoder (d=768, L=12, GPT-2-small shape) for the
+    long-context throughput/MFU measurements (bench.py lm_long)."""
+    kw.setdefault("hidden_dim", 768)
+    kw.setdefault("depth", 12)
+    kw.setdefault("num_heads", 12)
+    kw.setdefault("mlp_dim", 3072)
+    kw.setdefault("max_len", 8192)
+    return TransformerLM(**kw)

@@ -95,6 +95,7 @@ class SelfAttention(nn.Module):
     seq_axis: Optional[str] = None  # mesh axis for sequence parallelism
     sp_impl: str = "ring"           # "ring" | "ulysses"
     attn_impl: str = "xla"          # "xla" | "flash" (Pallas kernel)
+    causal: bool = False            # decoder (LM) blocks mask the future
 
     @nn.compact
     def __call__(self, x):
@@ -109,8 +110,8 @@ class SelfAttention(nn.Module):
         )(x)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         out = dot_product_attention(
-            q, k, v, seq_axis=self.seq_axis, sp_impl=self.sp_impl,
-            impl=self.attn_impl,
+            q, k, v, causal=self.causal, seq_axis=self.seq_axis,
+            sp_impl=self.sp_impl, impl=self.attn_impl,
         )
         out = nn.DenseGeneral(
             d,
@@ -130,6 +131,7 @@ class EncoderBlock(nn.Module):
     seq_axis: Optional[str] = None
     sp_impl: str = "ring"
     attn_impl: str = "xla"
+    causal: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -141,6 +143,7 @@ class EncoderBlock(nn.Module):
             seq_axis=self.seq_axis,
             sp_impl=self.sp_impl,
             attn_impl=self.attn_impl,
+            causal=self.causal,
             name="attn",
         )(y)
         x = x + y

@@ -3,26 +3,32 @@
 The reference consumes fused CUDA kernels through torch (cuDNN/cuBLAS —
 SURVEY §2.2 "CUDA/cuDNN kernels"); the TPU-native analogue for the one op
 XLA doesn't already fuse optimally at long sequence length is a hand-tiled
-attention kernel. Forward pass (per q-block, per batch*head grid cell):
+attention kernel. All three kernels are STREAMING: a 3D grid
+(batch*heads, outer-block, inner-block) whose innermost dimension sweeps
+the contracted sequence axis while per-block state lives in VMEM scratch
+— so only one q tile and one k/v tile are VMEM-resident at any moment and
+sequence length is bounded by HBM, not VMEM. Forward, per (q-block,
+k-block) grid step:
 
-    for each k/v block:                       # fori_loop, VMEM-resident
-        s   = q @ k^T * scale                 # MXU, fp32 accumulate
-        m'  = max(m, rowmax(s))               # online softmax rescale
-        acc = acc*exp(m-m') + exp(s-m') @ v   # MXU
-    out = acc / l,   lse = m + log l
+    @when(kj == 0):   m, l, acc := -inf, 0, 0  # scratch init
+    s   = q @ k^T * scale                      # MXU, fp32 accumulate
+    m'  = max(m, rowmax(s))                    # online softmax rescale
+    acc = acc*exp(m-m') + exp(s-m') @ v        # MXU
+    @when(kj == last): out = acc / l, lse = m + log l
 
 so the (seq x seq) score matrix never materializes in HBM — O(seq) memory,
-one pass over K/V. Causal masking prunes whole k-blocks above the diagonal.
+one pass over K/V. Causal masking skips whole k-blocks above the diagonal
+(@when(visible) gates the FLOPs).
 
 Backward is tiled the same way (FlashAttention-2 scheme), recomputing
 p = exp(s - lse) blockwise from the saved logsumexp:
 
     delta = rowsum(do * o)                    # XLA, cheap
-    dKdV kernel (grid over k-blocks): for each q-block:
-        p = exp(q@k^T*scale - lse);  dv += p^T @ do
+    dKdV kernel (grid bh x k-blocks x q-blocks, q innermost):
+        p = exp(q@k^T*scale - lse);  dv += p^T @ do     # scratch accum
         ds = p * (do @ v^T - delta); dk += ds^T @ (q*scale)
-    dQ kernel (grid over q-blocks): for each k-block:
-        dq += (ds @ k) * scale
+    dQ kernel (grid bh x q-blocks x k-blocks, k innermost):
+        dq += (ds @ k) * scale                          # scratch accum
 
 so training memory is O(seq) end to end. `flash_attention_with_lse`
 additionally exposes lse as a differentiable output — the lse cotangent
@@ -33,6 +39,12 @@ attention and merge normalized partials across ring steps
 
 Runs compiled on TPU; `interpret=True` under the CPU backend so the same
 tests cover it everywhere (tests/conftest.py).
+
+Hardware validation (TPU v5e, 2026-07-30, compiled — not interpret):
+fwd+bwd vs a Precision.HIGHEST dense reference at (4, 1024, 8, 64),
+causal and non-causal: max relative grad error 3-7e-3 — MXU default-
+precision (bf16-pass) noise, the same regime XLA's own dense attention
+computes in at default precision.
 """
 
 from __future__ import annotations
@@ -46,66 +58,84 @@ from jax.experimental import pallas as pl
 _NEG_INF = -1e30
 
 
+def _causal_mask(s, qi, kj, block_q, block_k, offset):
+    """Bottom-right-aligned causal mask for one (q-block, k-block) tile:
+    query i attends keys <= i + offset, offset = seq_k - seq_q (matches
+    _attention's tril)."""
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    )
+    k_pos = kj * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1
+    )
+    return jnp.where(q_pos + offset >= k_pos, s, _NEG_INF)
+
+
 def _fwd_kernel(
-    q_ref, k_ref, v_ref, o_ref, lse_ref,
-    *, sm_scale, block_k, causal, q_len_hint,
+    q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+    *, sm_scale, block_q, block_k, causal, seq_q, seq_k,
 ):
-    block_q, head_dim = q_ref.shape
-    seq_k = k_ref.shape[0]
+    """Streaming grid cell (bh, q-block, k-block): k innermost, so only one
+    (block_q, d) + one (block_k, d) tile live in VMEM at a time — sequence
+    length is unbounded by VMEM. Online-softmax state (m, l, acc) persists
+    in scratch across the k sweep; the output block writes on the last k
+    step (Pallas copies revisited out-blocks out once, at the end)."""
     qi = pl.program_id(1)
+    kj = pl.program_id(2)
+    n_k = pl.num_programs(2)
+    offset = seq_k - seq_q if causal else 0
 
-    q = q_ref[:].astype(jnp.float32) * sm_scale
+    @pl.when(kj == 0)
+    def _init():
+        m_scr[:] = jnp.full(m_scr.shape, _NEG_INF, jnp.float32)
+        l_scr[:] = jnp.zeros(l_scr.shape, jnp.float32)
+        acc_scr[:] = jnp.zeros(acc_scr.shape, jnp.float32)
 
-    m0 = jnp.full((block_q,), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q,), jnp.float32)
-    acc0 = jnp.zeros((block_q, head_dim), jnp.float32)
+    # a k-block fully above the diagonal contributes nothing: skip its FLOPs
+    visible = (
+        (qi * block_q + block_q - 1 + offset) >= (kj * block_k)
+        if causal else (kj >= 0)
+    )
 
-    n_k = pl.cdiv(seq_k, block_k)
-    # bottom-right-aligned causal (matches _attention's tril offset sk-sq):
-    # query i attends keys <= i + (seq_k - seq_q)
-    causal_offset = seq_k - q_len_hint if causal else 0
-    if causal:
-        # only k-blocks intersecting the allowed triangle of this q-block
-        n_k = jnp.minimum(
-            n_k, pl.cdiv((qi + 1) * block_q + causal_offset, block_k)
-        )
-
-    def body(j, carry):
-        m, l, acc = carry
-        k = k_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+    @pl.when(visible)
+    def _compute():
+        q = q_ref[:].astype(jnp.float32) * sm_scale
+        k = k_ref[:].astype(jnp.float32)
+        v = v_ref[:].astype(jnp.float32)
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
         if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            k_pos = j * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            s = jnp.where(q_pos + causal_offset >= k_pos, s, _NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+            s = _causal_mask(s, qi, kj, block_q, block_k, offset)
+        m_prev = m_scr[:, 0]
+        l_prev = l_scr[:, 0]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
         p = jnp.exp(s - m_new[:, None])
-        corr = jnp.exp(m - m_new)
-        l_new = l * corr + jnp.sum(p, axis=-1)
-        acc_new = acc * corr[:, None] + jnp.dot(
+        corr = jnp.exp(m_prev - m_new)
+        l_scr[:] = (l_prev * corr + jnp.sum(p, axis=-1))[:, None]
+        acc_scr[:] = acc_scr[:] * corr[:, None] + jnp.dot(
             p, v, preferred_element_type=jnp.float32
         )
-        return m_new, l_new, acc_new
+        m_scr[:] = m_new[:, None]
 
-    m, l, acc = jax.lax.fori_loop(0, n_k, body, (m0, l0, acc0))
-    l_safe = jnp.maximum(l, 1e-30)
-    o_ref[:] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-    lse_ref[:] = (m + jnp.log(l_safe))[:, None]  # (block_q, 1) lane-padded
+    @pl.when(kj == n_k - 1)
+    def _finalize():
+        l_safe = jnp.maximum(l_scr[:, 0], 1e-30)
+        o_ref[:] = (acc_scr[:] / l_safe[:, None]).astype(o_ref.dtype)
+        lse_ref[:] = (m_scr[:, 0] + jnp.log(l_safe))[:, None]
+
+
+def _fit_block(seq, block):
+    """Largest block <= the requested size that divides seq (blocks are
+    upper bounds, not contracts: seq 1536 with default block_k 1024 fits
+    down to 512 instead of erroring; seq <= block clamps to seq)."""
+    block = min(block, seq)
+    while seq % block:
+        block //= 2
+    return max(block, 1)
 
 
 def _check_blocks(seq_q, seq_k, block_q, block_k, causal):
-    block_q = min(block_q, seq_q)
-    block_k = min(block_k, seq_k)
-    if seq_q % block_q or seq_k % block_k:
-        raise ValueError(
-            f"flash attention needs seq divisible by block sizes: "
-            f"q {seq_q}%{block_q}, k {seq_k}%{block_k}"
-        )
+    block_q = _fit_block(seq_q, block_q)
+    block_k = _fit_block(seq_k, block_k)
     if causal and seq_q > seq_k:
         raise ValueError(
             f"causal flash attention needs seq_q <= seq_k (bottom-right "
@@ -117,30 +147,37 @@ def _check_blocks(seq_q, seq_k, block_q, block_k, causal):
 
 def _flash_fwd(q, k, v, *, causal, block_q, block_k, interpret):
     """q/k/v: (bh, seq, d). Returns (out, lse)."""
+    from jax.experimental.pallas import tpu as pltpu
+
     bh, seq_q, d = q.shape
     seq_k = k.shape[1]
     block_q, block_k = _check_blocks(seq_q, seq_k, block_q, block_k, causal)
     sm_scale = 1.0 / (d ** 0.5)
-    grid = (bh, seq_q // block_q)
+    grid = (bh, seq_q // block_q, seq_k // block_k)
     kernel = functools.partial(
-        _fwd_kernel, sm_scale=sm_scale, block_k=block_k, causal=causal,
-        q_len_hint=seq_q,
+        _fwd_kernel, sm_scale=sm_scale, block_q=block_q, block_k=block_k,
+        causal=causal, seq_q=seq_q, seq_k=seq_k,
     )
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((None, seq_k, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((None, seq_k, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((None, block_k, d), lambda b, i, j: (b, j, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((None, block_q, 1), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, block_q, 1), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct(q.shape, q.dtype),
             jax.ShapeDtypeStruct((bh, seq_q, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
         ],
         interpret=interpret,
     )(q, k, v)
@@ -149,101 +186,103 @@ def _flash_fwd(q, k, v, *, causal, block_q, block_k, interpret):
 
 def _dkdv_kernel(
     q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref, dk_ref, dv_ref,
-    *, sm_scale, block_q, causal, q_len_hint,
+    dk_scr, dv_scr,
+    *, sm_scale, block_q, block_k, causal, seq_q, seq_k,
 ):
-    """Grid cell: one k/v block; loops over q blocks (FlashAttention-2)."""
-    block_k, head_dim = k_ref.shape
-    seq_q = q_ref.shape[0]
+    """Streaming grid cell (bh, k-block, q-block): q innermost; dk/dv
+    accumulate in scratch across the q sweep (FlashAttention-2), writing
+    the output block on the last q step. Only one q tile + one k/v tile
+    are VMEM-resident — seq is unbounded by VMEM."""
     ki = pl.program_id(1)
+    qi = pl.program_id(2)
+    n_q = pl.num_programs(2)
+    offset = seq_k - seq_q if causal else 0
 
-    k = k_ref[:].astype(jnp.float32)
-    v = v_ref[:].astype(jnp.float32)
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros(dk_scr.shape, jnp.float32)
+        dv_scr[:] = jnp.zeros(dv_scr.shape, jnp.float32)
 
-    dk0 = jnp.zeros((block_k, head_dim), jnp.float32)
-    dv0 = jnp.zeros((block_k, head_dim), jnp.float32)
+    visible = (
+        (qi * block_q + block_q - 1 + offset) >= (ki * block_k)
+        if causal else (qi >= 0)
+    )
 
-    n_q = pl.cdiv(seq_q, block_q)
-    causal_offset = (k_ref.shape[0] * pl.num_programs(1)) - q_len_hint \
-        if causal else 0
-    q_start = 0
-    if causal:
-        # first q-block whose last row can see this k-block:
-        # q_pos + offset >= k_pos  =>  q_pos >= ki*block_k - offset
-        q_start = jnp.maximum(0, (ki * block_k - causal_offset) // block_q)
-
-    def body(i, carry):
-        dk, dv = carry
-        q = q_ref[pl.ds(i * block_q, block_q), :].astype(jnp.float32) * sm_scale
-        do = do_ref[pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[pl.ds(i * block_q, block_q), :]  # (block_q, 1) fp32
-        delta = delta_ref[pl.ds(i * block_q, block_q), :]
+    @pl.when(visible)
+    def _compute():
+        k = k_ref[:].astype(jnp.float32)
+        v = v_ref[:].astype(jnp.float32)
+        q = q_ref[:].astype(jnp.float32) * sm_scale
+        do = do_ref[:].astype(jnp.float32)
+        lse = lse_ref[:]      # (block_q, 1) fp32
+        delta = delta_ref[:]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
         if causal:
-            q_pos = i * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            k_pos = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            s = jnp.where(q_pos + causal_offset >= k_pos, s, _NEG_INF)
-        p = jnp.exp(s - lse)                       # exact probs (block)
-        dv = dv + jnp.dot(p.T, do, preferred_element_type=jnp.float32)
+            s = _causal_mask(s, qi, ki, block_q, block_k, offset)
+        p = jnp.exp(s - lse)  # exact probs from the saved logsumexp
+        dv_scr[:] = dv_scr[:] + jnp.dot(
+            p.T, do, preferred_element_type=jnp.float32
+        )
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
         ds = p * (dp - delta)
-        dk = dk + jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
-        return dk, dv
+        dk_scr[:] = dk_scr[:] + jnp.dot(
+            ds.T, q, preferred_element_type=jnp.float32
+        )
 
-    dk, dv = jax.lax.fori_loop(q_start, n_q, body, (dk0, dv0))
-    dk_ref[:] = dk.astype(dk_ref.dtype)
-    dv_ref[:] = dv.astype(dv_ref.dtype)
+    @pl.when(qi == n_q - 1)
+    def _finalize():
+        dk_ref[:] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[:] = dv_scr[:].astype(dv_ref.dtype)
 
 
 def _dq_kernel(
-    q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref, dq_ref,
-    *, sm_scale, block_k, causal, q_len_hint,
+    q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref, dq_ref, dq_scr,
+    *, sm_scale, block_q, block_k, causal, seq_q, seq_k,
 ):
-    """Grid cell: one q block; loops over k blocks."""
-    block_q, head_dim = q_ref.shape
-    seq_k = k_ref.shape[0]
+    """Streaming grid cell (bh, q-block, k-block): k innermost; dq
+    accumulates in scratch across the k sweep."""
     qi = pl.program_id(1)
+    kj = pl.program_id(2)
+    n_k = pl.num_programs(2)
+    offset = seq_k - seq_q if causal else 0
 
-    q = q_ref[:].astype(jnp.float32) * sm_scale
-    do = do_ref[:].astype(jnp.float32)
-    lse = lse_ref[:]           # (block_q, 1) fp32
-    delta = delta_ref[:]
+    @pl.when(kj == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros(dq_scr.shape, jnp.float32)
 
-    dq0 = jnp.zeros((block_q, head_dim), jnp.float32)
-    n_k = pl.cdiv(seq_k, block_k)
-    causal_offset = seq_k - q_len_hint if causal else 0
-    if causal:
-        n_k = jnp.minimum(
-            n_k, pl.cdiv((qi + 1) * block_q + causal_offset, block_k)
-        )
+    visible = (
+        (qi * block_q + block_q - 1 + offset) >= (kj * block_k)
+        if causal else (kj >= 0)
+    )
 
-    def body(j, dq):
-        k = k_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+    @pl.when(visible)
+    def _compute():
+        q = q_ref[:].astype(jnp.float32) * sm_scale
+        do = do_ref[:].astype(jnp.float32)
+        lse = lse_ref[:]
+        delta = delta_ref[:]
+        k = k_ref[:].astype(jnp.float32)
+        v = v_ref[:].astype(jnp.float32)
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
         if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            k_pos = j * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            s = jnp.where(q_pos + causal_offset >= k_pos, s, _NEG_INF)
+            s = _causal_mask(s, qi, kj, block_q, block_k, offset)
         p = jnp.exp(s - lse)
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
         ds = p * (dp - delta)
-        return dq + jnp.dot(ds, k, preferred_element_type=jnp.float32)
+        dq_scr[:] = dq_scr[:] + jnp.dot(
+            ds, k, preferred_element_type=jnp.float32
+        )
 
-    dq = jax.lax.fori_loop(0, n_k, body, dq0)
-    dq_ref[:] = (dq * sm_scale).astype(dq_ref.dtype)
+    @pl.when(kj == n_k - 1)
+    def _finalize():
+        dq_ref[:] = (dq_scr[:] * sm_scale).astype(dq_ref.dtype)
 
 
 def _flash_bwd(q, k, v, do, lse, delta, *, causal, block_q, block_k,
                interpret):
     """Tiled dq/dk/dv. delta = rowsum(do*o) - g_lse, fp32 (bh, seq_q)."""
+    from jax.experimental.pallas import tpu as pltpu
+
     bh, seq_q, d = q.shape
     seq_k = k.shape[1]
     block_q, block_k = _check_blocks(seq_q, seq_k, block_q, block_k, causal)
@@ -252,48 +291,53 @@ def _flash_bwd(q, k, v, do, lse, delta, *, causal, block_q, block_k,
     delta3 = delta[..., None].astype(jnp.float32)
 
     dkdv = functools.partial(
-        _dkdv_kernel, sm_scale=sm_scale, block_q=block_q, causal=causal,
-        q_len_hint=seq_q,
+        _dkdv_kernel, sm_scale=sm_scale, block_q=block_q, block_k=block_k,
+        causal=causal, seq_q=seq_q, seq_k=seq_k,
     )
     dk, dv = pl.pallas_call(
         dkdv,
-        grid=(bh, seq_k // block_k),
+        grid=(bh, seq_k // block_k, seq_q // block_q),
         in_specs=[
-            pl.BlockSpec((None, seq_q, d), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((None, seq_q, d), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((None, seq_q, 1), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((None, seq_q, 1), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((None, block_k, d), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((None, block_k, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((None, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((None, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((None, block_q, 1), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((None, block_q, 1), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((None, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((None, block_k, d), lambda b, j, i: (b, j, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((None, block_k, d), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((None, block_k, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((None, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((None, block_k, d), lambda b, j, i: (b, j, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct(k.shape, k.dtype),
             jax.ShapeDtypeStruct(v.shape, v.dtype),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
         interpret=interpret,
     )(q, do, lse3, delta3, k, v)
 
     dqk = functools.partial(
-        _dq_kernel, sm_scale=sm_scale, block_k=block_k, causal=causal,
-        q_len_hint=seq_q,
+        _dq_kernel, sm_scale=sm_scale, block_q=block_q, block_k=block_k,
+        causal=causal, seq_q=seq_q, seq_k=seq_k,
     )
     dq = pl.pallas_call(
         dqk,
-        grid=(bh, seq_q // block_q),
+        grid=(bh, seq_q // block_q, seq_k // block_k),
         in_specs=[
-            pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((None, block_q, 1), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((None, block_q, 1), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((None, seq_k, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((None, seq_k, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, block_q, 1), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, block_q, 1), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((None, block_k, d), lambda b, i, j: (b, j, 0)),
         ],
-        out_specs=pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),
+        out_specs=pl.BlockSpec((None, block_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
     )(q, do, lse3, delta3, k, v)
     return dq, dk, dv
@@ -355,8 +399,8 @@ def flash_attention_with_lse(
     v: jnp.ndarray,
     *,
     causal: bool = False,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = 512,
+    block_k: int = 1024,
 ):
     """Fused attention over folded (b*h, s, d) layout, returning (out, lse).
 
@@ -371,10 +415,16 @@ def flash_attention(
     v: jnp.ndarray,
     *,
     causal: bool = False,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = 512,
+    block_k: int = 1024,
 ) -> jnp.ndarray:
-    """Fused multi-head attention; layout-matches ops.attention._attention."""
+    """Fused multi-head attention; layout-matches ops.attention._attention.
+
+    Default blocks (512, 1024) are the measured sweet spot on TPU v5e for
+    lm_base shapes (head_dim 64): lm bench 34.1% MFU at seq 2048 and
+    27.9% at seq 8192, vs 29%/20% at (256, 512) — kernel sweep
+    2026-07-30, BENCHMARKS.md. Blocks clamp to the sequence length, so
+    short-seq callers (ViT at s=64) are unaffected."""
     b, sq, h, d = q.shape
     sk = k.shape[1]
 

@@ -28,14 +28,22 @@ def cross_entropy(
     """
     logits = logits.astype(jnp.float32)
     num_classes = logits.shape[-1]
-    onehot = jnp.eye(num_classes, dtype=jnp.float32)[labels]
-    if label_smoothing > 0.0:
-        onehot = onehot * (1.0 - label_smoothing) + label_smoothing / num_classes
     logprobs = logits - jnp.max(logits, axis=-1, keepdims=True)
     logprobs = logprobs - jnp.log(
         jnp.sum(jnp.exp(logprobs), axis=-1, keepdims=True)
     )
-    nll = -jnp.sum(onehot * logprobs, axis=-1)
+    # gather the target logprob instead of contracting with a one-hot: a
+    # dense (..., V) one-hot (and the (V, V) eye behind it) is harmless at
+    # 10 classes but allocates gigabytes at LM vocab sizes (V=32768).
+    # Smoothing folds in algebraically: the smoothed one-hot is
+    # (1-ls)*target + ls/V, so nll = (1-ls)*nll_target + ls*mean(-logprobs).
+    nll = -jnp.take_along_axis(
+        logprobs, labels[..., None].astype(jnp.int32), axis=-1
+    )[..., 0]
+    if label_smoothing > 0.0:
+        nll = (1.0 - label_smoothing) * nll + label_smoothing * jnp.mean(
+            -logprobs, axis=-1
+        )
     if weight is None:
         return jnp.mean(nll)
     denom = jnp.maximum(jnp.sum(weight), 1.0)

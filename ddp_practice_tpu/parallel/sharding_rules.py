@@ -76,11 +76,31 @@ def _vit_moe_rule(path, leaf) -> Optional[P]:
     return _vit_rule(path, leaf)
 
 
+def _lm_rule(path, leaf) -> Optional[P]:
+    """Decoder LM: Megatron embedding/vocab sharding on top of the block
+    rules (the block param names are the ViT ones — models/lm.py reuses
+    EncoderBlock). tok_embed (vocab, d) shards the vocab rows; lm_head
+    (d, vocab) is column-parallel over the vocab; pos_embed replicated."""
+    name = keystr(path)
+    if "tok_embed" in name:
+        return P(T, None) if "embedding" in name else None
+    if "lm_head" in name:
+        if "kernel" in name:
+            return P(None, T)
+        return P(T)  # bias (vocab,)
+    if "pos_embed" in name:
+        return None
+    return _vit_rule(path, leaf)
+
+
 _RULES: dict = {
     "vit": _vit_rule,
     "vit_tiny": _vit_rule,
+    "vit_base": _vit_rule,
     "vit_tiny_pipe": _vit_pipe_rule,
     "vit_tiny_moe": _vit_moe_rule,
+    "lm_tiny": _lm_rule,
+    "lm_base": _lm_rule,
 }
 
 

@@ -179,6 +179,130 @@ def make_chunked_train_step(
     return jax.jit(chunk_step, donate_argnums=0)
 
 
+def _lm_train_step_fn(model, tx):
+    """(state, batch) -> (state, metrics) for next-token language modeling.
+
+    batch["tokens"] is (batch, seq+1) int32; position t predicts t+1 (the
+    standard shifted objective). Optional batch["weight"] (batch, seq)
+    masks padded positions out of the mean loss. Metrics report loss,
+    perplexity (exp loss), next-token accuracy, and grad_norm — the LM
+    equivalents of the image metrics in _train_step_fn."""
+
+    def train_step(state: TrainState, batch):
+        tokens = batch["tokens"]
+        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+        weight = batch.get("weight")
+
+        def loss_fn(params):
+            logits = model.apply({"params": params}, inputs, train=True)
+            return cross_entropy(logits, targets, weight=weight), logits
+
+        (loss, logits), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params
+        )
+        updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        correct, total = accuracy_counts(logits, targets, weight=weight)
+        metrics = {
+            "loss": loss,
+            "perplexity": jnp.exp(loss),
+            "accuracy": correct / jnp.maximum(total, 1.0),
+            "grad_norm": optax.global_norm(grads),
+        }
+        new_state = TrainState(
+            step=state.step + 1,
+            params=new_params,
+            batch_stats=None,
+            opt_state=new_opt_state,
+        )
+        return new_state, metrics
+
+    return train_step
+
+
+def make_lm_train_step(
+    model,
+    tx,
+    *,
+    mesh=None,
+    state_shardings=None,
+    batch_shardings=None,
+):
+    """Jitted next-token LM train step; sharding contract identical to
+    make_train_step (batch leaves sharded over 'data' and — for sequence
+    parallelism — the token dim over 'seq')."""
+    train_step = _lm_train_step_fn(model, tx)
+    if mesh is not None and state_shardings is not None:
+        from ddp_practice_tpu.parallel.mesh import replicated
+
+        rep = replicated(mesh)
+        return jax.jit(
+            train_step,
+            in_shardings=(state_shardings, batch_shardings),
+            out_shardings=(state_shardings, rep),
+            donate_argnums=0,
+        )
+    return jax.jit(train_step, donate_argnums=0)
+
+
+def make_chunked_lm_train_step(
+    model,
+    tx,
+    *,
+    num_steps: int,
+    mesh=None,
+    state_shardings=None,
+    batch_shardings=None,
+):
+    """K LM steps per dispatch (`lax.scan` over stacked token batches) —
+    the dispatch-amortization scheme of make_chunked_train_step for the
+    LM objective."""
+    step_fn = _lm_train_step_fn(model, tx)
+
+    def chunk_step(state, batches):
+        state, ms = jax.lax.scan(step_fn, state, batches)
+        return state, jax.tree.map(lambda v: v[-1], ms)
+
+    if mesh is not None and state_shardings is not None:
+        from ddp_practice_tpu.parallel.mesh import replicated
+
+        rep = replicated(mesh)
+        stacked = stack_shardings(batch_shardings)
+        return jax.jit(
+            chunk_step,
+            in_shardings=(state_shardings, stacked),
+            out_shardings=(state_shardings, rep),
+            donate_argnums=0,
+        )
+    return jax.jit(chunk_step, donate_argnums=0)
+
+
+def make_lm_eval_step(model, *, mesh=None, state_shardings=None,
+                      batch_shardings=None):
+    """Jitted LM eval: weighted (correct, total) next-token counts plus
+    summed token NLL — the LM analogues of the image eval contract
+    (accuracy for the parity-visible print, NLL/total = perplexity)."""
+
+    def eval_step(state: TrainState, batch):
+        tokens = batch["tokens"]
+        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+        logits = model.apply({"params": state.params}, inputs, train=False)
+        correct, total = accuracy_counts(logits, targets)
+        nll = cross_entropy(logits, targets) * total
+        return correct, total, nll
+
+    if mesh is not None and state_shardings is not None:
+        from ddp_practice_tpu.parallel.mesh import replicated
+
+        rep = replicated(mesh)
+        return jax.jit(
+            eval_step,
+            in_shardings=(state_shardings, batch_shardings),
+            out_shardings=(rep, rep, rep),
+        )
+    return jax.jit(eval_step)
+
+
 def _resident_gather(data, idx, batch_sharding=None):
     """Materialize one batch from the device-resident corpus: a gather of
     rows `idx` (B,) from each (N, ...) leaf. With the corpus replicated and

@@ -113,6 +113,26 @@ def vit_forward_flops(image_shape=(32, 32, 3), *, patch_size: int = 4,
     return embed + depth * per_layer + head
 
 
+def lm_forward_flops_per_token(*, hidden_dim: int, depth: int, mlp_dim: int,
+                               vocab_size: int, seq_len: int,
+                               causal: bool = True) -> float:
+    """Decoder LM (models/lm.py) forward FLOPs per token. Per layer:
+    8*d^2 (qkv + out projections) + 4*d*mlp (MLP) + attention score/value
+    matmuls 4*s*d, halved under causal masking (each query attends to s/2
+    keys on average — flash skips the masked blocks; the dense path
+    wastes them, so causal MFU there is conservative). Plus the 2*d*V
+    lm_head. Embedding lookups are gathers, not FLOPs."""
+    d, m, v, s = hidden_dim, mlp_dim, vocab_size, seq_len
+    attn = 4.0 * s * d * (0.5 if causal else 1.0)
+    per_layer = 8.0 * d * d + 4.0 * d * m + attn
+    return depth * per_layer + 2.0 * d * v
+
+
+def lm_train_flops_per_token(**kw) -> float:
+    """fwd + bwd FLOPs per token: 3x forward (bwd ~= 2x fwd)."""
+    return 3.0 * lm_forward_flops_per_token(**kw)
+
+
 def train_flops_per_image(model: str, image_shape, num_classes: int = 10,
                           **kw) -> Optional[float]:
     """fwd + bwd FLOPs per image: 3x forward (bwd ~= 2x fwd)."""

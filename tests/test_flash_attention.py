@@ -90,7 +90,24 @@ def test_causal_rejects_longer_queries():
         flash_attention(q, k, k, causal=True)
 
 
-def test_indivisible_seq_rejected():
-    q, k, v = _qkv(s=100, seed=5)
-    with pytest.raises(ValueError):
-        flash_attention(q, k, v, block_q=64, block_k=64)
+def test_block_sizes_fit_down_to_divisors():
+    """Requested blocks are upper bounds: a seq that the default block
+    doesn't divide fits down to the largest dividing power-of-two split
+    instead of erroring (seq 1536 with default block_k 1024 -> 512)."""
+    from ddp_practice_tpu.ops.flash_attention import _fit_block
+
+    assert _fit_block(1536, 1024) == 512
+    assert _fit_block(65, 512) == 65      # seq <= block: clamp to seq
+    assert _fit_block(96, 64) == 32
+    assert _fit_block(2048, 1024) == 1024
+
+
+def test_flash_indivisible_seq_still_works():
+    """seq=96 with requested block 64 (not a divisor): blocks fit down and
+    numerics still match dense — the pre-fit behavior was a ValueError."""
+    q, k, v = _qkv(s=96, seed=5)
+    want = _attention(q, k, v, causal=True)
+    got = flash_attention(q, k, v, causal=True, block_q=64, block_k=64)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5
+    )
