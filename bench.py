@@ -43,8 +43,8 @@ _SUITE = {
         steps_per_call=8, calls=4, pool_size=512,
     ),
     # long-context LM entries (kind="lm" -> bench_lm_train: tokens/sec +
-    # MFU; causal flash attention). Not in the default list — run with
-    # `--models lm_long` / `--models lm_8k`.
+    # MFU; causal flash attention). lm_long runs in the default list; the
+    # longer lengths are opt-in: `--models lm_8k` / `--models lm_16k`.
     "lm_long": dict(
         kind="lm", seq_len=2048, batch_size=8, steps_per_call=4, calls=4,
     ),
@@ -59,7 +59,8 @@ _SUITE = {
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser("bench")
-    p.add_argument("--models", default="vit_base,vit_tiny,convnet,resnet18,resnet50",
+    p.add_argument("--models",
+                   default="vit_base,vit_tiny,convnet,resnet18,resnet50,lm_long",
                    help="comma-separated; first successful is the headline")
     p.add_argument("--precision", default="bf16", choices=["fp32", "bf16"])
     p.add_argument("--batch_size", type=int, default=0, help="override")

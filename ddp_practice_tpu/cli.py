@@ -34,8 +34,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="per data-parallel replica, like the reference")
     p.add_argument("--model", default="convnet",
                    choices=["convnet", "resnet18", "resnet50", "vit_tiny",
-                            "vit_tiny_moe", "vit_tiny_pipe"])
-    p.add_argument("--dataset", default="mnist")
+                            "vit_tiny_moe", "vit_tiny_pipe",
+                            "lm_tiny", "lm_base"])
+    p.add_argument("--dataset", default="mnist",
+                   help="image models: mnist|cifar10|imagenet|synthetic; "
+                        "lm models: text (bytes from --data_dir) or "
+                        "anything else for the synthetic Markov corpus")
+    p.add_argument("--seq_len", type=int, default=256,
+                   help="LM sequence length (lm_* models)")
     p.add_argument("--data_dir", default="./data")
     p.add_argument("--synthetic_size", type=int, default=0,
                    help="synthetic-fallback corpus size (train split; "
@@ -113,6 +119,7 @@ def config_from_args(args) -> TrainConfig:
         dataset=args.dataset,
         data_dir=args.data_dir,
         synthetic_size=args.synthetic_size,
+        seq_len=args.seq_len,
         epochs=args.epochs,
         batch_size=args.batch_size,
         learning_rate=args.lr,

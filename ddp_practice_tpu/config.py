@@ -131,8 +131,12 @@ class TrainConfig:
     data_dir: str = "./data"
     num_classes: int = 10
     # override the synthetic-fallback corpus size (train split; eval gets
-    # ~1/6, the MNIST train:test ratio). 0 = per-dataset default.
+    # ~1/6, the MNIST train:test ratio; for LM tasks this is the token
+    # count). 0 = per-dataset default.
     synthetic_size: int = 0
+    # sequence length for LM models (lm_*): batches are (seq_len + 1)
+    # token windows, position t predicting t + 1
+    seq_len: int = 256
 
     # optimization (reference defaults: origin_main.py:37-52, ddp_main.py:125)
     epochs: int = 3

@@ -30,7 +30,6 @@ from ddp_practice_tpu.parallel.mesh import batch_sharding, build_mesh, shard_sta
 from ddp_practice_tpu.parallel.ring import set_current_mesh
 from ddp_practice_tpu.parallel.sharding_rules import param_sharding_rules
 from ddp_practice_tpu.train.state import create_state, make_optimizer
-from ddp_practice_tpu.train.steps import make_eval_step, make_train_step
 from ddp_practice_tpu.utils.logging import get_logger, main_process_only
 from ddp_practice_tpu.utils.profiling import profile_region, step_annotation
 from ddp_practice_tpu.utils.timing import Timer
@@ -94,31 +93,40 @@ class Trainer:
         # (the reference's "batch 32 per process" contract, README.md:506)
         self.global_batch = config.batch_size * self.dp
         shard = ShardSpec(dist.process_index(), dist.process_count())
-        self.train_ds = load_dataset(
-            config.dataset, config.data_dir, "train", seed=config.seed,
-            synthetic_size=config.synthetic_size or None,
-        )
-        self.eval_ds = load_dataset(
-            config.dataset, config.data_dir, "test", seed=config.seed,
-            synthetic_size=(max(config.synthetic_size // 6, 1)
-                            if config.synthetic_size else None),
-        )
-        self.train_loader = DataLoader(
-            self.train_ds,
-            global_batch_size=self.global_batch,
-            shard=shard,
-            seed=config.seed,
-            shuffle=True,
-            backend=config.loader_backend,
-        )
-        self.eval_loader = DataLoader(
-            self.eval_ds,
-            global_batch_size=self.global_batch,
-            shard=shard,
-            seed=config.seed,
-            shuffle=config.shuffle_eval,
-            backend=config.loader_backend,
-        )
+        # lm_* models train on token streams (data/lm_corpus.py), the image
+        # families on the dataset registry; both honor the same sampler
+        # contract (seed/epoch permutation, per-process shards)
+        self.task = "lm" if config.model.lower().startswith("lm") else "image"
+        if self.task == "lm":
+            self.train_ds = self.eval_ds = None
+            (self.train_loader, self.eval_loader,
+             self._vocab_size) = self._build_lm_data(shard)
+        else:
+            self.train_ds = load_dataset(
+                config.dataset, config.data_dir, "train", seed=config.seed,
+                synthetic_size=config.synthetic_size or None,
+            )
+            self.eval_ds = load_dataset(
+                config.dataset, config.data_dir, "test", seed=config.seed,
+                synthetic_size=(max(config.synthetic_size // 6, 1)
+                                if config.synthetic_size else None),
+            )
+            self.train_loader = DataLoader(
+                self.train_ds,
+                global_batch_size=self.global_batch,
+                shard=shard,
+                seed=config.seed,
+                shuffle=True,
+                backend=config.loader_backend,
+            )
+            self.eval_loader = DataLoader(
+                self.eval_ds,
+                global_batch_size=self.global_batch,
+                shard=shard,
+                seed=config.seed,
+                shuffle=config.shuffle_eval,
+                backend=config.loader_backend,
+            )
 
         # model
         model_kwargs = {}
@@ -156,22 +164,32 @@ class Trainer:
                     f"num_experts={n_exp} not divisible by expert axis {self.ep}"
                 )
             model_kwargs["num_experts"] = n_exp
-        self.model = create_model(
-            config.model,
-            num_classes=self.train_ds.num_classes,
-            policy=policy,
-            axis_name=None,  # GSPMD: batch-axis stats are global by sharding
-            **model_kwargs,
-        )
+        if self.task == "lm":
+            model_kwargs["vocab_size"] = self._vocab_size
+            model_kwargs["max_len"] = config.seq_len
+            self.model = create_model(
+                config.model, policy=policy, **model_kwargs
+            )
+        else:
+            self.model = create_model(
+                config.model,
+                num_classes=self.train_ds.num_classes,
+                policy=policy,
+                axis_name=None,  # GSPMD: batch-axis stats are global by sharding
+                **model_kwargs,
+            )
         self.tx = make_optimizer(config, self.train_loader.steps_per_epoch)
 
         # state, sharded at init (params materialize directly on the mesh)
         rng = jax.random.PRNGKey(config.seed)
         # init with the global batch shape: sequence-parallel models open a
         # shard_map island whose dims must divide the mesh even during init
-        sample = jnp.zeros(
-            (self.global_batch,) + self.train_ds.image_shape, jnp.float32
-        )
+        if self.task == "lm":
+            sample = jnp.zeros((self.global_batch, config.seq_len), jnp.int32)
+        else:
+            sample = jnp.zeros(
+                (self.global_batch,) + self.train_ds.image_shape, jnp.float32
+            )
 
         def init_fn(r):
             return create_state(self.model, self.tx, rng=r, sample_input=sample)
@@ -186,37 +204,40 @@ class Trainer:
         self.state = jax.jit(init_fn, out_shardings=self.state_shardings)(rng)
 
         self.batch_shardings = batch_sharding(self.mesh)
-        self.train_step = make_train_step(
-            self.model,
-            self.tx,
-            label_smoothing=config.label_smoothing,
+        # one construction block for both tasks: only the factories differ
+        # (the step signatures are deliberately uniform, train/steps.py)
+        if self.task == "lm":
+            from ddp_practice_tpu.train.steps import (
+                make_chunked_lm_train_step as chunk_factory,
+                make_lm_eval_step as eval_factory,
+                make_lm_train_step as train_factory,
+            )
+        else:
+            from ddp_practice_tpu.train.steps import (
+                make_chunked_train_step as chunk_factory,
+                make_eval_step as eval_factory,
+                make_train_step as train_factory,
+            )
+        common = dict(
             mesh=self.mesh,
             state_shardings=self.state_shardings,
             batch_shardings=self.batch_shardings,
+        )
+        self.train_step = train_factory(
+            self.model, self.tx,
+            label_smoothing=config.label_smoothing, **common,
         )
         self.chunk_step = None
         if config.steps_per_call > 1:
-            from ddp_practice_tpu.train.steps import (
-                make_chunked_train_step,
-                stack_shardings,
-            )
+            from ddp_practice_tpu.train.steps import stack_shardings
 
             self.stacked_shardings = stack_shardings(self.batch_shardings)
-            self.chunk_step = make_chunked_train_step(
-                self.model,
-                self.tx,
+            self.chunk_step = chunk_factory(
+                self.model, self.tx,
                 num_steps=config.steps_per_call,
-                label_smoothing=config.label_smoothing,
-                mesh=self.mesh,
-                state_shardings=self.state_shardings,
-                batch_shardings=self.batch_shardings,
+                label_smoothing=config.label_smoothing, **common,
             )
-        self.eval_step = make_eval_step(
-            self.model,
-            mesh=self.mesh,
-            state_shardings=self.state_shardings,
-            batch_shardings=self.batch_shardings,
-        )
+        self.eval_step = eval_factory(self.model, **common)
         # device-resident data: corpus uploaded to HBM once, epochs driven
         # by index grids alone (no per-batch H2D) — see _train_epoch_resident
         self.resident_train_step = None
@@ -264,7 +285,7 @@ class Trainer:
                 "positive steps_per_call"
             )
         self.chunk_eval_step = None
-        if config.steps_per_call > 1:
+        if config.steps_per_call > 1 and self.task == "image":
             from ddp_practice_tpu.train.steps import make_chunked_eval_step
 
             self.chunk_eval_step = make_chunked_eval_step(
@@ -284,6 +305,7 @@ class Trainer:
 
         self._train_images = 0
         self._train_seconds = 0.0
+        self.eval_perplexity = None  # set by _evaluate_lm
         # XLA:CPU's in-process collective rendezvous can deadlock when more
         # than one execution of a collective-bearing program is in flight
         # (device threads join different run_ids). On the CPU dev platform,
@@ -338,11 +360,75 @@ class Trainer:
 
     # ------------------------------------------------------------------ #
 
+    def _build_lm_data(self, shard):
+        """Token loaders for the LM task: dataset='text' reads bytes from
+        data_dir (file or directory), anything else (or missing files)
+        falls back to the deterministic synthetic Markov corpus. The last
+        10% of the token stream is the held-out eval split."""
+        from ddp_practice_tpu.data.lm_corpus import (
+            LMDataLoader,
+            TokenCorpus,
+            load_text_corpus,
+            synthetic_token_corpus,
+        )
+
+        cfg = self.config
+        window = cfg.seq_len + 1
+        batch_tokens = self.global_batch * window
+        corpus = None
+        if cfg.dataset == "text":
+            try:
+                corpus = load_text_corpus(cfg.data_dir)
+            except FileNotFoundError:
+                warn0(
+                    "no readable files under %s — using the synthetic "
+                    "Markov corpus", cfg.data_dir,
+                )
+        if corpus is None:
+            # the synthetic default scales with the global batch so both
+            # splits always hold >= one batch of windows on any mesh size
+            corpus = synthetic_token_corpus(
+                cfg.synthetic_size or max(262144, 16 * batch_tokens),
+                seed=cfg.seed,
+            )
+        # eval = 10% of the stream, but never less than one global batch
+        n_eval = max(len(corpus) - int(len(corpus) * 0.9), batch_tokens)
+        n_train = len(corpus) - n_eval
+        if n_train < batch_tokens:
+            raise ValueError(
+                f"corpus {corpus.name} has {len(corpus)} tokens — too few "
+                f"for one train + one eval batch of {batch_tokens} tokens "
+                f"each (global_batch {self.global_batch} x window {window}); "
+                "grow the corpus or shrink batch_size/seq_len"
+            )
+        train_c = TokenCorpus(
+            corpus.tokens[:n_train], corpus.vocab_size, f"{corpus.name}-train"
+        )
+        eval_c = TokenCorpus(
+            corpus.tokens[n_train:], corpus.vocab_size, f"{corpus.name}-eval"
+        )
+
+        def make(c, shuffle):
+            return LMDataLoader(
+                c, seq_len=cfg.seq_len, global_batch_size=self.global_batch,
+                shard=shard, seed=cfg.seed, shuffle=shuffle,
+            )
+
+        return make(train_c, True), make(eval_c, cfg.shuffle_eval), corpus.vocab_size
+
     def _use_resident_data(self) -> bool:
         """Decide the corpus's home. 'device' demands it (and single-process
         addressability); 'auto' takes it when it fits; 'host' never."""
         cfg = self.config
         if cfg.data_placement == "host":
+            return False
+        if self.task == "lm":
+            if cfg.data_placement == "device":
+                raise ValueError(
+                    "data_placement='device' is not composed with the LM "
+                    "task yet: token batches stream from the host "
+                    "(data_placement='host'/'auto')"
+                )
             return False
         multi = dist.process_count() > 1
         if cfg.data_placement == "device":
@@ -621,6 +707,8 @@ class Trainer:
         With steps_per_call > 1, K eval batches run per dispatch (scan),
         mirroring the chunked train path; the padded-tail weights keep the
         result exact either way."""
+        if self.task == "lm":
+            return self._evaluate_lm()
         if self.resident_eval_step is not None:
             return self._evaluate_resident()
         k = max(1, self.config.steps_per_call if self.chunk_eval_step else 1)
@@ -651,6 +739,45 @@ class Trainer:
             it.close()  # stop the prefetch producer thread promptly
         self._drain_pending()  # rung-by-rung: beats during the wait
         acc = float(correct) / max(float(total), 1.0)  # readback = confirmed
+        if self._watchdog is not None:
+            self._watchdog.beat()
+        return acc
+
+    def _evaluate_lm(self) -> float:
+        """Held-out next-token accuracy (the parity-visible number) plus
+        perplexity (exp of mean token NLL, stored on self.eval_perplexity
+        and in the fit summary) — all processes participate, like the
+        image eval."""
+        import math
+
+        it = prefetch_to_device(
+            iter(self.eval_loader), self.batch_shardings,
+            size=self.config.prefetch,
+        )
+        correct = jnp.zeros((), jnp.float32)
+        total = jnp.zeros((), jnp.float32)
+        nll = jnp.zeros((), jnp.float32)
+        self._pending.clear()
+        try:
+            with profile_region("eval"):
+                n_eval = 0
+                for batch in it:
+                    c, t, s = self.eval_step(self.state, batch)
+                    if self._serialize_steps:
+                        jax.block_until_ready(c)
+                    correct = correct + c
+                    total = total + t
+                    nll = nll + s
+                    prev = n_eval
+                    n_eval += 1
+                    self._track(c)
+                    self._probe_if_due(prev, n_eval)
+        finally:
+            it.close()
+        self._drain_pending()
+        t_f = max(float(total), 1.0)
+        acc = float(correct) / t_f
+        self.eval_perplexity = math.exp(min(float(nll) / t_f, 30.0))
         if self._watchdog is not None:
             self._watchdog.beat()
         return acc
@@ -720,6 +847,12 @@ class Trainer:
             "global_batch": self.global_batch,
             "devices": jax.device_count(),
         }
+        if self.task == "lm" and self.eval_perplexity is not None:
+            summary["perplexity"] = self.eval_perplexity
+            summary["tokens_per_sec_per_chip"] = (
+                ips * cfg.seq_len / jax.device_count()
+            )
+            info0("perplexity: %.3f", self.eval_perplexity)
         # the reference's three parity-visible lines (SURVEY §5.5)
         info0("Accuracy is %.2f%%", accuracy * 100.0)
         info0("time elapsed: %.2fs", elapsed)

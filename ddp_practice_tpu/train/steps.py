@@ -179,7 +179,7 @@ def make_chunked_train_step(
     return jax.jit(chunk_step, donate_argnums=0)
 
 
-def _lm_train_step_fn(model, tx):
+def _lm_train_step_fn(model, tx, label_smoothing: float = 0.0):
     """(state, batch) -> (state, metrics) for next-token language modeling.
 
     batch["tokens"] is (batch, seq+1) int32; position t predicts t+1 (the
@@ -195,7 +195,11 @@ def _lm_train_step_fn(model, tx):
 
         def loss_fn(params):
             logits = model.apply({"params": params}, inputs, train=True)
-            return cross_entropy(logits, targets, weight=weight), logits
+            loss = cross_entropy(
+                logits, targets, weight=weight,
+                label_smoothing=label_smoothing,
+            )
+            return loss, logits
 
         (loss, logits), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             state.params
@@ -224,6 +228,7 @@ def make_lm_train_step(
     model,
     tx,
     *,
+    label_smoothing: float = 0.0,
     mesh=None,
     state_shardings=None,
     batch_shardings=None,
@@ -231,7 +236,7 @@ def make_lm_train_step(
     """Jitted next-token LM train step; sharding contract identical to
     make_train_step (batch leaves sharded over 'data' and — for sequence
     parallelism — the token dim over 'seq')."""
-    train_step = _lm_train_step_fn(model, tx)
+    train_step = _lm_train_step_fn(model, tx, label_smoothing)
     if mesh is not None and state_shardings is not None:
         from ddp_practice_tpu.parallel.mesh import replicated
 
@@ -250,6 +255,7 @@ def make_chunked_lm_train_step(
     tx,
     *,
     num_steps: int,
+    label_smoothing: float = 0.0,
     mesh=None,
     state_shardings=None,
     batch_shardings=None,
@@ -257,7 +263,7 @@ def make_chunked_lm_train_step(
     """K LM steps per dispatch (`lax.scan` over stacked token batches) —
     the dispatch-amortization scheme of make_chunked_train_step for the
     LM objective."""
-    step_fn = _lm_train_step_fn(model, tx)
+    step_fn = _lm_train_step_fn(model, tx, label_smoothing)
 
     def chunk_step(state, batches):
         state, ms = jax.lax.scan(step_fn, state, batches)
