@@ -54,6 +54,10 @@ _SUITE = {
     "lm_16k": dict(
         kind="lm", seq_len=16384, batch_size=1, steps_per_call=2, calls=3,
     ),
+    "lm_32k": dict(
+        kind="lm", seq_len=32768, batch_size=1, steps_per_call=1, calls=2,
+        model_kwargs={"remat": True},
+    ),
 }
 
 

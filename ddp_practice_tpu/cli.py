@@ -42,6 +42,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "anything else for the synthetic Markov corpus")
     p.add_argument("--seq_len", type=int, default=256,
                    help="LM sequence length (lm_* models)")
+    p.add_argument("--remat", action="store_true",
+                   help="rematerialize LM block activations in backward "
+                        "(longer sequences for ~1/3 more FLOPs)")
     p.add_argument("--data_dir", default="./data")
     p.add_argument("--synthetic_size", type=int, default=0,
                    help="synthetic-fallback corpus size (train split; "
@@ -52,6 +55,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--weight_decay", type=float, default=0.0)
     p.add_argument("--lr_schedule", default="constant",
                    choices=["constant", "cosine", "warmup_cosine"])
+    p.add_argument("--accum_steps", type=int, default=1,
+                   help="gradient accumulation: average grads over k "
+                        "micro-steps before each optimizer apply")
     p.add_argument("--scale_lr", action="store_true",
                    help="scale lr by replica count (the reference deliberately "
                         "does not; README.md:506)")
@@ -120,6 +126,7 @@ def config_from_args(args) -> TrainConfig:
         data_dir=args.data_dir,
         synthetic_size=args.synthetic_size,
         seq_len=args.seq_len,
+        remat=args.remat,
         epochs=args.epochs,
         batch_size=args.batch_size,
         learning_rate=args.lr,
@@ -128,6 +135,7 @@ def config_from_args(args) -> TrainConfig:
         weight_decay=args.weight_decay,
         lr_schedule=args.lr_schedule,
         scale_lr_by_replicas=args.scale_lr,
+        accum_steps=args.accum_steps,
         seed=args.seed,
         precision=args.precision,
         mesh=MeshConfig(

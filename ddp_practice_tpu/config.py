@@ -137,6 +137,11 @@ class TrainConfig:
     # sequence length for LM models (lm_*): batches are (seq_len + 1)
     # token windows, position t predicting t + 1
     seq_len: int = 256
+    # rematerialize LM block activations in backward (jax.checkpoint):
+    # ~1/3 more FLOPs for O(depth) less activation memory; with the
+    # streaming flash kernels this is what takes lm_base from seq 16k to
+    # 32k on one v5e chip (BENCHMARKS.md)
+    remat: bool = False
 
     # optimization (reference defaults: origin_main.py:37-52, ddp_main.py:125)
     epochs: int = 3
@@ -149,6 +154,12 @@ class TrainConfig:
     warmup_steps: int = 0
     scale_lr_by_replicas: bool = False  # parity default: False (README.md:506)
     label_smoothing: float = 0.0
+    # gradient accumulation: average grads over k micro-steps before the
+    # optimizer applies (optax.MultiSteps) — large effective batches
+    # without the memory; 1 = off. Decaying lr schedules advance once per
+    # optimizer APPLY; make_optimizer divides their horizons (total and
+    # warmup) by k so decay still completes over the run
+    accum_steps: int = 1
 
     # rng (reference: 3407 + rank, ddp_main.py:76-80)
     seed: int = 3407

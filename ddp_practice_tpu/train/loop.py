@@ -167,8 +167,15 @@ class Trainer:
         if self.task == "lm":
             model_kwargs["vocab_size"] = self._vocab_size
             model_kwargs["max_len"] = config.seq_len
+            if config.remat:
+                model_kwargs["remat"] = True
             self.model = create_model(
                 config.model, policy=policy, **model_kwargs
+            )
+        elif config.remat:
+            raise ValueError(
+                "remat is only wired for the LM family (lm_*) — the image "
+                "models at these sizes gain nothing from rematerialization"
             )
         else:
             self.model = create_model(

@@ -35,14 +35,20 @@ def make_optimizer(config: TrainConfig, steps_per_epoch: int = 0) -> optax.Gradi
     lr = config.learning_rate
     if config.scale_lr_by_replicas:
         lr = lr * jax.device_count()
-    total_steps = max(steps_per_epoch * config.epochs, 1)
+    # under gradient accumulation the schedule count advances once per
+    # optimizer APPLY (every accum_steps micro-steps, optax.MultiSteps),
+    # so decay/warmup horizons are in applies, not micro-steps — without
+    # this division a cosine schedule would finish only 1/k of its decay
+    accum = max(config.accum_steps, 1)
+    total_steps = max(steps_per_epoch * config.epochs // accum, 1)
+    warmup = config.warmup_steps // accum
     if config.lr_schedule == "constant":
         schedule = optax.constant_schedule(lr)
     elif config.lr_schedule == "cosine":
         schedule = optax.cosine_decay_schedule(lr, total_steps)
     elif config.lr_schedule == "warmup_cosine":
         schedule = optax.warmup_cosine_decay_schedule(
-            0.0, lr, config.warmup_steps, total_steps
+            0.0, lr, warmup, total_steps
         )
     else:
         raise ValueError(f"unknown lr_schedule {config.lr_schedule!r}")
@@ -57,6 +63,15 @@ def make_optimizer(config: TrainConfig, steps_per_epoch: int = 0) -> optax.Gradi
         raise ValueError(f"unknown optimizer {config.optimizer!r}")
     if config.weight_decay and config.optimizer == "sgd":
         tx = optax.chain(optax.add_decayed_weights(config.weight_decay), tx)
+    if config.accum_steps > 1:
+        # gradient accumulation: average grads over k micro-steps, apply
+        # the inner optimizer on the k-th (optax.MultiSteps). Because it
+        # wraps the GradientTransformation, every driver path — per-step,
+        # chunked scan, device-resident — gets it for free; state.step
+        # counts micro-steps. For losses that are per-batch means (ours),
+        # k micro-batches of size b equal one batch of size k*b exactly
+        # for SGD (tests/test_train.py pins this).
+        tx = optax.MultiSteps(tx, every_k_schedule=config.accum_steps)
     return tx
 
 

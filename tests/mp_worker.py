@@ -162,6 +162,33 @@ def main() -> int:
     np.testing.assert_allclose(got, np.asarray(ref))
     print(f"[{pid}] fsdp sharded save/restore ok")
 
+    # --- LM task multi-process: token shards, grad sync, perplexity ---
+    cfg_lm = TrainConfig(
+        model="lm_tiny",
+        dataset="synthetic_text",
+        batch_size=4,  # x4 global devices = 16 global
+        seq_len=32,
+        synthetic_size=32768,
+        epochs=1,
+        max_steps_per_epoch=3,
+        optimizer="adamw",
+        learning_rate=1e-3,
+        log_every_steps=0,
+        coordinator_address=coord,
+        num_processes=nproc,
+        process_id=pid,
+        mesh=MeshConfig(data=-1),
+    )
+    lm_tr = Trainer(cfg_lm)
+    lm_summary = lm_tr.fit()
+    assert lm_summary["steps"] == 3, lm_summary
+    assert np.isfinite(lm_summary["perplexity"]), lm_summary
+    leaf = jax.tree_util.tree_leaves(lm_tr.state.params)[0]
+    host_leaf = np.asarray(jax.device_get(leaf)).ravel()[:8]
+    g = multihost_utils.process_allgather(host_leaf)
+    np.testing.assert_allclose(g[0], g[1], rtol=0, atol=0)
+    print(f"[{pid}] lm task multi-process ok")
+
     # --- assert_in_sync MUST fire on divergent fingerprints ---
     fired = False
     try:

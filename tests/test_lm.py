@@ -108,6 +108,30 @@ def test_lm_train_step_learns_successor_task(devices):
     assert float(last["perplexity"]) < 1.5
 
 
+def test_lm_remat_matches_no_remat(devices):
+    """remat is a memory/FLOPs trade, not a math change: same params, same
+    logits (to float noise) and gradients flow."""
+    tokens = jnp.asarray(
+        np.random.default_rng(2).integers(0, 32, (2, 16)), jnp.int32
+    )
+    plain = _tiny_lm()
+    remat = _tiny_lm(remat=True)
+    variables = plain.init(jax.random.PRNGKey(0), tokens)
+    a = plain.apply(variables, tokens)
+    b = remat.apply(variables, tokens)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6, rtol=0)
+
+    def loss(params, model):
+        return jnp.sum(model.apply({"params": params}, tokens) ** 2)
+
+    ga = jax.grad(lambda p: loss(p, plain))(variables["params"])
+    gb = jax.grad(lambda p: loss(p, remat))(variables["params"])
+    for x, y in zip(jax.tree.leaves(ga), jax.tree.leaves(gb)):
+        np.testing.assert_allclose(
+            np.asarray(x), np.asarray(y), atol=1e-4, rtol=1e-4
+        )
+
+
 def test_chunked_lm_step_matches_per_step(devices):
     """K LM steps per dispatch == K calls of the per-step factory."""
     from ddp_practice_tpu.train.steps import make_chunked_lm_train_step

@@ -59,6 +59,50 @@ def test_fit_reaches_reference_accuracy_contract():
     assert summary["steps"] == 3 * (4096 // 32)
 
 
+def test_grad_accumulation_matches_big_batch():
+    """SGD with accum_steps=k over k micro-batches of size b == one step
+    on the concatenated k*b batch (mean-of-means == mean of the whole for
+    equal micro-batch sizes). A BN-free model (tiny ViT): BatchNorm's
+    batch statistics legitimately differ between micro and full batches,
+    so the equivalence claim is per-sample-normalized models only."""
+    model = create_model(
+        "vit_tiny", hidden_dim=32, depth=1, num_heads=2, mlp_dim=64,
+        patch_size=7,
+    )
+    rng = np.random.default_rng(1)
+    images = rng.uniform(size=(16, 28, 28, 1)).astype(np.float32)
+    labels = rng.integers(0, 10, 16).astype(np.int32)
+
+    def run(cfg, batches):
+        tx = make_optimizer(cfg)
+        state = create_state(
+            model, tx, rng=jax.random.PRNGKey(0),
+            sample_input=jnp.zeros((2, 28, 28, 1)),
+        )
+        step = make_train_step(model, tx)
+        for img, lbl in batches:
+            batch = {
+                "image": jnp.asarray(img), "label": jnp.asarray(lbl),
+                "weight": jnp.ones((len(lbl),), jnp.float32),
+            }
+            state, _ = step(state, batch)
+        return state.params
+
+    micro = run(
+        TrainConfig(optimizer="sgd", learning_rate=1e-2, accum_steps=4),
+        [(images[i * 4:(i + 1) * 4], labels[i * 4:(i + 1) * 4])
+         for i in range(4)],
+    )
+    big = run(
+        TrainConfig(optimizer="sgd", learning_rate=1e-2),
+        [(images, labels)],
+    )
+    for a, b in zip(jax.tree.leaves(micro), jax.tree.leaves(big)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-7, rtol=0
+        )
+
+
 def test_sgd_parity_hyperparams():
     """Optimizer defaults match the reference: SGD, lr 1e-4, unscaled
     (ddp_main.py:125; README.md:506)."""
