@@ -204,6 +204,15 @@ def restore(directory: str, target: Any, *, shardings: Any = None) -> Any:
         if want != got:
             raise ValueError(f"checkpoint leaf {i} is {got!r}; target wants {want!r}")
         arr = data[f"leaf_{i}"]
+        want_shape = getattr(leaf, "shape", None)
+        if want_shape is not None and tuple(arr.shape) != tuple(want_shape):
+            # e.g. generate.py --seq_len different from the training run:
+            # fail here with the mismatch named, not deep inside flax
+            raise ValueError(
+                f"checkpoint leaf {want!r} has shape {tuple(arr.shape)}; "
+                f"target wants {tuple(want_shape)} — the checkpoint was "
+                "written with a different model configuration"
+            )
         if hasattr(leaf, "dtype") and arr.dtype != leaf.dtype:
             arr = arr.astype(leaf.dtype)
         leaves.append(arr)

@@ -1,0 +1,123 @@
+"""Generate text from a trained LM checkpoint.
+
+    python -m ddp_practice_tpu.generate --ckpt_dir ckpts \
+        --prompt "def main" --max_new_tokens 256 --temperature 0.8 --top_k 40
+
+The training invocation's state-shaping knobs (model, optimizer, seq_len,
+vocab) are read back from the checkpoint manifest (train/loop.py save()),
+so only the checkpoint directory is required; flags override. The
+reference has no inference path to cite — this is framework surface the
+reference's training-only design stops short of (origin_main.py:113 saves
+and exits).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+from ddp_practice_tpu import checkpoint as ckpt
+from ddp_practice_tpu.config import PrecisionPolicy, TrainConfig
+from ddp_practice_tpu.inference import (
+    decode_bytes,
+    encode_bytes,
+    make_generate_fn,
+)
+from ddp_practice_tpu.models import create_model
+from ddp_practice_tpu.train.state import create_state, make_optimizer
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--ckpt_dir", required=True)
+    p.add_argument("--prompt", default="\n")
+    p.add_argument("--max_new_tokens", type=int, default=256)
+    p.add_argument("--temperature", type=float, default=0.8,
+                   help="0 = greedy argmax")
+    p.add_argument("--top_k", type=int, default=0)
+    p.add_argument("--top_p", type=float, default=0.0)
+    p.add_argument("--eos_id", type=int, default=None)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--model", default=None,
+                   help="override the manifest's model name")
+    p.add_argument("--seq_len", type=int, default=0,
+                   help="override the manifest's max sequence length")
+    return p
+
+
+def load_lm(args) -> tuple:
+    """(model, params) rebuilt from the checkpoint manifest + leaves."""
+    manifest = ckpt.latest_manifest(args.ckpt_dir)
+    if manifest is None:
+        raise SystemExit(f"no checkpoint under {args.ckpt_dir!r}")
+    extra = manifest.get("extra", {})
+    name = args.model or extra.get("model")
+    if not name or not name.startswith("lm_"):
+        raise SystemExit(
+            f"checkpoint model {name!r} is not an LM (lm_*) — generation "
+            "needs a decoder; pass --model to override"
+        )
+    seq_len = args.seq_len or int(extra.get("seq_len", 2048))
+    vocab = int(extra.get("vocab_size", 256))
+    policy = (
+        PrecisionPolicy.bf16()
+        if extra.get("precision_policy") == "bf16"
+        else PrecisionPolicy.fp32()
+    )
+    model = create_model(
+        name, policy=policy, vocab_size=vocab, max_len=seq_len,
+        remat=bool(extra.get("remat", False)),
+    )
+    # rebuild the train-state TREE abstractly (shapes only, no init FLOPs)
+    # so restore()'s strict path check accepts the leaves
+    cfg = TrainConfig(
+        model=name,
+        optimizer=extra.get("optimizer", "sgd"),
+        momentum=float(extra.get("momentum", 0.0)),
+        weight_decay=float(extra.get("weight_decay", 0.0)),
+        accum_steps=int(extra.get("accum_steps", 1)),
+    )
+    tx = make_optimizer(cfg)
+    sample = jnp.zeros((1, seq_len), jnp.int32)
+    abstract = jax.eval_shape(
+        lambda r: create_state(model, tx, rng=r, sample_input=sample),
+        jax.random.PRNGKey(0),
+    )
+    state = ckpt.restore(args.ckpt_dir, abstract)
+    return model, jax.device_put(state.params), int(extra.get("step", -1))
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    model, params, step = load_lm(args)
+    prompt = jnp.asarray(encode_bytes(args.prompt))
+    gen = jax.jit(
+        make_generate_fn(
+            model,
+            max_new_tokens=args.max_new_tokens,
+            temperature=args.temperature,
+            top_k=args.top_k,
+            top_p=args.top_p,
+            eos_id=args.eos_id,
+        )
+    )
+    key = jax.random.PRNGKey(args.seed)
+    t0 = time.perf_counter()
+    tokens = jax.device_get(gen(params, prompt, key))
+    dt = time.perf_counter() - t0
+    text = decode_bytes(tokens[0, prompt.shape[1]:])
+    print(text)
+    print(
+        f"[generate] ckpt step {step}, {args.max_new_tokens} tokens in "
+        f"{dt:.2f}s ({args.max_new_tokens / dt:.1f} tok/s, incl. compile)",
+        file=sys.stderr,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

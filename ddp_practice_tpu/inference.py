@@ -1,0 +1,170 @@
+"""Autoregressive inference: KV-cache prefill/decode + sampling.
+
+The reference is a training-only demo — it saves a checkpoint and stops
+(`origin_main.py:113`); there is no inference path anywhere in it. A
+framework with a decoder LM family (models/lm.py) needs one, so this
+module adds generation designed for the XLA compilation model:
+
+- the ENTIRE generation — prompt prefill plus `max_new_tokens` decode
+  steps — is one jittable pure function with static shapes: the K/V cache
+  is pre-allocated in HBM at `prompt_len + max_new_tokens`, prefill writes
+  the prompt's keys/values with one batched call (s = prompt length), and
+  decoding is a `lax.scan` of single-token steps (s = 1);
+- data-dependent stopping (EOS) is a done-mask folded through the scan,
+  not a dynamic loop exit — sampled-after-done positions emit `pad_id`;
+- sampling (greedy / temperature / top-k / nucleus top-p) happens
+  on-device from fp32 logits with an explicit PRNG key chain, so a given
+  (params, prompt, key) triple is reproducible across hosts and backends.
+
+The cache lives in a flax "cache" variable collection (see
+models/vit.py SelfAttention `decode=True`): each block holds
+(b, total_len, heads, head_dim) key/value buffers plus a write cursor,
+and the model tracks one top-level position cursor for the positional
+embedding. `model.apply(..., mutable=["cache"])` threads it functionally
+through the scan carry.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+def make_cache(model, batch: int, total_len: int) -> Any:
+    """Zero-initialized KV cache for `batch` sequences of `total_len`.
+
+    Shapes come from `jax.eval_shape` over a decode-mode init — no FLOPs,
+    no params materialized. Safe to call inside a jitted function (it is,
+    in `make_generate_fn`).
+    """
+    shapes = jax.eval_shape(
+        lambda: model.init(
+            jax.random.PRNGKey(0),
+            jnp.zeros((batch, total_len), jnp.int32),
+            decode=True,
+        )
+    )["cache"]
+    return jax.tree.map(lambda a: jnp.zeros(a.shape, a.dtype), shapes)
+
+
+def sample_logits(
+    logits: jnp.ndarray,
+    key: Optional[jax.Array],
+    *,
+    temperature: float = 1.0,
+    top_k: int = 0,
+    top_p: float = 0.0,
+) -> jnp.ndarray:
+    """Sample token ids (b,) from fp32 logits (b, vocab).
+
+    temperature=0 is greedy argmax (no key needed). top_k keeps the k
+    highest logits; top_p keeps the smallest prefix of the sorted
+    distribution whose cumulative probability reaches p (the most likely
+    token always survives). Both filters compose: k first, then p.
+    """
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1)
+    logits = logits.astype(jnp.float32) / temperature
+    neg = jnp.asarray(-1e30, logits.dtype)
+    if top_k > 0:
+        kth = lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, neg, logits)
+    if top_p > 0.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        # exclusive cumulative prob: position i survives while the mass
+        # BEFORE it is < p, so the argmax (mass 0 before it) always does
+        cum = jnp.cumsum(probs, axis=-1) - probs
+        keep = cum < top_p
+        # threshold = smallest surviving logit
+        thresh = jnp.min(
+            jnp.where(keep, sorted_logits, jnp.inf), axis=-1, keepdims=True
+        )
+        logits = jnp.where(logits < thresh, neg, logits)
+    return jax.random.categorical(key, logits, axis=-1)
+
+
+def make_generate_fn(
+    model,
+    *,
+    max_new_tokens: int,
+    temperature: float = 1.0,
+    top_k: int = 0,
+    top_p: float = 0.0,
+    eos_id: Optional[int] = None,
+    pad_id: int = 0,
+) -> Callable[[Any, jnp.ndarray, Optional[jax.Array]], jnp.ndarray]:
+    """Build `gen(params, prompt, key) -> tokens` for a decode-capable model.
+
+    `prompt` is (b, prompt_len) int32 (uniform length per batch — byte-level
+    prompts pad naturally by construction); the result is
+    (b, prompt_len + max_new_tokens) with the prompt copied through. Wrap
+    the returned function in `jax.jit` (the generate CLI and tests do); all
+    sampling parameters are closed over as compile-time constants.
+    """
+
+    def gen(params, prompt, key=None):
+        b, prompt_len = prompt.shape
+        if prompt_len == 0:
+            raise ValueError("prompt must contain at least one token")
+        total = prompt_len + max_new_tokens
+        if total > model.max_len:
+            raise ValueError(
+                f"prompt {prompt_len} + max_new_tokens {max_new_tokens} "
+                f"exceeds model max_len {model.max_len}"
+            )
+        if temperature != 0.0 and key is None:
+            raise ValueError("sampling (temperature != 0) needs a PRNG key")
+        cache = make_cache(model, b, total)
+        logits, mut = model.apply(
+            {"params": params, "cache": cache},
+            prompt,
+            decode=True,
+            mutable=["cache"],
+        )
+        carry_key = key if key is not None else jax.random.PRNGKey(0)
+        done = jnp.zeros((b,), bool)
+
+        def step(carry, _):
+            cache, last_logits, k, done = carry
+            k, sub = jax.random.split(k)
+            tok = sample_logits(
+                last_logits, sub,
+                temperature=temperature, top_k=top_k, top_p=top_p,
+            ).astype(jnp.int32)
+            tok = jnp.where(done, jnp.asarray(pad_id, jnp.int32), tok)
+            if eos_id is not None:
+                done = done | (tok == eos_id)
+            logits, mut = model.apply(
+                {"params": params, "cache": cache},
+                tok[:, None],
+                decode=True,
+                mutable=["cache"],
+            )
+            return (mut["cache"], logits[:, -1], k, done), tok
+
+        (_, _, _, _), toks = lax.scan(
+            step,
+            (mut["cache"], logits[:, -1], carry_key, done),
+            None,
+            length=max_new_tokens,
+        )
+        return jnp.concatenate([prompt, toks.T], axis=1)
+
+    return gen
+
+
+def encode_bytes(text: str) -> np.ndarray:
+    """str -> (1, len) int32 byte tokens (the byte-level LM vocabulary)."""
+    raw = np.frombuffer(text.encode("utf-8"), dtype=np.uint8)
+    return raw.astype(np.int32)[None, :]
+
+
+def decode_bytes(tokens) -> str:
+    """(len,) byte tokens -> str (invalid UTF-8 replaced, not raised)."""
+    arr = np.asarray(tokens).astype(np.uint8)
+    return arr.tobytes().decode("utf-8", errors="replace")

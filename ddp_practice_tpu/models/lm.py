@@ -52,8 +52,16 @@ class TransformerLM(nn.Module):
     axis_name: Optional[str] = None  # registry uniformity (no BN anywhere)
 
     @nn.compact
-    def __call__(self, tokens, *, train: bool = False):
-        """tokens (batch, seq) int32 -> logits (batch, seq, vocab) fp32."""
+    def __call__(self, tokens, *, train: bool = False, decode: bool = False):
+        """tokens (batch, seq) int32 -> logits (batch, seq, vocab) fp32.
+
+        `decode=True` is KV-cache inference mode (inference.py): the call
+        appends `s` tokens at the cache cursor instead of reading positions
+        from zero, so the same instance serves training, prompt prefill
+        (s = prompt length) and single-token generation steps (s = 1).
+        Initialize the cache collection by calling `init`/`eval_shape` with
+        a max-generation-length input and `decode=True`.
+        """
         b, s = tokens.shape
         if s > self.max_len:
             raise ValueError(f"sequence {s} exceeds max_len {self.max_len}")
@@ -70,10 +78,33 @@ class TransformerLM(nn.Module):
             (1, self.max_len, self.hidden_dim),
             self.param_dtype,
         )
-        x = x + pos[:, :s].astype(self.dtype)
-        block_cls = nn.remat(EncoderBlock) if self.remat else EncoderBlock
+        if decode:
+            # the position cursor mirrors the attention caches' write index
+            # (they advance in lockstep; this one lives at the top level so
+            # the embedding lookup doesn't reach into a block's variables)
+            pos_index = self.variable(
+                "cache", "pos_index", lambda: jnp.zeros((), jnp.int32)
+            )
+            if self.is_initializing():
+                x = x + pos[:, :s].astype(self.dtype)
+            else:
+                from jax import lax
+
+                p = lax.dynamic_slice(
+                    pos, (0, pos_index.value, 0), (1, s, self.hidden_dim)
+                )
+                x = x + p.astype(self.dtype)
+                pos_index.value = pos_index.value + s
+        else:
+            x = x + pos[:, :s].astype(self.dtype)
+        # remat only matters for the training backward pass; the decode path
+        # mutates cache variables, which jax.checkpoint must not wrap
+        block_cls = (
+            nn.remat(EncoderBlock) if (self.remat and not decode)
+            else EncoderBlock
+        )
         for i in range(self.depth):
-            x = block_cls(
+            block = block_cls(
                 self.num_heads,
                 self.mlp_dim,
                 dtype=self.dtype,
@@ -83,7 +114,10 @@ class TransformerLM(nn.Module):
                 attn_impl=self.attn_impl,
                 causal=True,
                 name=f"block{i}",
-            )(x)
+            )
+            # only the decode path passes the kwarg: under nn.remat,
+            # jax.checkpoint would reject a non-array argument
+            x = block(x, decode=True) if decode else block(x)
         x = nn.LayerNorm(
             dtype=self.dtype, param_dtype=self.param_dtype, name="ln_f"
         )(x)

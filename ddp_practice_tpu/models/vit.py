@@ -98,7 +98,7 @@ class SelfAttention(nn.Module):
     causal: bool = False            # decoder (LM) blocks mask the future
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, *, decode: bool = False):
         b, s, d = x.shape
         assert d % self.num_heads == 0, (d, self.num_heads)
         head_dim = d // self.num_heads
@@ -109,10 +109,60 @@ class SelfAttention(nn.Module):
             name="qkv",
         )(x)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-        out = dot_product_attention(
-            q, k, v, causal=self.causal, seq_axis=self.seq_axis,
-            sp_impl=self.sp_impl, impl=self.attn_impl,
-        )
+        if decode:
+            # KV-cache incremental decoding: the cache collection holds
+            # pre-allocated (b, max_len, h, hd) key/value buffers (shaped by
+            # a full-length init call) plus the write cursor. One code path
+            # serves prefill (s = prompt length at cursor 0) and
+            # single-token steps (s = 1): dynamic_update_slice writes the
+            # new K/V block at the cursor, and validity is the position
+            # inequality j <= cursor + i — static shapes, dynamic offset,
+            # which is what keeps the whole generate loop one compiled
+            # lax.scan (inference.py).
+            if not self.causal:
+                raise ValueError("decode=True requires causal attention")
+            if self.seq_axis is not None:
+                raise ValueError(
+                    "decode (KV-cache) mode does not compose with sequence "
+                    "parallelism — generate on a data/tensor-sharded mesh"
+                )
+            cached_key = self.variable(
+                "cache", "cached_key", jnp.zeros, k.shape, k.dtype
+            )
+            cached_value = self.variable(
+                "cache", "cached_value", jnp.zeros, v.shape, v.dtype
+            )
+            cache_index = self.variable(
+                "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
+            )
+            if self.is_initializing():
+                out = dot_product_attention(q, k, v, causal=True, impl="xla")
+            else:
+                from jax import lax
+
+                from ddp_practice_tpu.ops.attention import attention_with_mask
+
+                max_len = cached_key.value.shape[1]
+                cur = cache_index.value
+                k = lax.dynamic_update_slice(
+                    cached_key.value, k.astype(cached_key.value.dtype),
+                    (0, cur, 0, 0),
+                )
+                v = lax.dynamic_update_slice(
+                    cached_value.value, v.astype(cached_value.value.dtype),
+                    (0, cur, 0, 0),
+                )
+                cached_key.value = k
+                cached_value.value = v
+                cache_index.value = cur + s
+                pos_q = cur + jnp.arange(s)
+                mask = jnp.arange(max_len)[None, :] <= pos_q[:, None]
+                out = attention_with_mask(q, k, v, mask)
+        else:
+            out = dot_product_attention(
+                q, k, v, causal=self.causal, seq_axis=self.seq_axis,
+                sp_impl=self.sp_impl, impl=self.attn_impl,
+            )
         out = nn.DenseGeneral(
             d,
             axis=(-2, -1),
@@ -134,7 +184,7 @@ class EncoderBlock(nn.Module):
     causal: bool = False
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, *, decode: bool = False):
         y = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype, name="ln1")(x)
         y = SelfAttention(
             self.num_heads,
@@ -145,7 +195,7 @@ class EncoderBlock(nn.Module):
             attn_impl=self.attn_impl,
             causal=self.causal,
             name="attn",
-        )(y)
+        )(y, decode=decode)
         x = x + y
         y = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype, name="ln2")(x)
         y = MlpBlock(

@@ -60,7 +60,21 @@ def dot_product_attention(
     return _attention(q, k, v, causal=causal)
 
 
-def _attention(q, k, v, *, causal: bool) -> jnp.ndarray:
+def attention_with_mask(q, k, v, mask) -> jnp.ndarray:
+    """Attention under an explicit boolean mask (True = attend).
+
+    `mask` broadcasts against scores (b, h, sq, sk); a 2D (sq, sk) mask is
+    promoted. This is the KV-cache decode path (models/vit.py SelfAttention
+    `decode=True`): the query block sits at a dynamic offset inside a
+    pre-allocated key/value buffer, so validity is position arithmetic, not
+    a static triangle.
+    """
+    if mask.ndim == 2:
+        mask = mask[None, None]
+    return _attention(q, k, v, causal=False, mask=mask)
+
+
+def _attention(q, k, v, *, causal: bool, mask=None) -> jnp.ndarray:
     in_dtype = q.dtype
     head_dim = q.shape[-1]
     scale = 1.0 / jnp.sqrt(jnp.asarray(head_dim, jnp.float32))
@@ -70,7 +84,9 @@ def _attention(q, k, v, *, causal: bool) -> jnp.ndarray:
     ) * scale
     if causal:
         sq, sk = scores.shape[-2], scores.shape[-1]
-        mask = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
+        tri = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
+        scores = jnp.where(tri, scores, jnp.asarray(-1e30, scores.dtype))
+    if mask is not None:
         scores = jnp.where(mask, scores, jnp.asarray(-1e30, scores.dtype))
     probs = jnp.exp(
         scores - jnp.max(scores, axis=-1, keepdims=True)

@@ -793,15 +793,25 @@ class Trainer:
         if self._watchdog is not None:
             self._watchdog.beat()  # checkpoint IO is progress, not a hang
         if self.config.checkpoint_dir:
-            ckpt.save(
-                self.config.checkpoint_dir,
-                self.state,
-                extra={
-                    "step": int(self.state.step),
-                    "precision_policy": self.config.precision_policy().name,
-                    "model": self.config.model,
-                },
-            )
+            cfg = self.config
+            # everything needed to rebuild the state TREE (not just values)
+            # offline: generate.py restores a checkpoint with no knowledge
+            # of the training invocation, so the knobs that change the
+            # optimizer-state structure ride along in the manifest
+            extra = {
+                "step": int(self.state.step),
+                "precision_policy": cfg.precision_policy().name,
+                "model": cfg.model,
+                "optimizer": cfg.optimizer,
+                "momentum": cfg.momentum,
+                "weight_decay": cfg.weight_decay,
+                "accum_steps": cfg.accum_steps,
+            }
+            if self.task == "lm":
+                extra["seq_len"] = cfg.seq_len
+                extra["vocab_size"] = self._vocab_size
+                extra["remat"] = bool(cfg.remat)
+            ckpt.save(self.config.checkpoint_dir, self.state, extra=extra)
 
     def fit(self) -> dict:
         cfg = self.config

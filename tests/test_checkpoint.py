@@ -5,6 +5,7 @@ and restore-onto-a-mesh."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from ddp_practice_tpu import checkpoint as ckpt
 from ddp_practice_tpu.config import MeshConfig, TrainConfig
@@ -35,6 +36,19 @@ def test_roundtrip(tmp_path):
     )
     man = ckpt.latest_manifest(d)
     assert man["extra"]["precision_policy"] == "bf16"  # the "scaler slot"
+
+
+def test_restore_rejects_shape_mismatch(tmp_path):
+    """A config drift (e.g. generate.py --seq_len override) fails loudly at
+    restore time, not deep inside flax."""
+    state = _state()
+    d = str(tmp_path / "ck")
+    ckpt.save(d, state, extra={"step": 0})
+    bad = jax.tree.map(
+        lambda a: jax.ShapeDtypeStruct((7,) + a.shape, a.dtype), state
+    )
+    with pytest.raises(ValueError, match="different model configuration"):
+        ckpt.restore(d, bad)
 
 
 def test_restore_onto_mesh(tmp_path, devices):
