@@ -45,6 +45,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--remat", action="store_true",
                    help="rematerialize LM block activations in backward "
                         "(longer sequences for ~1/3 more FLOPs)")
+    p.add_argument("--pos_emb", default="learned", choices=["learned", "rope"],
+                   help="LM position encoding: learned absolute table or "
+                        "rotary Q/K (relative; long-context default)")
     p.add_argument("--data_dir", default="./data")
     p.add_argument("--synthetic_size", type=int, default=0,
                    help="synthetic-fallback corpus size (train split; "
@@ -127,6 +130,7 @@ def config_from_args(args) -> TrainConfig:
         synthetic_size=args.synthetic_size,
         seq_len=args.seq_len,
         remat=args.remat,
+        pos_emb=args.pos_emb,
         epochs=args.epochs,
         batch_size=args.batch_size,
         learning_rate=args.lr,

@@ -142,6 +142,9 @@ class TrainConfig:
     # streaming flash kernels this is what takes lm_base from seq 16k to
     # 32k on one v5e chip (BENCHMARKS.md)
     remat: bool = False
+    # LM position encoding: "learned" absolute table (GPT-2 style) or
+    # "rope" rotary Q/K (relative positions; ops/rope.py)
+    pos_emb: str = "learned"
 
     # optimization (reference defaults: origin_main.py:37-52, ddp_main.py:125)
     epochs: int = 3

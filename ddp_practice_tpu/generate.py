@@ -71,6 +71,7 @@ def load_lm(args) -> tuple:
     model = create_model(
         name, policy=policy, vocab_size=vocab, max_len=seq_len,
         remat=bool(extra.get("remat", False)),
+        pos_emb=extra.get("pos_emb", "learned"),
     )
     # rebuild the train-state TREE abstractly (shapes only, no init FLOPs)
     # so restore()'s strict path check accepts the leaves

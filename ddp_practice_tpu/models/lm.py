@@ -49,6 +49,10 @@ class TransformerLM(nn.Module):
     # streaming flash kernels it makes training memory per block O(seq·d)
     # instead of O(seq·d·n_intermediates))
     remat: bool = False
+    # "learned": absolute position table added to the embedding (GPT-2
+    # style, tied to max_len). "rope": rotary Q/K inside every attention —
+    # relative positions, the long-context default (ops/rope.py).
+    pos_emb: str = "learned"
     axis_name: Optional[str] = None  # registry uniformity (no BN anywhere)
 
     @nn.compact
@@ -72,31 +76,39 @@ class TransformerLM(nn.Module):
             param_dtype=self.param_dtype,
             name="tok_embed",
         )(tokens)
-        pos = self.param(
-            "pos_embed",
-            nn.initializers.normal(stddev=0.02),
-            (1, self.max_len, self.hidden_dim),
-            self.param_dtype,
-        )
-        if decode:
-            # the position cursor mirrors the attention caches' write index
-            # (they advance in lockstep; this one lives at the top level so
-            # the embedding lookup doesn't reach into a block's variables)
-            pos_index = self.variable(
-                "cache", "pos_index", lambda: jnp.zeros((), jnp.int32)
+        if self.pos_emb not in ("learned", "rope"):
+            raise ValueError(
+                f"unknown pos_emb {self.pos_emb!r} (want 'learned'|'rope')"
             )
-            if self.is_initializing():
-                x = x + pos[:, :s].astype(self.dtype)
-            else:
-                from jax import lax
-
-                p = lax.dynamic_slice(
-                    pos, (0, pos_index.value, 0), (1, s, self.hidden_dim)
+        if self.pos_emb == "learned":
+            pos = self.param(
+                "pos_embed",
+                nn.initializers.normal(stddev=0.02),
+                (1, self.max_len, self.hidden_dim),
+                self.param_dtype,
+            )
+            if decode:
+                # the position cursor mirrors the attention caches' write
+                # index (they advance in lockstep; this one lives at the top
+                # level so the embedding lookup doesn't reach into a block's
+                # variables)
+                pos_index = self.variable(
+                    "cache", "pos_index", lambda: jnp.zeros((), jnp.int32)
                 )
-                x = x + p.astype(self.dtype)
-                pos_index.value = pos_index.value + s
-        else:
-            x = x + pos[:, :s].astype(self.dtype)
+                if self.is_initializing():
+                    x = x + pos[:, :s].astype(self.dtype)
+                else:
+                    from jax import lax
+
+                    p = lax.dynamic_slice(
+                        pos, (0, pos_index.value, 0), (1, s, self.hidden_dim)
+                    )
+                    x = x + p.astype(self.dtype)
+                    pos_index.value = pos_index.value + s
+            else:
+                x = x + pos[:, :s].astype(self.dtype)
+        # rope: positions enter inside each attention (the blocks' caches
+        # already track the decode cursor; nothing to add at the embedding)
         # remat only matters for the training backward pass; the decode path
         # mutates cache variables, which jax.checkpoint must not wrap
         block_cls = (
@@ -113,6 +125,7 @@ class TransformerLM(nn.Module):
                 sp_impl=self.sp_impl,
                 attn_impl=self.attn_impl,
                 causal=True,
+                rope=self.pos_emb == "rope",
                 name=f"block{i}",
             )
             # only the decode path passes the kwarg: under nn.remat,

@@ -21,6 +21,7 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 from ddp_practice_tpu.ops.attention import dot_product_attention
+from ddp_practice_tpu.ops.rope import apply_rope
 
 
 class ViTEmbed(nn.Module):
@@ -96,6 +97,7 @@ class SelfAttention(nn.Module):
     sp_impl: str = "ring"           # "ring" | "ulysses"
     attn_impl: str = "xla"          # "xla" | "flash" (Pallas kernel)
     causal: bool = False            # decoder (LM) blocks mask the future
+    rope: bool = False              # rotary Q/K (ops/rope.py) vs none here
 
     @nn.compact
     def __call__(self, x, *, decode: bool = False):
@@ -109,6 +111,15 @@ class SelfAttention(nn.Module):
             name="qkv",
         )(x)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        if self.rope and not decode:
+            # global positions: under GSPMD jit the sequence dim is sharded
+            # by annotation, not split — s IS the global length (the SP
+            # shard_map island opens inside ring/ulysses, after this).
+            # Rotations bake absolute position into Q/K, so attention
+            # scores depend only on relative offsets downstream.
+            positions = jnp.arange(s)
+            q = apply_rope(q, positions)
+            k = apply_rope(k, positions)
         if decode:
             # KV-cache incremental decoding: the cache collection holds
             # pre-allocated (b, max_len, h, hd) key/value buffers (shaped by
@@ -144,6 +155,12 @@ class SelfAttention(nn.Module):
 
                 max_len = cached_key.value.shape[1]
                 cur = cache_index.value
+                if self.rope:
+                    # cached keys are stored rotated, so only the incoming
+                    # block needs rotation — at its absolute positions
+                    positions = cur + jnp.arange(s)
+                    q = apply_rope(q, positions)
+                    k = apply_rope(k, positions)
                 k = lax.dynamic_update_slice(
                     cached_key.value, k.astype(cached_key.value.dtype),
                     (0, cur, 0, 0),
@@ -182,6 +199,7 @@ class EncoderBlock(nn.Module):
     sp_impl: str = "ring"
     attn_impl: str = "xla"
     causal: bool = False
+    rope: bool = False
 
     @nn.compact
     def __call__(self, x, *, decode: bool = False):
@@ -194,6 +212,7 @@ class EncoderBlock(nn.Module):
             sp_impl=self.sp_impl,
             attn_impl=self.attn_impl,
             causal=self.causal,
+            rope=self.rope,
             name="attn",
         )(y, decode=decode)
         x = x + y

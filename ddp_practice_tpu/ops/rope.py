@@ -1,0 +1,49 @@
+"""Rotary position embeddings (RoPE, Su et al.) — relative positions for
+the LM family's long-context work.
+
+No counterpart in the reference (a CNN; no sequence axis anywhere,
+origin_main.py:9-31). Learned absolute positions (models/lm.py pos_embed)
+tie the model to max_len at train time; RoPE encodes position as a
+rotation of each query/key pair so attention scores depend only on
+relative offsets — the standard choice for long-context decoders and the
+variant that composes with the framework's sequence-parallel schemes for
+free: applied to Q/K *before* attention, the rotation is baked into the
+tensors, so ring K/V blocks travel with their positions and Ulysses'
+head scatter never sees positions at all.
+
+TPU notes: angles are computed in fp32 (bf16 loses position resolution
+past a few thousand tokens) and cast back; the rotate-half layout keeps
+everything as two contiguous (…, d/2) slabs — no interleaved gathers, so
+XLA fuses the whole thing into the surrounding matmul's prologue.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def apply_rope(
+    x: jnp.ndarray,
+    positions: jnp.ndarray,
+    *,
+    theta: float = 10000.0,
+) -> jnp.ndarray:
+    """Rotate (b, s, h, d) by per-position angles; positions is (s,) int.
+
+    GPT-NeoX rotate-half convention: channel pairs are (i, i + d/2).
+    Under GSPMD jit the model sees the GLOBAL sequence, so callers pass
+    `arange(s)` (+ the KV-cache cursor when decoding); inside a hand-built
+    shard_map over the sequence the caller must add its shard offset.
+    """
+    d = x.shape[-1]
+    if d % 2:
+        raise ValueError(f"RoPE needs an even head_dim, got {d}")
+    half = d // 2
+    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = positions.astype(jnp.float32)[:, None] * freqs[None, :]  # (s, half)
+    cos = jnp.cos(angles)[None, :, None, :]
+    sin = jnp.sin(angles)[None, :, None, :]
+    x1 = x[..., :half].astype(jnp.float32)
+    x2 = x[..., half:].astype(jnp.float32)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)

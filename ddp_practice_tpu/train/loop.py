@@ -169,6 +169,8 @@ class Trainer:
             model_kwargs["max_len"] = config.seq_len
             if config.remat:
                 model_kwargs["remat"] = True
+            if config.pos_emb != "learned":
+                model_kwargs["pos_emb"] = config.pos_emb
             self.model = create_model(
                 config.model, policy=policy, **model_kwargs
             )
@@ -811,6 +813,7 @@ class Trainer:
                 extra["seq_len"] = cfg.seq_len
                 extra["vocab_size"] = self._vocab_size
                 extra["remat"] = bool(cfg.remat)
+                extra["pos_emb"] = cfg.pos_emb
             ckpt.save(self.config.checkpoint_dir, self.state, extra=extra)
 
     def fit(self) -> dict:

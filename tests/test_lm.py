@@ -63,6 +63,24 @@ def test_lm_is_causal(devices):
     assert not np.allclose(np.asarray(base[:, t]), np.asarray(out[:, t]))
 
 
+def test_lm_learned_positions_are_used(devices):
+    """The position table must actually enter the forward pass (a refactor
+    once dropped the add in non-decode mode; causality tests can't see it)."""
+    model = _tiny_lm()
+    tokens = jnp.asarray(
+        np.random.default_rng(4).integers(0, 32, (1, 12)), jnp.int32
+    )
+    variables = model.init(jax.random.PRNGKey(0), tokens)
+    base = model.apply(variables, tokens)
+    zeroed = jax.tree_util.tree_map_with_path(
+        lambda p, leaf: jnp.zeros_like(leaf)
+        if "pos_embed" in jax.tree_util.keystr(p) else leaf,
+        variables["params"],
+    )
+    out = model.apply({"params": zeroed}, tokens)
+    assert not np.allclose(np.asarray(base), np.asarray(out))
+
+
 def test_lm_rejects_overlong_sequence(devices):
     model = _tiny_lm(max_len=16)
     tokens = jnp.zeros((1, 32), jnp.int32)
