@@ -34,8 +34,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="per data-parallel replica, like the reference")
     p.add_argument("--model", default="convnet",
                    choices=["convnet", "resnet18", "resnet50", "vit_tiny",
-                            "vit_tiny_moe", "vit_tiny_pipe",
+                            "vit_base", "vit_tiny_moe", "vit_tiny_pipe",
                             "lm_tiny", "lm_base"])
+    p.add_argument("--num_heads", type=int, default=0,
+                   help="override attention head count (transformer models; "
+                        "0 = model default — note tensor parallelism needs "
+                        "heads divisible by the tensor degree)")
     p.add_argument("--dataset", default="mnist",
                    help="image models: mnist|cifar10|imagenet|synthetic; "
                         "lm models: text (bytes from --data_dir) or "
@@ -151,6 +155,7 @@ def config_from_args(args) -> TrainConfig:
         attn_impl=args.attn_impl,
         num_microbatches=args.microbatches,
         num_experts=args.num_experts,
+        num_heads=args.num_heads,
         coordinator_address=args.coordinator,
         num_processes=args.num_processes,
         process_id=args.process_id,

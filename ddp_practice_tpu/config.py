@@ -185,6 +185,9 @@ class TrainConfig:
     # MoE expert count when mesh.expert > 1 (0 = auto: 8 rounded up to a
     # multiple of the expert axis)
     num_experts: int = 0
+    # attention head count override for transformer models (0 = model
+    # default); tensor parallelism shards heads, so heads % tensor == 0
+    num_heads: int = 0
     # multi-host rendezvous (replaces MASTER_ADDR/MASTER_PORT, ddp_main.py:61-62)
     coordinator_address: Optional[str] = None
     num_processes: Optional[int] = None

@@ -60,6 +60,11 @@ def pipeline_apply(
         )
     data_spec = P(MeshConfig.AXIS_DATA)  # batch dim over 'data', repl. over 'pipe'
     param_spec = jax.tree.map(lambda _: P(axis_name), stage_params)
+    # manual ONLY over 'pipe' (stage hops) and 'data' (microbatch split):
+    # every other mesh axis stays GSPMD-automatic inside the stage body, so
+    # tensor-parallel parameter shardings (sharding_rules._vit_pipe_rule)
+    # propagate into the per-stage matmuls and XLA inserts the Megatron
+    # all-reduces over 'tensor' there — TP x PP without hand collectives
     fn = jax.shard_map(
         functools.partial(
             _pipeline_local,
@@ -71,11 +76,23 @@ def pipeline_apply(
         mesh=mesh,
         in_specs=(param_spec, data_spec),
         out_specs=data_spec,
+        axis_names=frozenset({axis_name, MeshConfig.AXIS_DATA}),
         check_vma=False,
     )
+    # Boundary values stay fp32: XLA 0.9 CHECK-fails ("Invalid binary
+    # instruction opcode copy") building any sub-fp32 psum over the manual
+    # axes of a PARTIAL-manual shard_map — including the implicit psums
+    # grad-transpose inserts for operands replicated over a manual axis
+    # (activations are replicated over 'pipe', params over 'data'). Params
+    # are already fp32 under the bf16 policy; activations are cast here and
+    # per-tick (_pipeline_local), while block compute stays in the model's
+    # dtype. Cost: ppermute hops carry fp32 — 2x ICI bytes on one
+    # activation tensor per tick.
+    in_dtype = x.dtype
+    out = jax.jit(fn)(stage_params, x.astype(jnp.float32))
     # the scan-over-ticks body can't be evaluated eagerly inside shard_map;
     # jit is a no-op when already under an outer jit trace
-    return jax.jit(fn)(stage_params, x)
+    return out.astype(in_dtype)
 
 
 def _pipeline_local(stage_params, x, *, block_fn, num_mb, axis_name, remat):
@@ -100,7 +117,9 @@ def _pipeline_local(stage_params, x, *, block_fn, num_mb, axis_name, remat):
         state, outputs = carry
         t_in = jnp.clip(t, 0, num_mb - 1)
         inp = jnp.where(idx == 0, xs[t_in], state)
-        y = apply_stage(params, inp)
+        # carry stays in the (fp32) boundary dtype — see pipeline_apply —
+        # while the block computes in the model's own dtype
+        y = apply_stage(params, inp).astype(x.dtype)
         t_out = t - (n_stages - 1)
         emit = jnp.logical_and(idx == n_stages - 1, t_out >= 0)
         t_out = jnp.clip(t_out, 0, num_mb - 1)
@@ -117,11 +136,13 @@ def _pipeline_local(stage_params, x, *, block_fn, num_mb, axis_name, remat):
         tick, (state0, out0), jnp.arange(num_mb + n_stages - 1)
     )
     # only the last stage holds real outputs; masked psum replicates them
-    # over 'pipe' so downstream GSPMD code is stage-agnostic
-    outputs = lax.psum(
-        jnp.where(idx == n_stages - 1, outputs, jnp.zeros_like(outputs)),
-        axis_name,
-    )
+    # over 'pipe' so downstream GSPMD code is stage-agnostic. The psum runs
+    # in fp32: XLA (0.9 CPU backend) CHECK-fails building a sub-fp32
+    # all-reduce when the shard_map is manual over a subset of mesh axes
+    # ("Invalid binary instruction opcode copy"), and the upcast is free
+    # here (one masked tensor, bandwidth-bound either way).
+    masked = jnp.where(idx == n_stages - 1, outputs, jnp.zeros_like(outputs))
+    outputs = lax.psum(masked.astype(jnp.float32), axis_name).astype(x.dtype)
     return outputs.reshape((batch,) + x.shape[1:])
 
 

@@ -55,13 +55,22 @@ def _vit_rule(path, leaf) -> Optional[P]:
 
 def _vit_pipe_rule(path, leaf) -> Optional[P]:
     """Pipelined ViT: block-stack leaves carry a leading depth dimension
-    sharded over 'pipe' (each device holds its stage's contiguous blocks);
-    embed/head replicated. TP is not composed into the pipeline shard_map
-    (its in_specs declare inner dims replicated), so inner dims stay None.
-    """
+    sharded over 'pipe' (each device holds its stage's contiguous blocks —
+    depth-contiguous sharding coincides with stack_stages' (stages,
+    depth/stages) reshape); embed/head replicated over 'pipe'.
+
+    TP composes by suffix: the inner dims of each stacked block leaf take
+    the plain ViT Megatron spec. The pipeline shard_map is manual over
+    'pipe'/'data' only (parallel/pipeline.py axis_names), so 'tensor'
+    stays a GSPMD-automatic axis inside the stage body and XLA inserts
+    the row-parallel all-reduces there, exactly as in the unpipelined
+    model."""
     name = keystr(path)
     if "'blocks'" in name:
-        return P(MeshConfig.AXIS_PIPE)
+        inner = _vit_rule(path, leaf)
+        if inner is None:
+            return P(MeshConfig.AXIS_PIPE)
+        return P(MeshConfig.AXIS_PIPE, *inner)
     return None
 
 

@@ -130,6 +130,13 @@ class Trainer:
 
         # model
         model_kwargs = {}
+        if config.num_heads:
+            if not config.model.startswith(("vit", "lm")):
+                raise ValueError(
+                    f"--num_heads applies to transformer models, not "
+                    f"{config.model!r}"
+                )
+            model_kwargs["num_heads"] = config.num_heads
         if self.sp > 1:
             model_kwargs["seq_axis"] = MeshConfig.AXIS_SEQ
             model_kwargs["sp_impl"] = config.sp_impl
@@ -143,17 +150,9 @@ class Trainer:
             # than silently training unpipelined
             model_kwargs["num_stages"] = self.pp
             model_kwargs["num_microbatches"] = config.num_microbatches
-            if mesh_shape.get(MeshConfig.AXIS_TENSOR, 1) > 1:
-                # TP rules deliberately leave pipeline block params' inner
-                # dims replicated (sharding_rules._vit_pipe_rule); training
-                # with --tensor>1 --pipe>1 would silently not be
-                # tensor-parallel, so refuse instead
-                raise ValueError(
-                    "tensor parallelism is not composed into the pipeline "
-                    "shard_map yet: use tensor>1 with pipe=1, or pipe>1 "
-                    "with tensor=1 (supported combinations: README "
-                    "'Parallelism composition')"
-                )
+            # tensor parallelism composes: the pipeline shard_map is manual
+            # over 'pipe'/'data' only, so the _vit_pipe_rule tensor specs
+            # ride GSPMD inside each stage (parallel/pipeline.py)
         self.ep = mesh_shape.get(MeshConfig.AXIS_EXPERT, 1)
         if self.ep > 1 or config.num_experts:
             # expert count must divide evenly over the 'expert' axis; default
@@ -174,6 +173,11 @@ class Trainer:
             self.model = create_model(
                 config.model, policy=policy, **model_kwargs
             )
+        elif config.pos_emb != "learned":
+            raise ValueError(
+                "--pos_emb applies to the LM family (lm_*); "
+                f"{config.model!r} keeps its own position scheme"
+            )
         elif config.remat:
             raise ValueError(
                 "remat is only wired for the LM family (lm_*) — the image "
@@ -187,6 +191,20 @@ class Trainer:
                 axis_name=None,  # GSPMD: batch-axis stats are global by sharding
                 **model_kwargs,
             )
+        tp = mesh_shape.get(MeshConfig.AXIS_TENSOR, 1)
+        if tp > 1:
+            # fail with the fix named, not a pjit divisibility traceback:
+            # the Megatron rules shard the head dim of qkv/out kernels
+            heads = getattr(self.model, "num_heads", None) or getattr(
+                getattr(self.model, "block", None), "num_heads", None
+            )
+            if heads is not None and heads % tp:
+                raise ValueError(
+                    f"tensor parallelism shards attention heads: "
+                    f"{config.model} has {heads} heads, not divisible by "
+                    f"--tensor {tp} — pass --num_heads (e.g. "
+                    f"{((heads // tp) + 1) * tp}) or a different degree"
+                )
         self.tx = make_optimizer(config, self.train_loader.steps_per_epoch)
 
         # state, sharded at init (params materialize directly on the mesh)
