@@ -118,61 +118,144 @@ def save(
     process 0 touches the filesystem (the rank-0 gate of
     ddp_main.py:165-169). Returns the final checkpoint path.
     """
-    extra = dict(extra or {})
-    if step is None:
-        step = int(extra.get("step", 0))
-    extra.setdefault("step", step)
-
-    paths_and_leaves, _ = tree_flatten_with_path(state)
-    arrays = {}
-    names = []
-    for i, (path, leaf) in enumerate(paths_and_leaves):
-        names.append(keystr(path))
-        arrays[f"leaf_{i}"] = _leaf_to_host(leaf)
-
+    extra, step = _normalize_step(extra, step)
+    arrays, names = _gather(state)
     final = os.path.join(directory, f"step_{step}")
     if jax.process_index() == 0:
-        os.makedirs(directory, exist_ok=True)
-        tmp = os.path.join(directory, f"tmp.step_{step}.{os.getpid()}")
-        if os.path.isdir(tmp):
-            shutil.rmtree(tmp)
-        os.makedirs(tmp)
-        np.savez(os.path.join(tmp, _LEAVES), **arrays)
-        manifest = {
-            "schema_version": _SCHEMA_VERSION,
-            "paths": names,
-            "extra": extra,
-        }
-        # manifest last: its presence marks the checkpoint complete
-        with open(os.path.join(tmp, _MANIFEST), "w") as f:
-            json.dump(manifest, f, indent=2)
-        if os.path.isdir(final):
-            # re-save at the same step (e.g. the end-of-fit save landing on
-            # the last periodic save's step): move the old dir aside before
-            # the swap so no crash instant leaves step_N deleted with the
-            # replacement still under an ignored tmp. name
-            old = f"{final}.old.{os.getpid()}"
-            os.rename(final, old)
-            os.rename(tmp, final)  # atomic on POSIX (same filesystem)
-            shutil.rmtree(old, ignore_errors=True)
-        else:
-            os.rename(tmp, final)  # atomic on POSIX (same filesystem)
-        # prune only after the new checkpoint is durable
-        steps = _complete_steps(directory)
-        for old in steps[:-keep_last] if keep_last > 0 else []:
-            shutil.rmtree(
-                os.path.join(directory, f"step_{old}"), ignore_errors=True
-            )
-        # sweep stale debris from crashed earlier saves
-        for name in os.listdir(directory):
-            if name.startswith("tmp.step_") or ".old." in name:
-                shutil.rmtree(os.path.join(directory, name), ignore_errors=True)
+        _write(directory, arrays, names, extra, step, keep_last)
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
 
         # no process may return (and possibly restart+restore) before the
         # checkpoint is fully on disk
         multihost_utils.sync_global_devices(f"ckpt_save_{step}")
+    return final
+
+
+class AsyncSave:
+    """Handle for a background checkpoint write (save_async).
+
+    wait() joins the writer and returns the final path, re-raising any
+    write error; done() polls."""
+
+    def __init__(self, thread, path: str):
+        self._thread = thread
+        self._error: list = []
+        self.path = path
+
+    def wait(self) -> str:
+        self._thread.join()
+        if self._error:
+            raise self._error[0]
+        return self.path
+
+    def done(self) -> bool:
+        return not self._thread.is_alive()
+
+
+def save_async(
+    directory: str,
+    state: Any,
+    *,
+    extra: Optional[dict] = None,
+    step: Optional[int] = None,
+    keep_last: int = 3,
+) -> AsyncSave:
+    """Like save(), but the serialization + atomic rename run on a
+    background thread, so the train loop only pays the leaf gather (a
+    device fence + D2H copy) and overlaps the disk write with the next
+    steps. Crash safety is identical (same temp-dir + rename protocol).
+
+    Single-process only: the multi-host save is a collective whose
+    ordering must match across processes, so it stays synchronous —
+    callers fall back to save() there (Trainer does).
+
+    Do not overlap async saves to the same directory: the end-of-write
+    debris sweep of one save may remove another's in-flight temp dir.
+    wait() on the previous handle first (Trainer serializes this way).
+    """
+    if jax.process_count() > 1:
+        raise ValueError(
+            "save_async is single-process; multi-host saves are collective "
+            "— use save()"
+        )
+    import threading
+
+    extra, step = _normalize_step(extra, step)
+    arrays, names = _gather(state)
+    final = os.path.join(directory, f"step_{step}")
+
+    def _run():
+        try:
+            _write(directory, arrays, names, extra, step, keep_last)
+        except BaseException as e:  # surfaced by wait()
+            handle._error.append(e)
+
+    thread = threading.Thread(target=_run, name=f"ckpt-write-{step}")
+    handle = AsyncSave(thread, final)
+    thread.start()
+    return handle
+
+
+def _normalize_step(extra, step):
+    """One place decides the step dir number from extra/step (save and
+    save_async must produce identical manifests)."""
+    extra = dict(extra or {})
+    if step is None:
+        step = int(extra.get("step", 0))
+    extra.setdefault("step", step)
+    return extra, step
+
+
+def _gather(state):
+    """Flatten + bring every leaf to host memory (collective multi-host)."""
+    paths_and_leaves, _ = tree_flatten_with_path(state)
+    arrays = {}
+    names = []
+    for i, (path, leaf) in enumerate(paths_and_leaves):
+        names.append(keystr(path))
+        arrays[f"leaf_{i}"] = _leaf_to_host(leaf)
+    return arrays, names
+
+
+def _write(directory, arrays, names, extra, step, keep_last) -> str:
+    """Serialize + atomically publish one checkpoint (host data only)."""
+    final = os.path.join(directory, f"step_{step}")
+    os.makedirs(directory, exist_ok=True)
+    tmp = os.path.join(directory, f"tmp.step_{step}.{os.getpid()}")
+    if os.path.isdir(tmp):
+        shutil.rmtree(tmp)
+    os.makedirs(tmp)
+    np.savez(os.path.join(tmp, _LEAVES), **arrays)
+    manifest = {
+        "schema_version": _SCHEMA_VERSION,
+        "paths": names,
+        "extra": extra,
+    }
+    # manifest last: its presence marks the checkpoint complete
+    with open(os.path.join(tmp, _MANIFEST), "w") as f:
+        json.dump(manifest, f, indent=2)
+    if os.path.isdir(final):
+        # re-save at the same step (e.g. the end-of-fit save landing on
+        # the last periodic save's step): move the old dir aside before
+        # the swap so no crash instant leaves step_N deleted with the
+        # replacement still under an ignored tmp. name
+        old = f"{final}.old.{os.getpid()}"
+        os.rename(final, old)
+        os.rename(tmp, final)  # atomic on POSIX (same filesystem)
+        shutil.rmtree(old, ignore_errors=True)
+    else:
+        os.rename(tmp, final)  # atomic on POSIX (same filesystem)
+    # prune only after the new checkpoint is durable
+    steps = _complete_steps(directory)
+    for old in steps[:-keep_last] if keep_last > 0 else []:
+        shutil.rmtree(
+            os.path.join(directory, f"step_{old}"), ignore_errors=True
+        )
+    # sweep stale debris from crashed earlier saves
+    for name in os.listdir(directory):
+        if name.startswith("tmp.step_") or ".old." in name:
+            shutil.rmtree(os.path.join(directory, name), ignore_errors=True)
     return final
 
 

@@ -96,6 +96,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--num_processes", type=int, default=None)
     p.add_argument("--process_id", type=int, default=None)
     p.add_argument("--ckpt_dir", default=None)
+    p.add_argument("--ckpt_every", type=int, default=0, metavar="STEPS",
+                   help="also checkpoint every N optimizer steps "
+                        "(async write; 0 = only per-epoch/end)")
+    p.add_argument("--ckpt_sync", action="store_true",
+                   help="force synchronous periodic checkpoint writes")
     p.add_argument("--resume", action="store_true")
     p.add_argument("--max_restarts", type=int, default=0,
                    help="checkpoint-based restarts on training failure")
@@ -160,6 +165,8 @@ def config_from_args(args) -> TrainConfig:
         num_processes=args.num_processes,
         process_id=args.process_id,
         checkpoint_dir=args.ckpt_dir,
+        checkpoint_every_steps=args.ckpt_every,
+        checkpoint_async=not args.ckpt_sync,
         resume=args.resume,
         max_restarts=args.max_restarts,
         watchdog_timeout_s=args.watchdog,

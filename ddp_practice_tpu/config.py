@@ -196,6 +196,12 @@ class TrainConfig:
     # checkpointing (reference saves once at end, no resume: origin_main.py:113)
     checkpoint_dir: Optional[str] = None
     checkpoint_every_epochs: int = 0   # 0 = only at end
+    checkpoint_every_steps: int = 0    # 0 = off (periodic mid-epoch saves)
+    # periodic saves write on a background thread (gather fences the
+    # device, serialization overlaps the next steps); the end-of-fit save
+    # is always synchronous, and multi-host saves are always synchronous
+    # (collective ordering)
+    checkpoint_async: bool = True
     resume: bool = False
 
     # failure detection / elastic recovery (absent in reference, SURVEY §5.3)

@@ -340,6 +340,7 @@ class Trainer:
         # ride ICI and overlap is the point).
         self._serialize_steps = jax.default_backend() == "cpu"
         self._watchdog = None
+        self._pending_save = None  # in-flight async checkpoint write
         # ladder of per-step scalar futures (see _probe_if_due)
         from collections import deque
 
@@ -527,6 +528,13 @@ class Trainer:
                 "epoch %d step %d loss %.4f acc %.3f",
                 epoch, steps_done, float(m["loss"]), float(m["accuracy"]),
             )
+        if (
+            cfg.checkpoint_dir
+            and cfg.checkpoint_every_steps
+            and prev // cfg.checkpoint_every_steps
+            != steps_done // cfg.checkpoint_every_steps
+        ):
+            self.save(periodic=True)
 
     def _close_train_epoch(self, final_metrics) -> None:
         """End-of-epoch fence shared by both train loops: drain the probe
@@ -809,9 +817,20 @@ class Trainer:
             self._watchdog.beat()
         return acc
 
-    def save(self) -> None:
+    def save(self, *, periodic: bool = False) -> None:
+        """Checkpoint the current state.
+
+        periodic=True (the every-N-steps saves) uses the async writer in
+        single-process runs: the leaf gather fences the device, the
+        serialization + rename overlap the next steps. The previous write
+        is always waited on first (overlapping saves to one directory are
+        forbidden — checkpoint.save_async). End-of-fit and multi-host
+        saves are synchronous."""
         if self._watchdog is not None:
             self._watchdog.beat()  # checkpoint IO is progress, not a hang
+        if self._pending_save is not None:
+            self._pending_save.wait()  # surfaces write errors too
+            self._pending_save = None
         if self.config.checkpoint_dir:
             cfg = self.config
             # everything needed to rebuild the state TREE (not just values)
@@ -832,7 +851,12 @@ class Trainer:
                 extra["vocab_size"] = self._vocab_size
                 extra["remat"] = bool(cfg.remat)
                 extra["pos_emb"] = cfg.pos_emb
-            ckpt.save(self.config.checkpoint_dir, self.state, extra=extra)
+            if periodic and cfg.checkpoint_async and dist.process_count() == 1:
+                self._pending_save = ckpt.save_async(
+                    cfg.checkpoint_dir, self.state, extra=extra
+                )
+            else:
+                ckpt.save(cfg.checkpoint_dir, self.state, extra=extra)
 
     def fit(self) -> dict:
         cfg = self.config
@@ -846,6 +870,16 @@ class Trainer:
             if self._watchdog is not None:
                 self._watchdog.stop()
                 self._watchdog = None
+            if self._pending_save is not None:
+                # an exception mid-epoch must not leave an orphan writer
+                # racing a restarted Trainer's restore/save in the same
+                # directory (run_with_restarts reconstructs immediately);
+                # swallow the write error — the original exception wins
+                try:
+                    self._pending_save.wait()
+                except Exception:
+                    log.exception("async checkpoint write failed")
+                self._pending_save = None
 
     def _fit_inner(self) -> dict:
         cfg = self.config
