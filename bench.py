@@ -58,6 +58,13 @@ _SUITE = {
         kind="lm", seq_len=32768, batch_size=1, steps_per_call=1, calls=2,
         model_kwargs={"remat": True},
     ),
+    # autoregressive generation (KV-cache decode, inference.py): tokens/sec
+    # + model-bandwidth utilization — decode re-reads all params per token,
+    # so the roofline is HBM, not the MXU. Opt-in: `--models lm_decode`.
+    "lm_decode": dict(
+        kind="decode", prompt_len=128, max_new_tokens=512, batch_size=8,
+        calls=3,
+    ),
 }
 
 
@@ -72,7 +79,11 @@ def main(argv=None) -> int:
     p.add_argument("--calls", type=int, default=0, help="override")
     args = p.parse_args(argv)
 
-    from ddp_practice_tpu.benchmarks import bench_lm_train, bench_train
+    from ddp_practice_tpu.benchmarks import (
+        bench_lm_decode,
+        bench_lm_train,
+        bench_train,
+    )
 
     results = []
     errors = []
@@ -93,6 +104,10 @@ def main(argv=None) -> int:
         try:
             if kind == "lm":
                 r = bench_lm_train("lm_base", **kw)
+                r["model"] = name
+                results.append(r)
+            elif kind == "decode":
+                r = bench_lm_decode("lm_base", **kw)
                 r["model"] = name
                 results.append(r)
             else:
@@ -133,9 +148,10 @@ def main(argv=None) -> int:
             "convnet entry ran in this invocation; rerun with "
             "--models convnet,... for the like-for-like number"
         )
+    head_mode = "decode" if head.get("mode") == "decode" else "train"
     line = {
         "metric": (
-            f"{head['model']} train throughput (bs={head['batch_size']}, "
+            f"{head['model']} {head_mode} throughput (bs={head['batch_size']}, "
             f"{head['precision']}, {head['n_chips']} chip(s), "
             f"{head['device_kind']})"
         ),
@@ -148,6 +164,8 @@ def main(argv=None) -> int:
     if "mfu_pct" in head:
         line["mfu_pct"] = head["mfu_pct"]
         line["tflops_per_chip"] = head["tflops_per_chip"]
+    if "mbu_pct" in head:
+        line["mbu_pct"] = head["mbu_pct"]
     if errors:
         line["errors"] = errors
     print(json.dumps(line))

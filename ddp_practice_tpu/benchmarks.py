@@ -337,3 +337,110 @@ def bench_lm_train(
         return out
     finally:
         set_current_mesh(None)
+
+
+def bench_lm_decode(
+    model_name: str = "lm_base",
+    *,
+    prompt_len: int = 128,
+    max_new_tokens: int = 512,
+    batch_size: int = 8,
+    vocab_size: int = 256,
+    precision: str = "bf16",
+    calls: int = 3,
+    warmup_calls: int = 1,
+    temperature: float = 1.0,
+    top_k: int = 0,
+    model_kwargs: Optional[dict] = None,
+    seed: int = 0,
+    # accepted for bench.py CLI-override uniformity; decode has no chunking
+    steps_per_call: int = 0,
+) -> dict:
+    """Autoregressive generation throughput: KV-cache decode tokens/sec.
+
+    Decode is HBM-bandwidth-bound, not MXU-bound: every generated token
+    re-reads the full parameter set (plus the growing KV cache), so the
+    roofline metric is model-bandwidth utilization (MBU) = bytes actually
+    streamed per second / chip HBM bandwidth — reported alongside
+    tokens/sec. Params are fp32 in HBM under both precision policies
+    (bf16 keeps fp32 master params), so the per-step traffic floor is
+    4 bytes/param + the bf16 KV cache read. The whole generation (prefill
+    + lax.scan of single-token steps, inference.py) is ONE jitted call;
+    timing fences on a host readback of the final tokens.
+    """
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ddp_practice_tpu.config import PrecisionPolicy
+    from ddp_practice_tpu.inference import make_generate_fn
+    from ddp_practice_tpu.models import create_model
+    from ddp_practice_tpu.utils.flops import chip_hbm_bandwidth
+
+    policy = PrecisionPolicy.from_name(precision)
+    kwargs = dict(
+        vocab_size=vocab_size, max_len=prompt_len + max_new_tokens
+    )
+    kwargs.update(model_kwargs or {})
+    model = create_model(model_name, policy=policy, **kwargs)
+    rng = np.random.default_rng(seed)
+    prompt = jnp.asarray(
+        rng.integers(0, vocab_size, (batch_size, prompt_len)), jnp.int32
+    )
+    params = model.init(
+        jax.random.PRNGKey(seed), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    n_params = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(params))
+    gen = jax.jit(
+        make_generate_fn(
+            model,
+            max_new_tokens=max_new_tokens,
+            temperature=temperature,
+            top_k=top_k,
+        )
+    )
+    key = jax.random.PRNGKey(seed + 1)
+    for i in range(max(warmup_calls, 1)):
+        tokens = gen(params, prompt, jax.random.fold_in(key, i))
+    _fence = int(jax.device_get(tokens[0, -1]))
+
+    t0 = time.perf_counter()
+    for i in range(calls):
+        tokens = gen(params, prompt, jax.random.fold_in(key, 100 + i))
+        _fence = int(jax.device_get(tokens[0, -1]))  # fence every call
+    dt = time.perf_counter() - t0
+
+    # generation here is an UNSHARDED jit: it runs on one device no matter
+    # how many are visible (unlike bench_lm_train's data-parallel mesh),
+    # so per-chip rates divide by 1, not jax.device_count()
+    n_chips = 1
+    new_tokens = calls * batch_size * max_new_tokens
+    tps = new_tokens / dt
+    steps_per_sec = calls * max_new_tokens / dt  # param reads/sec (batched)
+    device_kind = jax.devices()[0].device_kind
+    out = {
+        "model": model_name,
+        "mode": "decode",
+        "prompt_len": prompt_len,
+        "max_new_tokens": max_new_tokens,
+        "batch_size": batch_size,
+        "vocab_size": vocab_size,
+        "precision": precision,
+        "device_kind": device_kind,
+        "n_chips": n_chips,
+        "n_params": n_params,
+        "tokens_per_sec": round(tps, 1),
+        "tokens_per_sec_per_chip": round(tps / n_chips, 1),
+        "ms_per_token_step": round(1e3 / steps_per_sec, 3),
+        "seconds_per_call": round(dt / calls, 3),
+    }
+    bw = chip_hbm_bandwidth(device_kind)
+    if bw:
+        # params-only traffic floor (fp32 master weights); the KV-cache
+        # read adds ~2*depth*ctx*d bf16 bytes per sequence per step on top
+        bytes_per_sec = n_params * 4 * steps_per_sec
+        out["mbu_pct"] = round(100.0 * bytes_per_sec / (bw * n_chips), 2)
+        out["hbm_gbps"] = bw / 1e9
+    return out

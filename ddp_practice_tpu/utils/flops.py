@@ -165,11 +165,32 @@ _PEAK_BF16 = {
 def chip_peak_flops(device_kind: str) -> Optional[float]:
     """Peak bf16 FLOP/s for a `jax.Device.device_kind`, or None if unknown
     (e.g. the CPU test backend — MFU is only reported on real TPU)."""
+    return _lookup(_PEAK_BF16, device_kind)
+
+
+# HBM bandwidth per device (bytes/s) — the roofline for autoregressive
+# decode, where every generated token re-reads the whole parameter set.
+_HBM_BYTES = {
+    "TPU v2": 700e9,
+    "TPU v3": 900e9,
+    "TPU v4": 1228e9,
+    "TPU v5 lite": 819e9,    # v5e
+    "TPU v5": 2765e9,        # v5p
+    "TPU v6 lite": 1640e9,   # v6e / Trillium
+}
+
+
+def chip_hbm_bandwidth(device_kind: str) -> Optional[float]:
+    """HBM bytes/s for a `jax.Device.device_kind`, or None if unknown."""
+    return _lookup(_HBM_BYTES, device_kind)
+
+
+def _lookup(table: dict, device_kind: str) -> Optional[float]:
     kind = device_kind.strip()
-    if kind in _PEAK_BF16:
-        return _PEAK_BF16[kind]
+    if kind in table:
+        return table[kind]
     # prefix match handles vendor suffixes like "TPU v5 lite0"
-    for k, v in sorted(_PEAK_BF16.items(), key=lambda kv: -len(kv[0])):
+    for k, v in sorted(table.items(), key=lambda kv: -len(kv[0])):
         if kind.startswith(k):
             return v
     return None
