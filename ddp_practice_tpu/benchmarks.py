@@ -367,7 +367,19 @@ def bench_lm_decode(
     4 bytes/param + the bf16 KV cache read. The whole generation (prefill
     + lax.scan of single-token steps, inference.py) is ONE jitted call;
     timing fences on a host readback of the final tokens.
+
+    The timed window is the full generation call, so the per-decode-step
+    metrics (ms_per_token_step, mbu_pct) amortize prompt prefill over the
+    decode steps — a few percent at the default 128/512 ratio. Configs
+    where prefill would dominate are rejected rather than silently
+    reported as decode rates.
     """
+    if prompt_len > max_new_tokens:
+        raise ValueError(
+            f"prompt_len {prompt_len} > max_new_tokens {max_new_tokens}: "
+            "the timed window includes prefill, so per-decode-step metrics "
+            "would be prefill-dominated — generate more tokens"
+        )
     import time
 
     import jax

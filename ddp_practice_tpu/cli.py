@@ -52,6 +52,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--pos_emb", default="learned", choices=["learned", "rope"],
                    help="LM position encoding: learned absolute table or "
                         "rotary Q/K (relative; long-context default)")
+    p.add_argument("--tied", action="store_true",
+                   help="tie the LM output projection to the token "
+                        "embedding (GPT-2 weight tying)")
     p.add_argument("--data_dir", default="./data")
     p.add_argument("--synthetic_size", type=int, default=0,
                    help="synthetic-fallback corpus size (train split; "
@@ -140,6 +143,7 @@ def config_from_args(args) -> TrainConfig:
         seq_len=args.seq_len,
         remat=args.remat,
         pos_emb=args.pos_emb,
+        tied_embeddings=args.tied,
         epochs=args.epochs,
         batch_size=args.batch_size,
         learning_rate=args.lr,

@@ -145,6 +145,9 @@ class TrainConfig:
     # LM position encoding: "learned" absolute table (GPT-2 style) or
     # "rope" rotary Q/K (relative positions; ops/rope.py)
     pos_emb: str = "learned"
+    # share the token embedding with the output projection (GPT-2 weight
+    # tying): removes the (d, vocab) lm_head parameter
+    tied_embeddings: bool = False
 
     # optimization (reference defaults: origin_main.py:37-52, ddp_main.py:125)
     epochs: int = 3

@@ -72,6 +72,7 @@ def load_lm(args) -> tuple:
         name, policy=policy, vocab_size=vocab, max_len=seq_len,
         remat=bool(extra.get("remat", False)),
         pos_emb=extra.get("pos_emb", "learned"),
+        tied_embeddings=bool(extra.get("tied_embeddings", False)),
     )
     # rebuild the train-state TREE abstractly (shapes only, no init FLOPs)
     # so restore()'s strict path check accepts the leaves

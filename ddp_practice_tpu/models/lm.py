@@ -53,6 +53,12 @@ class TransformerLM(nn.Module):
     # style, tied to max_len). "rope": rotary Q/K inside every attention —
     # relative positions, the long-context default (ops/rope.py).
     pos_emb: str = "learned"
+    # share the token-embedding table with the output projection (GPT-2
+    # weight tying): logits = x @ tok_embed.T — removes the (d, vocab)
+    # lm_head parameter. TP-consistent: tok_embed shards its vocab rows
+    # over 'tensor' (sharding_rules._lm_rule), so the tied logits come out
+    # vocab-sharded exactly like the untied column-parallel head.
+    tied_embeddings: bool = False
     axis_name: Optional[str] = None  # registry uniformity (no BN anywhere)
 
     @nn.compact
@@ -69,13 +75,14 @@ class TransformerLM(nn.Module):
         b, s = tokens.shape
         if s > self.max_len:
             raise ValueError(f"sequence {s} exceeds max_len {self.max_len}")
-        x = nn.Embed(
+        embed = nn.Embed(
             self.vocab_size,
             self.hidden_dim,
             dtype=self.dtype,
             param_dtype=self.param_dtype,
             name="tok_embed",
-        )(tokens)
+        )
+        x = embed(tokens)
         if self.pos_emb not in ("learned", "rope"):
             raise ValueError(
                 f"unknown pos_emb {self.pos_emb!r} (want 'learned'|'rope')"
@@ -134,12 +141,15 @@ class TransformerLM(nn.Module):
         x = nn.LayerNorm(
             dtype=self.dtype, param_dtype=self.param_dtype, name="ln_f"
         )(x)
-        logits = nn.Dense(
-            self.vocab_size,
-            dtype=self.dtype,
-            param_dtype=self.param_dtype,
-            name="lm_head",
-        )(x)
+        if self.tied_embeddings:
+            logits = embed.attend(x)  # x @ tok_embed.T, no lm_head param
+        else:
+            logits = nn.Dense(
+                self.vocab_size,
+                dtype=self.dtype,
+                param_dtype=self.param_dtype,
+                name="lm_head",
+            )(x)
         return logits.astype(jnp.float32)
 
 

@@ -170,6 +170,8 @@ class Trainer:
                 model_kwargs["remat"] = True
             if config.pos_emb != "learned":
                 model_kwargs["pos_emb"] = config.pos_emb
+            if config.tied_embeddings:
+                model_kwargs["tied_embeddings"] = True
             self.model = create_model(
                 config.model, policy=policy, **model_kwargs
             )
@@ -177,6 +179,11 @@ class Trainer:
             raise ValueError(
                 "--pos_emb applies to the LM family (lm_*); "
                 f"{config.model!r} keeps its own position scheme"
+            )
+        elif config.tied_embeddings:
+            raise ValueError(
+                "--tied (embedding/output weight tying) applies to the LM "
+                f"family (lm_*), not {config.model!r}"
             )
         elif config.remat:
             raise ValueError(
@@ -851,6 +858,7 @@ class Trainer:
                 extra["vocab_size"] = self._vocab_size
                 extra["remat"] = bool(cfg.remat)
                 extra["pos_emb"] = cfg.pos_emb
+                extra["tied_embeddings"] = bool(cfg.tied_embeddings)
             if periodic and cfg.checkpoint_async and dist.process_count() == 1:
                 self._pending_save = ckpt.save_async(
                     cfg.checkpoint_dir, self.state, extra=extra
