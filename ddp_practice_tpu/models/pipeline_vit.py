@@ -9,9 +9,13 @@ hopping stages via ppermute (see that module for the schedule).
 
 Embed (patch + position) and head (LN + pool + classifier) run outside the
 pipeline under plain GSPMD, replicated over 'pipe'. Composes with the
-'data' axis (microbatches split the per-shard batch). `init`/`apply`
-duck-type the flax module interface the train steps consume, so the same
-`make_train_step` drives pipelined and sequential models identically.
+'data' axis (microbatches split the per-shard batch), with 'tensor'
+(Megatron specs on the stacked block leaves ride GSPMD inside each stage
+— the pipeline shard_map is manual over 'pipe'/'data' only), and with
+'seq' (ring/Ulysses open a nested island over the still-automatic seq
+axis inside each stage). `init`/`apply` duck-type the flax module
+interface the train steps consume, so the same `make_train_step` drives
+pipelined and sequential models identically.
 """
 
 from __future__ import annotations
@@ -52,15 +56,6 @@ class PipelinedViT:
     ):
         if depth % max(num_stages, 1) != 0:
             raise ValueError(f"depth {depth} % stages {num_stages} != 0")
-        if seq_axis is not None:
-            # fail loudly rather than train without the requested sequence
-            # parallelism: the encoder stack runs inside the pipeline
-            # shard_map, where the GSPMD-side SP wrappers don't apply
-            raise ValueError(
-                "PipelinedViT does not compose sequence parallelism with "
-                "the pipeline yet; use mesh.seq=1 with pipe>1 (supported "
-                "combinations: README 'Parallelism composition')"
-            )
         self.depth = depth
         self.num_stages = num_stages
         self.num_microbatches = num_microbatches
@@ -72,9 +67,13 @@ class PipelinedViT:
             dtype=dtype,
             param_dtype=param_dtype,
         )
+        # seq_axis rides into each stage's attention: the pipeline
+        # shard_map is manual over 'pipe'/'data' only, so ring/Ulysses
+        # open their own nested island over the still-automatic 'seq'
+        # axis (parallel/ring.py _island_mesh_and_spec) — sp x pp composes
         self.block = EncoderBlock(
             num_heads, mlp_dim, dtype=dtype, param_dtype=param_dtype,
-            attn_impl=attn_impl,
+            attn_impl=attn_impl, seq_axis=seq_axis, sp_impl=sp_impl,
         )
         self.head = ViTHead(
             num_classes=num_classes, dtype=dtype, param_dtype=param_dtype

@@ -77,7 +77,7 @@ def ring_attention(
         )
     # batch over data, sequence over the ring axis, heads stay sharded over
     # tensor (heads are independent in attention, so TP composes with SP)
-    spec = P(MeshConfig.AXIS_DATA, axis_name, MeshConfig.AXIS_TENSOR, None)
+    mesh, spec = _island_mesh_and_spec(mesh, axis_name)
     fn = jax.shard_map(
         functools.partial(
             _ring_attention_local, axis_name=axis_name, causal=causal,
@@ -89,6 +89,41 @@ def ring_attention(
         check_vma=False,
     )
     return fn(q, k, v)
+
+
+def _island_mesh_and_spec(mesh, axis_name: str):
+    """Mesh + (batch, seq, heads, None) spec for an SP shard_map island.
+
+    Under an OUTER partial-manual shard_map (the GPipe pipeline is manual
+    over 'pipe'/'data'), a nested island must (a) pass the context
+    AbstractMesh, whose axis_types record which axes are already Manual,
+    and (b) name only still-automatic axes in its specs — the manual ones
+    are already local dims here. That is what lets sequence parallelism
+    run INSIDE a pipeline stage (sp x pp)."""
+    try:
+        from jax.sharding import AxisType
+
+        ctx = jax.sharding.get_abstract_mesh()
+        manual = {
+            n for n, t in zip(ctx.axis_names, ctx.axis_types)
+            if t == AxisType.Manual
+        }
+    except Exception:
+        ctx, manual = None, set()
+    if manual:
+        if axis_name in manual:
+            raise ValueError(
+                f"sequence axis {axis_name!r} is already manual in the "
+                "enclosing shard_map — call the local ring directly"
+            )
+        mesh = ctx
+    spec = P(
+        None if MeshConfig.AXIS_DATA in manual else MeshConfig.AXIS_DATA,
+        axis_name,
+        None if MeshConfig.AXIS_TENSOR in manual else MeshConfig.AXIS_TENSOR,
+        None,
+    )
+    return mesh, spec
 
 
 def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,

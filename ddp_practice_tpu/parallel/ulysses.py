@@ -24,10 +24,12 @@ import functools
 
 import jax
 from jax import lax
-from jax.sharding import PartitionSpec as P
 
-from ddp_practice_tpu.config import MeshConfig
-from ddp_practice_tpu.parallel.ring import _axis_bound, get_current_mesh
+from ddp_practice_tpu.parallel.ring import (
+    _axis_bound,
+    _island_mesh_and_spec,
+    get_current_mesh,
+)
 
 
 def ulysses_attention(q, k, v, *, axis_name: str, causal: bool = False,
@@ -47,7 +49,7 @@ def ulysses_attention(q, k, v, *, axis_name: str, causal: bool = False,
             "ulysses_attention outside shard_map needs a mesh "
             "(set via parallel.ring.set_current_mesh)"
         )
-    spec = P(MeshConfig.AXIS_DATA, axis_name, MeshConfig.AXIS_TENSOR, None)
+    mesh, spec = _island_mesh_and_spec(mesh, axis_name)
     fn = jax.shard_map(
         functools.partial(
             _ulysses_local, axis_name=axis_name, causal=causal, impl=impl

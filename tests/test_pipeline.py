@@ -104,14 +104,28 @@ def test_pipeline_sharded_train_step(pipe_mesh):
     assert not np.allclose(before, after)  # params actually updated
 
 
-def test_pipeline_rejects_sequence_parallelism():
-    """--pipe with --seq must raise, not silently train without SP
-    (VERDICT weak #7: no accepted-but-ignored arguments)."""
-    with pytest.raises(ValueError, match="sequence parallelism"):
-        create_model(
-            "vit_tiny_pipe", num_classes=10, depth=4, num_stages=4,
-            seq_axis=MeshConfig.AXIS_SEQ,
+@pytest.mark.parametrize("sp_impl", ["ring", "ulysses"])
+def test_pipeline_composes_sequence_parallelism(devices, sp_impl):
+    """SP x PP: ring/Ulysses attention opens a nested shard_map island
+    over the still-automatic 'seq' axis inside each pipeline stage; the
+    sharded pipelined forward must match the sequential dense apply."""
+    mesh = build_mesh(MeshConfig(data=2, seq=2, pipe=2))
+    set_current_mesh(mesh)
+    try:
+        piped = create_model(
+            "vit_tiny_pipe", num_stages=2, num_microbatches=2,
+            seq_axis=MeshConfig.AXIS_SEQ, sp_impl=sp_impl, **MODEL_KW
         )
+        seq = create_model("vit_tiny_pipe", num_stages=1, **MODEL_KW)
+        x = _images()
+        variables = seq.init(jax.random.PRNGKey(0), x)
+        want = seq.apply(variables, x)
+        got = piped.apply(variables, x)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5
+        )
+    finally:
+        set_current_mesh(None)
 
 
 @pytest.fixture()
