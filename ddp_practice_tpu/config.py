@@ -155,6 +155,9 @@ class TrainConfig:
     learning_rate: float = 1e-4
     optimizer: str = "sgd"
     momentum: float = 0.0
+    # clip gradients to this global L2 norm before the optimizer update
+    # (0 = off) — the standard transformer-training stabilizer
+    clip_norm: float = 0.0
     weight_decay: float = 0.0
     lr_schedule: str = "constant"     # constant | cosine | warmup_cosine
     warmup_steps: int = 0

@@ -80,6 +80,7 @@ def load_lm(args) -> tuple:
         model=name,
         optimizer=extra.get("optimizer", "sgd"),
         momentum=float(extra.get("momentum", 0.0)),
+        clip_norm=float(extra.get("clip_norm", 0.0)),
         weight_decay=float(extra.get("weight_decay", 0.0)),
         accum_steps=int(extra.get("accum_steps", 1)),
     )

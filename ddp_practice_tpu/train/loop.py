@@ -850,6 +850,7 @@ class Trainer:
                 "model": cfg.model,
                 "optimizer": cfg.optimizer,
                 "momentum": cfg.momentum,
+                "clip_norm": cfg.clip_norm,
                 "weight_decay": cfg.weight_decay,
                 "accum_steps": cfg.accum_steps,
             }

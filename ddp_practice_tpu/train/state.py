@@ -63,6 +63,10 @@ def make_optimizer(config: TrainConfig, steps_per_epoch: int = 0) -> optax.Gradi
         raise ValueError(f"unknown optimizer {config.optimizer!r}")
     if config.weight_decay and config.optimizer == "sgd":
         tx = optax.chain(optax.add_decayed_weights(config.weight_decay), tx)
+    if config.clip_norm:
+        # clip FIRST (on the raw global grad norm), then the optimizer —
+        # the standard transformer-training order
+        tx = optax.chain(optax.clip_by_global_norm(config.clip_norm), tx)
     if config.accum_steps > 1:
         # gradient accumulation: average grads over k micro-steps, apply
         # the inner optimizer on the k-th (optax.MultiSteps). Because it

@@ -25,6 +25,29 @@ def _tiny_setup():
     return model, tx, state
 
 
+def test_clip_norm_bounds_sgd_update():
+    """With SGD lr and clip C, the param delta's global norm is exactly
+    lr*min(C, ||g||): clipping rescales the whole gradient tree, applied
+    BEFORE the optimizer."""
+    import optax
+
+    lr, clip = 0.5, 1e-3
+    cfg = TrainConfig(optimizer="sgd", learning_rate=lr, clip_norm=clip)
+    tx = make_optimizer(cfg)
+    params = {"w": jnp.asarray([3.0, 4.0]), "b": jnp.asarray([12.0])}
+    grads = params  # global norm 13 >> clip
+    opt_state = tx.init(params)
+    updates, _ = tx.update(grads, opt_state, params)
+    got = optax.global_norm(updates)
+    np.testing.assert_allclose(float(got), lr * clip, rtol=1e-6)
+    # below the threshold, clipping is a no-op
+    small = jax.tree.map(lambda g: g * 1e-6, grads)
+    updates, _ = tx.update(small, tx.init(params), params)
+    np.testing.assert_allclose(
+        float(optax.global_norm(updates)), lr * 13.0 * 1e-6, rtol=1e-5
+    )
+
+
 def test_train_step_decreases_loss():
     model, tx, state = _tiny_setup()
     step = make_train_step(model, tx)
