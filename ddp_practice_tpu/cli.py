@@ -64,6 +64,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--momentum", type=float, default=0.0)
     p.add_argument("--clip_norm", type=float, default=0.0,
                    help="clip gradients to this global L2 norm (0 = off)")
+    p.add_argument("--dropout", type=float, default=0.0,
+                   help="dropout rate for the ViT/LM transformer blocks "
+                        "(residual branches + LM embedding; 0 = off)")
     p.add_argument("--weight_decay", type=float, default=0.0)
     p.add_argument("--lr_schedule", default="constant",
                    choices=["constant", "cosine", "warmup_cosine"])
@@ -152,6 +155,7 @@ def config_from_args(args) -> TrainConfig:
         optimizer=args.optimizer,
         momentum=args.momentum,
         clip_norm=args.clip_norm,
+        dropout=args.dropout,
         weight_decay=args.weight_decay,
         lr_schedule=args.lr_schedule,
         scale_lr_by_replicas=args.scale_lr,

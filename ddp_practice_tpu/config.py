@@ -158,6 +158,10 @@ class TrainConfig:
     # clip gradients to this global L2 norm before the optimizer update
     # (0 = off) — the standard transformer-training stabilizer
     clip_norm: float = 0.0
+    # residual-branch + embedding dropout for the transformer families
+    # (ViT, LM); 0 = off. Masks are keyed on the global step (train/steps.py
+    # _step_rngs): deterministic across resume and driver variants.
+    dropout: float = 0.0
     weight_decay: float = 0.0
     lr_schedule: str = "constant"     # constant | cosine | warmup_cosine
     warmup_steps: int = 0

@@ -59,6 +59,9 @@ class TransformerLM(nn.Module):
     # over 'tensor' (sharding_rules._lm_rule), so the tied logits come out
     # vocab-sharded exactly like the untied column-parallel head.
     tied_embeddings: bool = False
+    # embedding + residual-branch dropout (GPT-2 placement); never active
+    # in decode mode (generation always runs deterministic)
+    dropout_rate: float = 0.0
     axis_name: Optional[str] = None  # registry uniformity (no BN anywhere)
 
     @nn.compact
@@ -116,10 +119,16 @@ class TransformerLM(nn.Module):
                 x = x + pos[:, :s].astype(self.dtype)
         # rope: positions enter inside each attention (the blocks' caches
         # already track the decode cursor; nothing to add at the embedding)
+        x = nn.Dropout(
+            self.dropout_rate, deterministic=not (train and not decode)
+        )(x)
         # remat only matters for the training backward pass; the decode path
-        # mutates cache variables, which jax.checkpoint must not wrap
+        # mutates cache variables, which jax.checkpoint must not wrap. The
+        # (decode, train) call args are static under remat (argnums 2, 3 —
+        # self is 0), so dropout composes with rematerialization.
         block_cls = (
-            nn.remat(EncoderBlock) if (self.remat and not decode)
+            nn.remat(EncoderBlock, static_argnums=(2, 3))
+            if (self.remat and not decode)
             else EncoderBlock
         )
         for i in range(self.depth):
@@ -133,11 +142,13 @@ class TransformerLM(nn.Module):
                 attn_impl=self.attn_impl,
                 causal=True,
                 rope=self.pos_emb == "rope",
+                dropout_rate=self.dropout_rate,
                 name=f"block{i}",
             )
-            # only the decode path passes the kwarg: under nn.remat,
-            # jax.checkpoint would reject a non-array argument
-            x = block(x, decode=True) if decode else block(x)
+            # positional (decode, train): nn.remat's static_argnums are
+            # positional indices. Dropout never fires in decode mode —
+            # generation is deterministic whatever the caller passes
+            x = block(x, decode, train and not decode)
         x = nn.LayerNorm(
             dtype=self.dtype, param_dtype=self.param_dtype, name="ln_f"
         )(x)

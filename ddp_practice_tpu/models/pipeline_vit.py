@@ -96,7 +96,11 @@ class PipelinedViT:
             }
         }
 
-    def apply(self, variables, x, *, train: bool = False, mutable=None):
+    def apply(self, variables, x, *, train: bool = False, mutable=None,
+              rngs=None):
+        # rngs accepted for step-interface uniformity; unused (the
+        # pipelined blocks have no stochastic layers — dropout_rate is not
+        # a PipelinedViT knob, and the Trainer refuses --dropout for it)
         p = variables["params"]
         tokens = self.embed.apply({"params": p["embed"]}, x)
         tokens = self.run_blocks(p["blocks"], tokens)

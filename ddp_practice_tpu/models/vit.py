@@ -77,14 +77,16 @@ class MlpBlock(nn.Module):
     mlp_dim: int
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
+    dropout_rate: float = 0.0
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, *, train: bool = False):
         d = x.shape[-1]
         x = nn.Dense(
             self.mlp_dim, dtype=self.dtype, param_dtype=self.param_dtype, name="fc_in"
         )(x)
         x = nn.gelu(x)
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
         x = nn.Dense(d, dtype=self.dtype, param_dtype=self.param_dtype, name="fc_out")(x)
         return x
 
@@ -200,9 +202,17 @@ class EncoderBlock(nn.Module):
     attn_impl: str = "xla"
     causal: bool = False
     rope: bool = False
+    # residual-branch dropout (after the attention projection and inside
+    # the MLP). Deliberately NOT on the attention probabilities: that
+    # variant cannot compose with the flash/ring kernels, which never
+    # materialize the probability matrix.
+    dropout_rate: float = 0.0
 
     @nn.compact
-    def __call__(self, x, *, decode: bool = False):
+    def __call__(self, x, decode: bool = False, train: bool = False):
+        # decode/train are positional-friendly: the LM's remat path wraps
+        # this module in nn.remat(static_argnums=(2, 3)), and jax.checkpoint
+        # only accepts non-array arguments at static positions
         y = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype, name="ln1")(x)
         y = SelfAttention(
             self.num_heads,
@@ -215,11 +225,13 @@ class EncoderBlock(nn.Module):
             rope=self.rope,
             name="attn",
         )(y, decode=decode)
+        y = nn.Dropout(self.dropout_rate, deterministic=not train)(y)
         x = x + y
         y = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype, name="ln2")(x)
         y = MlpBlock(
-            self.mlp_dim, dtype=self.dtype, param_dtype=self.param_dtype, name="mlp"
-        )(y)
+            self.mlp_dim, dtype=self.dtype, param_dtype=self.param_dtype,
+            dropout_rate=self.dropout_rate, name="mlp",
+        )(y, train=train)
         return x + y
 
 
@@ -235,6 +247,7 @@ class ViT(nn.Module):
     seq_axis: Optional[str] = None
     sp_impl: str = "ring"
     attn_impl: str = "xla"
+    dropout_rate: float = 0.0       # residual-branch dropout in every block
     axis_name: Optional[str] = None  # accepted for registry uniformity (no BN)
 
     @nn.compact
@@ -255,8 +268,9 @@ class ViT(nn.Module):
                 seq_axis=self.seq_axis,
                 sp_impl=self.sp_impl,
                 attn_impl=self.attn_impl,
+                dropout_rate=self.dropout_rate,
                 name=f"block{i}",
-            )(x)
+            )(x, train=train)
         return ViTHead(
             num_classes=self.num_classes,
             dtype=self.dtype,

@@ -137,6 +137,21 @@ class Trainer:
                     f"{config.model!r}"
                 )
             model_kwargs["num_heads"] = config.num_heads
+        if config.dropout:
+            if not 0.0 < config.dropout < 1.0:
+                # rate >= 1 would silently zero every residual branch;
+                # negative rates silently rescale activations
+                raise ValueError(
+                    f"--dropout must be in [0, 1), got {config.dropout}"
+                )
+            if config.model not in ("vit_tiny", "vit_base") and not (
+                config.model.startswith("lm")
+            ):
+                raise ValueError(
+                    "--dropout is wired for the dense transformer families "
+                    f"(vit_tiny, vit_base, lm_*), not {config.model!r}"
+                )
+            model_kwargs["dropout_rate"] = config.dropout
         if self.sp > 1:
             model_kwargs["seq_axis"] = MeshConfig.AXIS_SEQ
             model_kwargs["sp_impl"] = config.sp_impl
@@ -259,7 +274,8 @@ class Trainer:
         )
         self.train_step = train_factory(
             self.model, self.tx,
-            label_smoothing=config.label_smoothing, **common,
+            label_smoothing=config.label_smoothing, seed=config.seed,
+            **common,
         )
         self.chunk_step = None
         if config.steps_per_call > 1:
@@ -269,7 +285,8 @@ class Trainer:
             self.chunk_step = chunk_factory(
                 self.model, self.tx,
                 num_steps=config.steps_per_call,
-                label_smoothing=config.label_smoothing, **common,
+                label_smoothing=config.label_smoothing, seed=config.seed,
+                **common,
             )
         self.eval_step = eval_factory(self.model, **common)
         # device-resident data: corpus uploaded to HBM once, epochs driven
@@ -301,6 +318,7 @@ class Trainer:
                 self.model,
                 self.tx,
                 label_smoothing=config.label_smoothing,
+                seed=config.seed,
                 mesh=self.mesh,
                 state_shardings=self.state_shardings,
             )

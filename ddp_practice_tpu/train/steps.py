@@ -40,7 +40,19 @@ def prepare_image(img):
     return img
 
 
-def _train_step_fn(model, tx, label_smoothing: float):
+def _step_rngs(step, seed: int = 0):
+    """Per-step RNGs for stochastic layers (dropout).
+
+    Keyed on (run seed, global step): reproducible for a given --seed,
+    decorrelated across seeds, deterministic across checkpoint resume
+    (state.step restores), and identical under the per-step, chunked-scan,
+    and device-resident drivers at the same step. Under GSPMD the key is
+    replicated and the dropout mask is a global array — each device
+    materializes only its shard."""
+    return {"dropout": jax.random.fold_in(jax.random.PRNGKey(seed), step)}
+
+
+def _train_step_fn(model, tx, label_smoothing: float, seed: int = 0):
     """The pure (state, batch) -> (state, metrics) function both the
     per-step and the scan-chunked factories jit."""
 
@@ -55,7 +67,7 @@ def _train_step_fn(model, tx, label_smoothing: float):
                 mutable.append("batch_stats")
             logits, updated = model.apply(
                 variables, prepare_image(batch["image"]), train=True,
-                mutable=mutable,
+                mutable=mutable, rngs=_step_rngs(state.step, seed),
             )
             new_stats = updated["batch_stats"] if has_bn else None
             loss = cross_entropy(
@@ -101,6 +113,7 @@ def make_train_step(
     tx,
     *,
     label_smoothing: float = 0.0,
+    seed: int = 0,
     mesh=None,
     state_shardings=None,
     batch_shardings=None,
@@ -110,7 +123,7 @@ def make_train_step(
     When mesh/shardings are given, they pin input/output layouts (GSPMD);
     the state buffer is donated so parameters update in place in HBM.
     """
-    train_step = _train_step_fn(model, tx, label_smoothing)
+    train_step = _train_step_fn(model, tx, label_smoothing, seed)
     if mesh is not None and state_shardings is not None:
         from ddp_practice_tpu.parallel.mesh import replicated
 
@@ -146,6 +159,7 @@ def make_chunked_train_step(
     *,
     num_steps: int,
     label_smoothing: float = 0.0,
+    seed: int = 0,
     mesh=None,
     state_shardings=None,
     batch_shardings=None,
@@ -159,7 +173,7 @@ def make_chunked_train_step(
     XLA program amortizes both by K. Identical math to K calls of
     make_train_step. Returned metrics are the final step's.
     """
-    step_fn = _train_step_fn(model, tx, label_smoothing)
+    step_fn = _train_step_fn(model, tx, label_smoothing, seed)
 
     def chunk_step(state, batches):
         state, ms = jax.lax.scan(step_fn, state, batches)
@@ -179,7 +193,7 @@ def make_chunked_train_step(
     return jax.jit(chunk_step, donate_argnums=0)
 
 
-def _lm_train_step_fn(model, tx, label_smoothing: float = 0.0):
+def _lm_train_step_fn(model, tx, label_smoothing: float = 0.0, seed: int = 0):
     """(state, batch) -> (state, metrics) for next-token language modeling.
 
     batch["tokens"] is (batch, seq+1) int32; position t predicts t+1 (the
@@ -194,7 +208,10 @@ def _lm_train_step_fn(model, tx, label_smoothing: float = 0.0):
         weight = batch.get("weight")
 
         def loss_fn(params):
-            logits = model.apply({"params": params}, inputs, train=True)
+            logits = model.apply(
+                {"params": params}, inputs, train=True,
+                rngs=_step_rngs(state.step, seed),
+            )
             loss = cross_entropy(
                 logits, targets, weight=weight,
                 label_smoothing=label_smoothing,
@@ -229,6 +246,7 @@ def make_lm_train_step(
     tx,
     *,
     label_smoothing: float = 0.0,
+    seed: int = 0,
     mesh=None,
     state_shardings=None,
     batch_shardings=None,
@@ -236,7 +254,7 @@ def make_lm_train_step(
     """Jitted next-token LM train step; sharding contract identical to
     make_train_step (batch leaves sharded over 'data' and — for sequence
     parallelism — the token dim over 'seq')."""
-    train_step = _lm_train_step_fn(model, tx, label_smoothing)
+    train_step = _lm_train_step_fn(model, tx, label_smoothing, seed)
     if mesh is not None and state_shardings is not None:
         from ddp_practice_tpu.parallel.mesh import replicated
 
@@ -256,6 +274,7 @@ def make_chunked_lm_train_step(
     *,
     num_steps: int,
     label_smoothing: float = 0.0,
+    seed: int = 0,
     mesh=None,
     state_shardings=None,
     batch_shardings=None,
@@ -263,7 +282,7 @@ def make_chunked_lm_train_step(
     """K LM steps per dispatch (`lax.scan` over stacked token batches) —
     the dispatch-amortization scheme of make_chunked_train_step for the
     LM objective."""
-    step_fn = _lm_train_step_fn(model, tx, label_smoothing)
+    step_fn = _lm_train_step_fn(model, tx, label_smoothing, seed)
 
     def chunk_step(state, batches):
         state, ms = jax.lax.scan(step_fn, state, batches)
@@ -333,6 +352,7 @@ def make_resident_train_step(
     tx,
     *,
     label_smoothing: float = 0.0,
+    seed: int = 0,
     mesh=None,
     state_shardings=None,
 ):
@@ -352,7 +372,7 @@ def make_resident_train_step(
     G is read from idx's shape — one factory serves any group size; each
     distinct G compiles once. Returned metrics are the final step's.
     """
-    step_fn = _train_step_fn(model, tx, label_smoothing)
+    step_fn = _train_step_fn(model, tx, label_smoothing, seed)
     bsh = None
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec as P

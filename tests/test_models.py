@@ -74,3 +74,63 @@ def test_train_eval_mode_differ_through_bn():
     out_train, _ = model.apply(variables, x, train=True, mutable=["batch_stats"])
     out_eval = model.apply(variables, x, train=False)
     assert not np.allclose(np.asarray(out_train), np.asarray(out_eval))
+
+
+def test_vit_dropout_behavior():
+    """Dropout: off by default (rate 0 == pre-dropout numerics, no rng
+    needed); with rate > 0, train mode is stochastic per rng while eval is
+    deterministic and rng-free."""
+    x = jnp.asarray(
+        np.random.default_rng(1).normal(size=(2, 32, 32, 3)), jnp.float32
+    )
+    plain = create_model("vit_tiny", depth=2, hidden_dim=32, num_heads=4,
+                         mlp_dim=64)
+    drop = create_model("vit_tiny", depth=2, hidden_dim=32, num_heads=4,
+                        mlp_dim=64, dropout_rate=0.5)
+    variables = plain.init(jax.random.PRNGKey(0), x)
+    # identical params tree: dropout adds no parameters
+    a = plain.apply(variables, x)
+    b = drop.apply(variables, x)  # eval mode: dropout inert, no rng needed
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    r1 = drop.apply(variables, x, train=True,
+                    rngs={"dropout": jax.random.PRNGKey(1)})
+    r2 = drop.apply(variables, x, train=True,
+                    rngs={"dropout": jax.random.PRNGKey(2)})
+    same = drop.apply(variables, x, train=True,
+                      rngs={"dropout": jax.random.PRNGKey(1)})
+    assert not np.allclose(np.asarray(r1), np.asarray(r2))
+    np.testing.assert_array_equal(np.asarray(r1), np.asarray(same))
+    assert not np.allclose(np.asarray(r1), np.asarray(a))
+
+
+def test_lm_dropout_composes_with_remat_and_decode():
+    """LM dropout: trains under remat (static train arg through
+    jax.checkpoint), and generation (decode) stays deterministic — dropout
+    never fires in decode mode."""
+    from ddp_practice_tpu.inference import make_cache
+
+    model = create_model(
+        "lm_tiny", vocab_size=32, max_len=32, hidden_dim=32, depth=2,
+        num_heads=4, mlp_dim=64, dropout_rate=0.3, remat=True,
+    )
+    tokens = jnp.asarray(
+        np.random.default_rng(2).integers(0, 32, (2, 12)), jnp.int32
+    )
+    variables = model.init(jax.random.PRNGKey(0), tokens)
+    g = jax.grad(
+        lambda p: jnp.sum(
+            model.apply({"params": p}, tokens, train=True,
+                        rngs={"dropout": jax.random.PRNGKey(3)}) ** 2
+        )
+    )(variables["params"])
+    assert all(np.all(np.isfinite(np.asarray(x))) for x in jax.tree.leaves(g))
+
+    full = model.apply(variables, tokens)  # eval: deterministic
+    cache = make_cache(model, 2, 12)
+    logits, _ = model.apply(
+        {"params": variables["params"], "cache": cache},
+        tokens[:, :5], decode=True, mutable=["cache"],
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits), np.asarray(full[:, :5]), rtol=2e-5, atol=2e-5
+    )
