@@ -61,6 +61,11 @@ def load_lm(args) -> tuple:
             f"checkpoint model {name!r} is not an LM (lm_*) — generation "
             "needs a decoder; pass --model to override"
         )
+    if name == "lm_pipe":
+        raise SystemExit(
+            "lm_pipe has no KV-cache decode path — generate from an "
+            "equivalent lm_tiny/lm_base checkpoint instead"
+        )
     seq_len = args.seq_len or int(extra.get("seq_len", 2048))
     vocab = int(extra.get("vocab_size", 256))
     policy = (

@@ -15,6 +15,7 @@ from ddp_practice_tpu.config import PrecisionPolicy
 from ddp_practice_tpu.models.convnet import ConvNet
 from ddp_practice_tpu.models.resnet import ResNet, ResNet18, ResNet50
 from ddp_practice_tpu.models.vit import ViT, ViTBase, ViTTiny
+from ddp_practice_tpu.models.pipeline_lm import PipelinedLM
 from ddp_practice_tpu.models.pipeline_vit import PipelinedViT
 from ddp_practice_tpu.models.vit_moe import ViTMoE
 from ddp_practice_tpu.models.lm import LMBase, LMTiny, TransformerLM
@@ -153,6 +154,21 @@ def _vit_tiny_pipe(*, num_classes, policy, axis_name, **kw):
     )
 
 
+@register("lm_pipe")
+def _lm_pipe(*, num_classes, policy, axis_name, **kw):
+    # LM registry convention: num_classes/axis_name accepted and ignored
+    # (vocab_size is the explicit kwarg); defaults mirror lm_tiny
+    kw.setdefault("hidden_dim", 256)
+    kw.setdefault("depth", 4)
+    kw.setdefault("num_heads", 8)
+    kw.setdefault("mlp_dim", 1024)
+    return PipelinedLM(
+        dtype=policy.compute_dtype,
+        param_dtype=policy.param_dtype,
+        **kw,
+    )
+
+
 __all__ = [
     "create_model",
     "ConvNet",
@@ -162,6 +178,7 @@ __all__ = [
     "ViT",
     "ViTTiny",
     "ViTBase",
+    "PipelinedLM",
     "PipelinedViT",
     "ViTMoE",
     "TransformerLM",

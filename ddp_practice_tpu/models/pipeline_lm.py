@@ -1,0 +1,216 @@
+"""Pipeline-parallel decoder LM: stage-sharded causal block stack.
+
+The LM counterpart of PipelinedViT (models/pipeline_vit.py) — nothing
+like either exists in the reference (SURVEY §2.3, "Pipeline parallel —
+No"). Embedding (token table + learned positions, or RoPE inside the
+blocks) and the final LN + vocab projection run outside the pipeline
+under plain GSPMD; the causal EncoderBlock stack is depth-stacked,
+stage-sharded over 'pipe', and scheduled by `pipeline_apply` (GPipe
+microbatches over the BATCH dim — the sequence stays whole per
+microbatch, so causal masking is untouched by the schedule).
+
+Composes like the ViT pipeline: 'data' (microbatch split), 'tensor'
+(Megatron specs on the stacked leaves ride GSPMD inside each stage),
+'seq' (ring/Ulysses nested island inside each stage — causal ring).
+Decode/KV-cache generation is NOT wired for the pipelined variant
+(generate from the equivalent lm_tiny/lm_base checkpoint instead);
+tied embeddings and dropout are likewise the dense family's features.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ddp_practice_tpu.config import MeshConfig
+from ddp_practice_tpu.models.vit import EncoderBlock
+from ddp_practice_tpu.parallel.pipeline import pipeline_apply, stack_stages
+
+
+class _LMEmbed(nn.Module):
+    """Token embedding + (optionally) learned positions.
+
+    Mirrors TransformerLM's inline embed (models/lm.py) — the layouts are
+    hand-synchronized, and tests/test_pipeline_lm.py pins the numeric
+    equivalence by mapping a dense param tree into this layout."""
+
+    vocab_size: int
+    max_len: int
+    hidden_dim: int
+    pos_emb: str = "learned"
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, tokens):
+        b, s = tokens.shape
+        x = nn.Embed(
+            self.vocab_size,
+            self.hidden_dim,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            name="tok_embed",
+        )(tokens)
+        if self.pos_emb == "learned":
+            pos = self.param(
+                "pos_embed",
+                nn.initializers.normal(stddev=0.02),
+                (1, self.max_len, self.hidden_dim),
+                self.param_dtype,
+            )
+            x = x + pos[:, :s].astype(self.dtype)
+        return x
+
+
+class _LMHead(nn.Module):
+    """Final LN + vocab projection; logits fp32."""
+
+    vocab_size: int
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.LayerNorm(
+            dtype=self.dtype, param_dtype=self.param_dtype, name="ln_f"
+        )(x)
+        logits = nn.Dense(
+            self.vocab_size,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            name="lm_head",
+        )(x)
+        return logits.astype(jnp.float32)
+
+
+class PipelinedLM:
+    """Duck-typed model: init(rng, tokens) -> variables; apply(...)."""
+
+    def __init__(
+        self,
+        *,
+        vocab_size: int = 256,
+        max_len: int = 2048,
+        hidden_dim: int = 256,
+        depth: int = 4,
+        num_heads: int = 8,
+        mlp_dim: int = 1024,
+        dtype: jnp.dtype = jnp.float32,
+        param_dtype: jnp.dtype = jnp.float32,
+        num_stages: int = 1,
+        num_microbatches: int = 4,
+        pipe_axis: str = MeshConfig.AXIS_PIPE,
+        remat: bool = True,
+        pos_emb: str = "learned",
+        seq_axis: Optional[str] = None,
+        sp_impl: str = "ring",
+        attn_impl: str = "xla",
+        axis_name: Optional[str] = None,
+    ):
+        if depth % max(num_stages, 1) != 0:
+            raise ValueError(f"depth {depth} % stages {num_stages} != 0")
+        if pos_emb not in ("learned", "rope"):
+            raise ValueError(f"unknown pos_emb {pos_emb!r}")
+        self.vocab_size = vocab_size
+        self.max_len = max_len
+        self.hidden_dim = hidden_dim
+        self.depth = depth
+        self.num_heads = num_heads
+        self.mlp_dim = mlp_dim
+        self.num_stages = num_stages
+        self.num_microbatches = num_microbatches
+        self.pipe_axis = pipe_axis
+        self.remat = remat
+        self.embed = _LMEmbed(
+            vocab_size=vocab_size,
+            max_len=max_len,
+            hidden_dim=hidden_dim,
+            pos_emb=pos_emb,
+            dtype=dtype,
+            param_dtype=param_dtype,
+        )
+        self.block = EncoderBlock(
+            num_heads, mlp_dim, dtype=dtype, param_dtype=param_dtype,
+            attn_impl=attn_impl, seq_axis=seq_axis, sp_impl=sp_impl,
+            causal=True, rope=pos_emb == "rope",
+        )
+        self.head = _LMHead(
+            vocab_size=vocab_size, dtype=dtype, param_dtype=param_dtype
+        )
+
+    def init(self, rng, tokens, *, train: bool = False):
+        if tokens.shape[1] > self.max_len:
+            raise ValueError(
+                f"sequence {tokens.shape[1]} exceeds max_len {self.max_len}"
+            )
+        r_embed, r_blocks, r_head = jax.random.split(rng, 3)
+        embed_vars = self.embed.init(r_embed, tokens)
+        x = self.embed.apply(embed_vars, tokens)
+        keys = jax.random.split(r_blocks, self.depth)
+        block_params = jax.vmap(
+            lambda k: self.block.init(k, x)["params"]
+        )(keys)
+        head_vars = self.head.init(r_head, x)
+        return {
+            "params": {
+                "embed": embed_vars["params"],
+                "blocks": block_params,
+                "head": head_vars["params"],
+            }
+        }
+
+    def apply(self, variables, tokens, *, train: bool = False, mutable=None,
+              rngs=None):
+        # train/rngs accepted for step-interface uniformity; the pipelined
+        # blocks have no stochastic layers (dropout is a dense-LM feature)
+        if tokens.shape[1] > self.max_len:
+            raise ValueError(
+                f"sequence {tokens.shape[1]} exceeds max_len {self.max_len}"
+            )
+        p = variables["params"]
+        x = self.embed.apply({"params": p["embed"]}, tokens)
+        x = self.run_blocks(p["blocks"], x)
+        out = self.head.apply({"params": p["head"]}, x)
+        if mutable is not None:
+            return out, {}
+        return out
+
+    def run_blocks(self, block_params, x):
+        if self.num_stages <= 1:
+            return self._sequential(block_params, x)
+        stages = stack_stages(block_params, self.num_stages)
+
+        def block_fn(stage_params, xb):
+            def body(h, bp):
+                return self.block.apply({"params": bp}, h), None
+
+            h, _ = lax.scan(body, xb, stage_params)
+            return h
+
+        return pipeline_apply(
+            block_fn,
+            stages,
+            x,
+            num_microbatches=self.num_microbatches,
+            axis_name=self.pipe_axis,
+            remat=self.remat,
+        )
+
+    def _sequential(self, block_params, x):
+        # honor remat on the unpipelined path too (num_stages == 1): the
+        # trainer forwards --remat here, and silently training with full
+        # O(depth) activation memory would contradict the flag
+        apply_block = (
+            jax.checkpoint(self.block.apply) if self.remat
+            else self.block.apply
+        )
+
+        def body(h, bp):
+            return apply_block({"params": bp}, h), None
+
+        h, _ = lax.scan(body, x, block_params)
+        return h

@@ -102,6 +102,16 @@ def _lm_rule(path, leaf) -> Optional[P]:
     return _vit_rule(path, leaf)
 
 
+def _lm_pipe_rule(path, leaf) -> Optional[P]:
+    """Pipelined LM: stacked causal blocks shard like the pipelined ViT
+    (stage dim over 'pipe' + Megatron inner dims over 'tensor'); the
+    out-of-pipeline embed/head take the dense LM's vocab sharding."""
+    name = keystr(path)
+    if "'blocks'" in name:
+        return _vit_pipe_rule(path, leaf)  # same stacked-block layout
+    return _lm_rule(path, leaf)
+
+
 _RULES: dict = {
     "vit": _vit_rule,
     "vit_tiny": _vit_rule,
@@ -110,6 +120,7 @@ _RULES: dict = {
     "vit_tiny_moe": _vit_moe_rule,
     "lm_tiny": _lm_rule,
     "lm_base": _lm_rule,
+    "lm_pipe": _lm_pipe_rule,
 }
 
 

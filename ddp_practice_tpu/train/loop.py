@@ -145,11 +145,12 @@ class Trainer:
                     f"--dropout must be in [0, 1), got {config.dropout}"
                 )
             if config.model not in ("vit_tiny", "vit_base") and not (
-                config.model.startswith("lm")
+                config.model.startswith("lm") and config.model != "lm_pipe"
             ):
                 raise ValueError(
                     "--dropout is wired for the dense transformer families "
-                    f"(vit_tiny, vit_base, lm_*), not {config.model!r}"
+                    f"(vit_tiny, vit_base, lm_tiny/lm_base), not "
+                    f"{config.model!r}"
                 )
             model_kwargs["dropout_rate"] = config.dropout
         if self.sp > 1:
@@ -186,7 +187,17 @@ class Trainer:
             if config.pos_emb != "learned":
                 model_kwargs["pos_emb"] = config.pos_emb
             if config.tied_embeddings:
+                if config.model == "lm_pipe":
+                    raise ValueError(
+                        "--tied is not wired for the pipelined LM — use "
+                        "lm_tiny/lm_base for weight tying"
+                    )
                 model_kwargs["tied_embeddings"] = True
+            if self.pp > 1 and config.model != "lm_pipe":
+                raise ValueError(
+                    "pipeline parallelism for language models uses the "
+                    "stage-sharded variant: --model lm_pipe"
+                )
             self.model = create_model(
                 config.model, policy=policy, **model_kwargs
             )
