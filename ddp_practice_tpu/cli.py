@@ -121,6 +121,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="cap steps per epoch (smoke runs; 0 = full epoch)")
     p.add_argument("--log_every", type=int, default=100)
     p.add_argument("--profile_dir", default=None)
+    p.add_argument("--metrics_file", default=None, metavar="PATH",
+                   help="append one JSON record per logged step / eval / "
+                        "summary (training curves; process 0 only)")
     p.add_argument("--loader", default="auto", choices=["auto", "native", "python"])
     p.add_argument("--steps_per_call", type=int, default=1,
                    help="K optimizer steps per jitted call (amortizes host "
@@ -186,6 +189,7 @@ def config_from_args(args) -> TrainConfig:
         max_steps_per_epoch=args.max_steps,
         log_every_steps=args.log_every,
         profile_dir=args.profile_dir,
+        metrics_file=args.metrics_file,
         loader_backend=args.loader,
         steps_per_call=args.steps_per_call,
         data_placement=args.data_placement,

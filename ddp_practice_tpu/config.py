@@ -235,6 +235,11 @@ class TrainConfig:
     eval_every_epochs: int = 0         # 0 = only at end (reference behavior)
     log_every_steps: int = 100
     profile_dir: Optional[str] = None
+    # append one JSON record per logged train step / eval / run summary
+    # (process 0 only) — machine-readable training curves next to the
+    # human stdout logs; records carry the global step, so resumed runs
+    # append seamlessly
+    metrics_file: Optional[str] = None
 
     # input pipeline
     loader_backend: str = "auto"       # auto | native | python

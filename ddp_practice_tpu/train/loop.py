@@ -377,6 +377,16 @@ class Trainer:
         self._serialize_steps = jax.default_backend() == "cpu"
         self._watchdog = None
         self._pending_save = None  # in-flight async checkpoint write
+        self._metrics_fh = None
+        if config.metrics_file and dist.process_index() == 0:
+            import os
+
+            d = os.path.dirname(config.metrics_file)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            # append: records carry the global step, so a resumed run's
+            # curve continues the same file
+            self._metrics_fh = open(config.metrics_file, "a")
         # ladder of per-step scalar futures (see _probe_if_due)
         from collections import deque
 
@@ -564,6 +574,12 @@ class Trainer:
                 "epoch %d step %d loss %.4f acc %.3f",
                 epoch, steps_done, float(m["loss"]), float(m["accuracy"]),
             )
+            self._write_metrics({
+                "kind": "train",
+                "epoch": epoch,
+                "step": int(self.state.step),
+                **{k: float(v) for k, v in m.items()},
+            })
         if (
             cfg.checkpoint_dir
             and cfg.checkpoint_every_steps
@@ -571,6 +587,18 @@ class Trainer:
             != steps_done // cfg.checkpoint_every_steps
         ):
             self.save(periodic=True)
+
+    def _write_metrics(self, record: dict) -> None:
+        """Append one JSON line to the metrics file (process 0; no-op
+        otherwise). Flushed per record so a crashed run's curve survives."""
+        if self._metrics_fh is None:
+            return
+        import json
+        import time as _time
+
+        record.setdefault("time", _time.time())
+        self._metrics_fh.write(json.dumps(record) + "\n")
+        self._metrics_fh.flush()
 
     def _close_train_epoch(self, final_metrics) -> None:
         """End-of-epoch fence shared by both train loops: drain the probe
@@ -918,6 +946,11 @@ class Trainer:
                 except Exception:
                     log.exception("async checkpoint write failed")
                 self._pending_save = None
+            if self._metrics_fh is not None:
+                # crash path: a restarted Trainer reopens the same file in
+                # append mode; don't leak this fd until GC
+                self._metrics_fh.close()
+                self._metrics_fh = None
 
     def _fit_inner(self) -> dict:
         cfg = self.config
@@ -940,6 +973,12 @@ class Trainer:
             if cfg.eval_every_epochs and (epoch + 1) % cfg.eval_every_epochs == 0:
                 accuracy = self.evaluate()
                 info0("Accuracy is %.2f%%", accuracy * 100.0)
+                self._write_metrics({
+                    "kind": "eval", "epoch": epoch,
+                    "step": int(self.state.step), "accuracy": accuracy,
+                    **({"perplexity": self.eval_perplexity}
+                       if self.eval_perplexity is not None else {}),
+                })
             if cfg.checkpoint_every_epochs and (epoch + 1) % cfg.checkpoint_every_epochs == 0:
                 self.save()
         if accuracy is None or not cfg.eval_every_epochs:
@@ -968,6 +1007,7 @@ class Trainer:
         info0("time elapsed: %.2fs", elapsed)
         info0("throughput: %.1f images/sec (%.1f /chip)",
               ips, ips / jax.device_count())
+        self._write_metrics({"kind": "summary", **summary})
         return summary
 
 

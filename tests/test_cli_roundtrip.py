@@ -51,6 +51,27 @@ def test_train_then_generate_roundtrip(tmp_path, capsys, devices):
     assert first == second
 
 
+def test_metrics_file_records_curves(tmp_path, capsys, devices):
+    """--metrics_file: JSONL with per-step train records (monotone steps),
+    an eval-derived record stream, and a final summary matching stdout."""
+    mf = tmp_path / "m" / "metrics.jsonl"
+    summary = _train(
+        tmp_path, capsys,
+        "--metrics_file", str(mf), "--log_every", "2", "--eval_every", "1",
+    )
+    records = [json.loads(l) for l in mf.read_text().splitlines()]
+    kinds = [r["kind"] for r in records]
+    assert kinds[-1] == "summary"
+    eval_recs = [r for r in records if r["kind"] == "eval"]
+    assert eval_recs and all("accuracy" in r for r in eval_recs)
+    train_recs = [r for r in records if r["kind"] == "train"]
+    assert train_recs and all("loss" in r and "time" in r for r in train_recs)
+    steps = [r["step"] for r in train_recs]
+    assert steps == sorted(steps)
+    assert records[-1]["steps"] == summary["steps"]
+    assert records[-1]["accuracy"] == summary["accuracy"]
+
+
 def test_generate_rejects_non_lm_checkpoint(tmp_path, capsys, devices):
     argv = [
         "--model", "convnet", "--dataset", "synthetic",
