@@ -983,6 +983,12 @@ class Trainer:
                 self.save()
         if accuracy is None or not cfg.eval_every_epochs:
             accuracy = self.evaluate()
+            self._write_metrics({
+                "kind": "eval", "epoch": cfg.epochs - 1,
+                "step": int(self.state.step), "accuracy": accuracy,
+                **({"perplexity": self.eval_perplexity}
+                   if self.eval_perplexity is not None else {}),
+            })
         self.save()
         elapsed = timer.elapsed()
         ips = self._train_images / max(self._train_seconds, 1e-9)
