@@ -63,7 +63,8 @@ _SUITE = {
     ),
     # autoregressive generation (KV-cache decode, inference.py): tokens/sec
     # + model-bandwidth utilization — decode re-reads all params per token,
-    # so the roofline is HBM, not the MXU. Opt-in: `--models lm_decode`.
+    # so the roofline is HBM, not the MXU (67.8% of the params-streaming
+    # bound single-stream at bs=1; the default bs=8 trades MBU for rate)
     "lm_decode": dict(
         kind="decode", prompt_len=128, max_new_tokens=512, batch_size=8,
         calls=3,
@@ -74,7 +75,8 @@ _SUITE = {
 def main(argv=None) -> int:
     p = argparse.ArgumentParser("bench")
     p.add_argument("--models",
-                   default="vit_base,vit_tiny,convnet,resnet18,resnet50,lm_long",
+                   default="vit_base,vit_tiny,convnet,resnet18,resnet50,"
+                           "lm_long,lm_decode",
                    help="comma-separated; first successful is the headline")
     p.add_argument("--precision", default="bf16", choices=["fp32", "bf16"])
     p.add_argument("--batch_size", type=int, default=0, help="override")

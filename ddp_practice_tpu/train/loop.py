@@ -600,6 +600,16 @@ class Trainer:
         self._metrics_fh.write(json.dumps(record) + "\n")
         self._metrics_fh.flush()
 
+    def _write_eval_record(self, epoch: int, accuracy: float) -> None:
+        """One {kind: "eval"} metrics record — shared by the in-loop
+        (--eval_every) and end-of-run eval sites."""
+        self._write_metrics({
+            "kind": "eval", "epoch": epoch,
+            "step": int(self.state.step), "accuracy": accuracy,
+            **({"perplexity": self.eval_perplexity}
+               if self.eval_perplexity is not None else {}),
+        })
+
     def _close_train_epoch(self, final_metrics) -> None:
         """End-of-epoch fence shared by both train loops: drain the probe
         ladder rung by rung (beats during the wait), then close timing on
@@ -973,22 +983,12 @@ class Trainer:
             if cfg.eval_every_epochs and (epoch + 1) % cfg.eval_every_epochs == 0:
                 accuracy = self.evaluate()
                 info0("Accuracy is %.2f%%", accuracy * 100.0)
-                self._write_metrics({
-                    "kind": "eval", "epoch": epoch,
-                    "step": int(self.state.step), "accuracy": accuracy,
-                    **({"perplexity": self.eval_perplexity}
-                       if self.eval_perplexity is not None else {}),
-                })
+                self._write_eval_record(epoch, accuracy)
             if cfg.checkpoint_every_epochs and (epoch + 1) % cfg.checkpoint_every_epochs == 0:
                 self.save()
         if accuracy is None or not cfg.eval_every_epochs:
             accuracy = self.evaluate()
-            self._write_metrics({
-                "kind": "eval", "epoch": cfg.epochs - 1,
-                "step": int(self.state.step), "accuracy": accuracy,
-                **({"perplexity": self.eval_perplexity}
-                   if self.eval_perplexity is not None else {}),
-            })
+            self._write_eval_record(cfg.epochs - 1, accuracy)
         self.save()
         elapsed = timer.elapsed()
         ips = self._train_images / max(self._train_seconds, 1e-9)
