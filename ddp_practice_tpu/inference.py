@@ -107,7 +107,7 @@ def make_generate_fn(
     sampling parameters are closed over as compile-time constants.
     """
 
-    def gen(params, prompt, key=None):
+    def gen(params, prompt, key=None, prompt_lens=None):
         b, prompt_len = prompt.shape
         if prompt_len == 0:
             raise ValueError("prompt must contain at least one token")
@@ -119,12 +119,28 @@ def make_generate_fn(
             )
         if temperature != 0.0 and key is None:
             raise ValueError("sampling (temperature != 0) needs a PRNG key")
+        # variable-length batching: prompts arrive LEFT-padded (real tokens
+        # right-aligned, pad_left_prompts builds this layout), so every
+        # sequence's last prompt token sits at the same index and the
+        # decode scan needs no per-sequence cursors; attn_start masks the
+        # left padding out of every attention. RoPE-only (models/lm.py).
+        attn_start = None
+        if prompt_lens is not None:
+            # lengths are traced under jit, so out-of-range values can't
+            # raise here; clamp to [1, prompt_len] instead — a negative
+            # start would silently attend the padding, a start past the
+            # last prompt slot would leave query rows with no valid keys
+            lens = jnp.clip(
+                jnp.asarray(prompt_lens, jnp.int32), 1, prompt_len
+            )
+            attn_start = (prompt_len - lens).astype(jnp.int32)
         cache = make_cache(model, b, total)
         logits, mut = model.apply(
             {"params": params, "cache": cache},
             prompt,
             decode=True,
             mutable=["cache"],
+            attn_start=attn_start,
         )
         carry_key = key if key is not None else jax.random.PRNGKey(0)
         done = jnp.zeros((b,), bool)
@@ -144,6 +160,7 @@ def make_generate_fn(
                 tok[:, None],
                 decode=True,
                 mutable=["cache"],
+                attn_start=attn_start,
             )
             return (mut["cache"], logits[:, -1], k, done), tok
 
@@ -156,6 +173,24 @@ def make_generate_fn(
         return jnp.concatenate([prompt, toks.T], axis=1)
 
     return gen
+
+
+def pad_left_prompts(prompts, pad_id: int = 0):
+    """Batch variable-length token lists as a LEFT-padded array.
+
+    Returns (tokens (b, max_len) int32, lengths (b,) int32) for
+    `gen(params, tokens, key, prompt_lens=lengths)` — real tokens are
+    right-aligned so all sequences share the decode cursor, and the
+    returned lengths drive the attention mask over the padding.
+    """
+    lens = np.asarray([len(p) for p in prompts], np.int32)
+    if (lens == 0).any():
+        raise ValueError("every prompt must contain at least one token")
+    width = int(lens.max())
+    out = np.full((len(prompts), width), pad_id, np.int32)
+    for i, p in enumerate(prompts):
+        out[i, width - len(p):] = np.asarray(p, np.int32)
+    return jnp.asarray(out), jnp.asarray(lens)
 
 
 def encode_bytes(text: str) -> np.ndarray:

@@ -65,7 +65,8 @@ class TransformerLM(nn.Module):
     axis_name: Optional[str] = None  # registry uniformity (no BN anywhere)
 
     @nn.compact
-    def __call__(self, tokens, *, train: bool = False, decode: bool = False):
+    def __call__(self, tokens, *, train: bool = False, decode: bool = False,
+                 attn_start=None):
         """tokens (batch, seq) int32 -> logits (batch, seq, vocab) fp32.
 
         `decode=True` is KV-cache inference mode (inference.py): the call
@@ -74,7 +75,24 @@ class TransformerLM(nn.Module):
         (s = prompt length) and single-token generation steps (s = 1).
         Initialize the cache collection by calling `init`/`eval_shape` with
         a max-generation-length input and `decode=True`.
+
+        `attn_start` (b,) int32, decode-only: first real (non-pad) key
+        position per sequence — the variable-length-prompt mask for
+        LEFT-padded batches (inference.py). Requires pos_emb="rope":
+        rotary scores depend only on relative offsets, so a uniform left
+        shift is invisible; a learned absolute table would silently
+        misplace every real token, so that combination raises.
         """
+        if attn_start is not None and self.pos_emb != "rope":
+            raise ValueError(
+                "variable-length (left-padded) prompts need pos_emb='rope' "
+                "— learned absolute positions would shift with the padding"
+            )
+        if attn_start is not None and not decode:
+            raise ValueError(
+                "attn_start is a KV-cache decode feature (inference.py); "
+                "the training forward has no left-padding mask"
+            )
         b, s = tokens.shape
         if s > self.max_len:
             raise ValueError(f"sequence {s} exceeds max_len {self.max_len}")
@@ -147,8 +165,13 @@ class TransformerLM(nn.Module):
             )
             # positional (decode, train): nn.remat's static_argnums are
             # positional indices. Dropout never fires in decode mode —
-            # generation is deterministic whatever the caller passes
-            x = block(x, decode, train and not decode)
+            # generation is deterministic whatever the caller passes.
+            # attn_start only rides the decode path (remat never applies
+            # there, so the array kwarg never meets jax.checkpoint).
+            if decode and attn_start is not None:
+                x = block(x, True, False, attn_start=attn_start)
+            else:
+                x = block(x, decode, train and not decode)
         x = nn.LayerNorm(
             dtype=self.dtype, param_dtype=self.param_dtype, name="ln_f"
         )(x)

@@ -102,7 +102,7 @@ class SelfAttention(nn.Module):
     rope: bool = False              # rotary Q/K (ops/rope.py) vs none here
 
     @nn.compact
-    def __call__(self, x, *, decode: bool = False):
+    def __call__(self, x, *, decode: bool = False, attn_start=None):
         b, s, d = x.shape
         assert d % self.num_heads == 0, (d, self.num_heads)
         head_dim = d // self.num_heads
@@ -176,6 +176,15 @@ class SelfAttention(nn.Module):
                 cache_index.value = cur + s
                 pos_q = cur + jnp.arange(s)
                 mask = jnp.arange(max_len)[None, :] <= pos_q[:, None]
+                if attn_start is not None:
+                    # left-padded prompts (inference.py variable-length
+                    # batching): key positions before each sequence's
+                    # first real token never receive attention
+                    mask = mask[None] & (
+                        jnp.arange(max_len)[None, None, :]
+                        >= attn_start[:, None, None]
+                    )
+                    mask = mask[:, None]  # (b, 1, sq, sk)
                 out = attention_with_mask(q, k, v, mask)
         else:
             out = dot_product_attention(
@@ -209,10 +218,12 @@ class EncoderBlock(nn.Module):
     dropout_rate: float = 0.0
 
     @nn.compact
-    def __call__(self, x, decode: bool = False, train: bool = False):
+    def __call__(self, x, decode: bool = False, train: bool = False, *,
+                 attn_start=None):
         # decode/train are positional-friendly: the LM's remat path wraps
         # this module in nn.remat(static_argnums=(2, 3)), and jax.checkpoint
-        # only accepts non-array arguments at static positions
+        # only accepts non-array arguments at static positions. attn_start
+        # (an array) is decode-only, where remat never applies.
         y = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype, name="ln1")(x)
         y = SelfAttention(
             self.num_heads,
@@ -224,7 +235,7 @@ class EncoderBlock(nn.Module):
             causal=self.causal,
             rope=self.rope,
             name="attn",
-        )(y, decode=decode)
+        )(y, decode=decode, attn_start=attn_start)
         y = nn.Dropout(self.dropout_rate, deterministic=not train)(y)
         x = x + y
         y = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype, name="ln2")(x)

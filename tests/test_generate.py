@@ -193,6 +193,41 @@ def test_generate_with_tensor_sharded_params(devices, lm):
     np.testing.assert_array_equal(got, want)
 
 
+def test_variable_length_prompts_match_per_prompt_runs(devices):
+    """Left-padded variable-length batching (pad_left_prompts +
+    prompt_lens): every sequence's greedy continuation must equal its own
+    single-prompt run — padding must be invisible (RoPE model; the
+    attention mask hides pad K/V, rotary positions are shift-invariant)."""
+    from ddp_practice_tpu.inference import pad_left_prompts
+
+    model = create_model(
+        "lm_tiny", vocab_size=VOCAB, max_len=64, hidden_dim=64, depth=2,
+        num_heads=4, mlp_dim=128, pos_emb="rope",
+    )
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    prompts = [[3, 1, 4], [2, 7, 1, 8, 2], [5]]
+    tokens, lens = pad_left_prompts(prompts)
+    n_new = 6
+    gen = jax.jit(make_generate_fn(model, max_new_tokens=n_new, temperature=0.0))
+    batched = np.asarray(gen(params, tokens, None, lens))
+    width = tokens.shape[1]
+    for i, p in enumerate(prompts):
+        single = np.asarray(gen(params, jnp.asarray([p], jnp.int32)))
+        np.testing.assert_array_equal(batched[i, width:], single[0, len(p):])
+
+
+def test_variable_length_needs_rope(devices, lm):
+    """attn_start with learned absolute positions must raise (padding
+    would shift every real token's position)."""
+    model, params = lm  # learned positions
+    prompt = jnp.asarray([[0, 0, 3, 1]], jnp.int32)
+    gen = make_generate_fn(model, max_new_tokens=2, temperature=0.0)
+    with pytest.raises(ValueError, match="rope"):
+        gen(params, prompt, None, jnp.asarray([2], jnp.int32))
+
+
 def test_generate_rejects_empty_prompt(devices, lm):
     model, params = lm
     gen = make_generate_fn(model, max_new_tokens=4, temperature=0.0)
