@@ -137,6 +137,24 @@ class LMDataLoader:
     def __len__(self) -> int:
         return self.steps_per_epoch
 
+    def epoch_plan(self):
+        """(starts, None): a (steps, global_batch) int32 grid of token
+        START offsets for the device-resident driver — the same
+        (seed, epoch)-keyed window order __iter__ streams, as offsets the
+        on-device gather consumes (tokens[start : start + seq_len + 1]).
+        The second element keeps the image DataLoader.epoch_plan interface
+        (its eval weights); LM drops trailing windows instead of padding,
+        so there is nothing to weight."""
+        order = epoch_indices(
+            self.num_windows, seed=self.seed, epoch=self._epoch,
+            shuffle=self.shuffle,
+        )
+        usable = self.steps_per_epoch * self.global_batch_size
+        starts = order[:usable].reshape(
+            self.steps_per_epoch, self.global_batch_size
+        ) * self.window
+        return starts.astype(np.int32), None
+
     def __iter__(self) -> Iterator[dict]:
         order = epoch_indices(
             self.num_windows, seed=self.seed, epoch=self._epoch,

@@ -317,27 +317,72 @@ class Trainer:
             self._grid_sharding = NamedSharding(
                 self.mesh, P(None, MeshConfig.AXIS_DATA)
             )
-            self._train_data = {
-                "image": jax.device_put(np.asarray(self.train_ds.images), rep),
-                "label": jax.device_put(np.asarray(self.train_ds.labels), rep),
-            }
-            self._eval_data = {
-                "image": jax.device_put(np.asarray(self.eval_ds.images), rep),
-                "label": jax.device_put(np.asarray(self.eval_ds.labels), rep),
-            }
-            self.resident_train_step = make_resident_train_step(
-                self.model,
-                self.tx,
-                label_smoothing=config.label_smoothing,
-                seed=config.seed,
-                mesh=self.mesh,
-                state_shardings=self.state_shardings,
-            )
-            self.resident_eval_step = make_resident_eval_step(
-                self.model,
-                mesh=self.mesh,
-                state_shardings=self.state_shardings,
-            )
+            if self.task == "lm":
+                from ddp_practice_tpu.train.steps import (
+                    make_resident_lm_eval_step,
+                    make_resident_lm_train_step,
+                )
+
+                self._train_data = {
+                    "tokens": jax.device_put(
+                        np.asarray(
+                            self.train_loader.corpus.tokens, np.int32
+                        ),
+                        rep,
+                    ),
+                }
+                self._eval_data = {
+                    "tokens": jax.device_put(
+                        np.asarray(self.eval_loader.corpus.tokens, np.int32),
+                        rep,
+                    ),
+                }
+                window = config.seq_len + 1
+                self.resident_train_step = make_resident_lm_train_step(
+                    self.model,
+                    self.tx,
+                    window=window,
+                    label_smoothing=config.label_smoothing,
+                    seed=config.seed,
+                    mesh=self.mesh,
+                    state_shardings=self.state_shardings,
+                )
+                self.resident_eval_step = make_resident_lm_eval_step(
+                    self.model,
+                    window=window,
+                    mesh=self.mesh,
+                    state_shardings=self.state_shardings,
+                )
+            else:
+                self._train_data = {
+                    "image": jax.device_put(
+                        np.asarray(self.train_ds.images), rep
+                    ),
+                    "label": jax.device_put(
+                        np.asarray(self.train_ds.labels), rep
+                    ),
+                }
+                self._eval_data = {
+                    "image": jax.device_put(
+                        np.asarray(self.eval_ds.images), rep
+                    ),
+                    "label": jax.device_put(
+                        np.asarray(self.eval_ds.labels), rep
+                    ),
+                }
+                self.resident_train_step = make_resident_train_step(
+                    self.model,
+                    self.tx,
+                    label_smoothing=config.label_smoothing,
+                    seed=config.seed,
+                    mesh=self.mesh,
+                    state_shardings=self.state_shardings,
+                )
+                self.resident_eval_step = make_resident_eval_step(
+                    self.model,
+                    mesh=self.mesh,
+                    state_shardings=self.state_shardings,
+                )
         elif config.steps_per_call == -1:
             raise ValueError(
                 "steps_per_call=-1 (whole epoch per dispatch) needs "
@@ -496,15 +541,20 @@ class Trainer:
         cfg = self.config
         if cfg.data_placement == "host":
             return False
+        multi = dist.process_count() > 1
         if self.task == "lm":
             if cfg.data_placement == "device":
-                raise ValueError(
-                    "data_placement='device' is not composed with the LM "
-                    "task yet: token batches stream from the host "
-                    "(data_placement='host'/'auto')"
-                )
-            return False
-        multi = dist.process_count() > 1
+                if multi:
+                    raise ValueError(
+                        "data_placement='device' requires a single process"
+                    )
+                return True
+            # auto: token streams are tiny (bytes per token; uploaded as
+            # int32) — resident whenever they fit the same budget
+            nbytes = 4 * (
+                len(self.train_loader.corpus) + len(self.eval_loader.corpus)
+            )
+            return not multi and nbytes <= cfg.resident_max_bytes
         if cfg.data_placement == "device":
             if multi:
                 raise ValueError(
@@ -857,8 +907,8 @@ class Trainer:
         perplexity (exp of mean token NLL, stored on self.eval_perplexity
         and in the fit summary) — all processes participate, like the
         image eval."""
-        import math
-
+        if self.resident_eval_step is not None:
+            return self._evaluate_lm_resident()
         it = prefetch_to_device(
             iter(self.eval_loader), self.batch_shardings,
             size=self.config.prefetch,
@@ -883,6 +933,43 @@ class Trainer:
                     self._probe_if_due(prev, n_eval)
         finally:
             it.close()
+        return self._finish_lm_eval(correct, total, nll)
+
+    def _evaluate_lm_resident(self) -> float:
+        """LM eval against the HBM-resident token stream: grouped grids of
+        window starts, (correct, total, nll) summed in-graph."""
+        starts, _ = self.eval_loader.epoch_plan()
+        total_rows = len(starts)
+        g = self._resident_group(total_rows)
+        correct = jnp.zeros((), jnp.float32)
+        total = jnp.zeros((), jnp.float32)
+        nll = jnp.zeros((), jnp.float32)
+        self._pending.clear()
+        with profile_region("eval"):
+            n_eval = 0
+            for g0 in range(0, total_rows, g):
+                rows = jax.device_put(
+                    starts[g0 : g0 + g], self._grid_sharding
+                )
+                c, t, s = self.resident_eval_step(
+                    self.state, self._eval_data, rows
+                )
+                if self._serialize_steps:
+                    jax.block_until_ready(c)
+                correct = correct + c
+                total = total + t
+                nll = nll + s
+                prev = n_eval
+                n_eval += min(g, total_rows - g0)  # steps, not dispatches
+                self._track(c)
+                self._probe_if_due(prev, n_eval)
+        return self._finish_lm_eval(correct, total, nll)
+
+    def _finish_lm_eval(self, correct, total, nll) -> float:
+        """Shared LM-eval epilogue (host + resident paths): drain the probe
+        ladder, derive accuracy/perplexity, confirm progress."""
+        import math
+
         self._drain_pending()
         t_f = max(float(total), 1.0)
         acc = float(correct) / t_f

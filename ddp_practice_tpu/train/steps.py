@@ -504,3 +504,106 @@ def make_chunked_eval_step(
             out_shardings=(rep, rep),
         )
     return jax.jit(chunk_eval)
+
+
+def _lm_window_gather(tokens, starts, window: int, batch_sharding=None):
+    """Materialize one (B, window) token batch from the HBM-resident
+    stream: a strided gather at `starts` (B,) offsets. Same layout-pinning
+    rationale as _resident_gather (sharding constraint + barrier keep the
+    resident path bitwise on the host path's program shape)."""
+    batch = tokens[starts[:, None] + jnp.arange(window)[None, :]]
+    if batch_sharding is not None:
+        batch = jax.lax.with_sharding_constraint(batch, batch_sharding)
+    return jax.lax.optimization_barrier(batch)
+
+
+def make_resident_lm_train_step(
+    model,
+    tx,
+    *,
+    window: int,
+    label_smoothing: float = 0.0,
+    seed: int = 0,
+    mesh=None,
+    state_shardings=None,
+):
+    """LM counterpart of make_resident_train_step: the token STREAM (a 1D
+    int32 array — megabytes where the image corpora are tens of MB) lives
+    in HBM, and each scanned step gathers its (B, seq_len + 1) windows
+    on device from a (G, B) grid of start offsets (LMDataLoader
+    .epoch_plan). Per-epoch host→device traffic: the grid alone."""
+    step_fn = _lm_train_step_fn(model, tx, label_smoothing, seed)
+    bsh = None
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        bsh = NamedSharding(mesh, P("data"))
+
+    def resident_chunk(state, data, starts):
+        def body(st, row):
+            batch = {
+                "tokens": _lm_window_gather(data["tokens"], row, window, bsh)
+            }
+            return step_fn(st, batch)
+
+        state, ms = jax.lax.scan(body, state, starts)
+        return state, jax.tree.map(lambda v: v[-1], ms)
+
+    if mesh is not None and state_shardings is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ddp_practice_tpu.parallel.mesh import replicated
+
+        rep = replicated(mesh)
+        grid_sh = NamedSharding(mesh, P(None, "data"))
+        return jax.jit(
+            resident_chunk,
+            in_shardings=(state_shardings, rep, grid_sh),
+            out_shardings=(state_shardings, rep),
+            donate_argnums=0,
+        )
+    return jax.jit(resident_chunk, donate_argnums=0)
+
+
+def make_resident_lm_eval_step(
+    model, *, window: int, mesh=None, state_shardings=None
+):
+    """Eval G batches per call against the resident token stream: summed
+    (correct, total, nll) over the scanned grid — the resident analogue of
+    make_lm_eval_step's per-batch triple."""
+    bsh = None
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        bsh = NamedSharding(mesh, P("data"))
+
+    def resident_eval(state, data, starts):
+        def body(carry, row):
+            tokens = _lm_window_gather(data["tokens"], row, window, bsh)
+            inputs, targets = tokens[:, :-1], tokens[:, 1:]
+            logits = model.apply(
+                {"params": state.params}, inputs, train=False
+            )
+            c, t = accuracy_counts(logits, targets)
+            s = cross_entropy(logits, targets) * t
+            return (carry[0] + c, carry[1] + t, carry[2] + s), None
+
+        zero = jnp.zeros((), jnp.float32)
+        (correct, total, nll), _ = jax.lax.scan(
+            body, (zero, zero, zero), starts
+        )
+        return correct, total, nll
+
+    if mesh is not None and state_shardings is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ddp_practice_tpu.parallel.mesh import replicated
+
+        rep = replicated(mesh)
+        grid_sh = NamedSharding(mesh, P(None, "data"))
+        return jax.jit(
+            resident_eval,
+            in_shardings=(state_shardings, rep, grid_sh),
+            out_shardings=(rep, rep, rep),
+        )
+    return jax.jit(resident_eval)

@@ -7,6 +7,7 @@ contract on the synthetic Markov corpus — perplexity must fall from
 uniform (= vocab) to near the chain's entropy floor.
 """
 
+import jax
 import numpy as np
 import pytest
 
@@ -162,15 +163,34 @@ def test_lm_label_smoothing_threads_through(devices):
     assert loss_with(0.0) != loss_with(0.5)
 
 
-def test_lm_rejects_device_placement(devices):
+def test_lm_resident_matches_host_path(devices):
+    """The HBM-resident LM driver (token stream + on-device window gather,
+    LMDataLoader.epoch_plan) is an optimization, not a math change: same
+    (seed, epoch) windows, same final params (to float noise) and the same
+    eval numbers as the host-streamed path."""
     from ddp_practice_tpu.train.loop import Trainer
 
-    cfg = TrainConfig(
-        model="lm_tiny", dataset="synthetic_text", batch_size=4, seq_len=64,
-        data_placement="device", mesh=MeshConfig(data=-1),
+    base = TrainConfig(
+        model="lm_tiny", dataset="synthetic_text", batch_size=4, seq_len=32,
+        epochs=1, max_steps_per_epoch=6, optimizer="adamw",
+        learning_rate=1e-3, log_every_steps=0, mesh=MeshConfig(data=-1),
     )
-    with pytest.raises(ValueError, match="not composed with the LM task"):
-        Trainer(cfg)
+    host = Trainer(base.replace(data_placement="host"))
+    s_host = host.fit()
+    dev = Trainer(base.replace(data_placement="device"))
+    assert dev.resident_train_step is not None  # really the resident driver
+    s_dev = dev.fit()
+    for a, b in zip(
+        jax.tree.leaves(jax.device_get(host.state.params)),
+        jax.tree.leaves(jax.device_get(dev.state.params)),
+    ):
+        np.testing.assert_allclose(a, b, atol=2e-5, rtol=2e-5)
+    np.testing.assert_allclose(
+        s_dev["accuracy"], s_host["accuracy"], atol=1e-6
+    )
+    np.testing.assert_allclose(
+        s_dev["perplexity"], s_host["perplexity"], rtol=1e-4
+    )
 
 
 def test_lm_trainer_text_dataset(devices, tmp_path):
