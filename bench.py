@@ -1,11 +1,14 @@
 """Benchmark: steady-state training throughput and MFU, one JSON line.
 
 Headline: ViT-Base (the MXU-bound flagship transformer) training
-images/sec/chip with computed MFU against the chip's bf16 peak. Companion
-entries (in "extras"): ViT-Tiny (HBM-bound at d=192 — see BENCHMARKS.md),
-the ConvNet/MNIST parity model (the BASELINE.json north-star metric, with
-`vs_baseline` = ratio to the reference's ~7,923 images/sec implied by
-README.md:201), ResNet-18, and ResNet-50 at ImageNet shape.
+images/sec/chip with computed MFU against the chip's bf16 peak. The final
+stdout line is a COMPACT driver-parseable record (metric/value/unit/
+vs_baseline + headline MFU only); the full per-model suite is written to
+BENCHMARKS.json next to this file. Companion entries there: ViT-Tiny
+(HBM-bound at d=192 — see BENCHMARKS.md), the ConvNet/MNIST parity model
+(the BASELINE.json north-star metric, with `vs_baseline` = ratio to the
+reference's ~7,923 images/sec implied by README.md:201), ResNet-18,
+ResNet-50 at ImageNet shape, and the LM train/decode entries.
 
 Methodology — device-resident uint8 data pool, on-device gather+normalize,
 K steps per dispatch, timing fenced by a scalar host readback — is
@@ -17,6 +20,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import traceback
 
@@ -121,9 +125,10 @@ def main(argv=None) -> int:
             errors.append({"model": name, "error": traceback.format_exc(limit=3)})
 
     if not results:
+        _write_suite({"headline": None, "results": [], "errors": errors})
         print(json.dumps({
             "metric": "bench failed", "value": 0.0, "unit": "images/sec/chip",
-            "vs_baseline": 0.0, "errors": errors,
+            "vs_baseline": 0.0, "n_errors": len(errors),
         }))
         return 1
 
@@ -141,7 +146,7 @@ def main(argv=None) -> int:
             convnet["images_per_sec_per_chip"] / REFERENCE_IMAGES_PER_SEC, 3
         )
         vs_note = (
-            "ratio of the ConvNet/MNIST companion entry (extras) to the "
+            "ratio of the ConvNet/MNIST companion entry (results) to the "
             "reference's ~7,923 img/s (README.md:201); the reference "
             "publishes no transformer numbers"
         )
@@ -163,8 +168,6 @@ def main(argv=None) -> int:
         "value": head_rate,
         "unit": head_unit,
         "vs_baseline": vs_baseline,
-        "vs_baseline_note": vs_note,
-        "extras": results[1:],
     }
     if "mfu_pct" in head:
         line["mfu_pct"] = head["mfu_pct"]
@@ -172,9 +175,34 @@ def main(argv=None) -> int:
     if "mbu_pct" in head:
         line["mbu_pct"] = head["mbu_pct"]
     if errors:
-        line["errors"] = errors
+        line["n_errors"] = len(errors)
+
+    # Full suite (every model record, the vs_baseline provenance note, and
+    # any tracebacks) goes to a file; the driver's tail capture only needs
+    # the compact line above. BENCH_r02 taught us the hard way: a several-KB
+    # stdout line gets truncated mid-record and parses as null.
+    _write_suite({
+        "headline": head,
+        "results": results,
+        "vs_baseline": vs_baseline,
+        "vs_baseline_note": vs_note,
+        "errors": errors,
+    })
     print(json.dumps(line))
     return 0
+
+
+def _write_suite(suite: dict) -> None:
+    """Dump the full suite next to this file; never kill the stdout line."""
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCHMARKS.json"
+    )
+    try:
+        with open(path, "w") as f:
+            json.dump(suite, f, indent=1)
+        print(f"full suite -> {path}", file=sys.stderr)
+    except OSError as e:  # read-only checkout / full disk: line still prints
+        print(f"could not write {path}: {e}", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -117,7 +117,14 @@ def main(argv=None) -> int:
     t0 = time.perf_counter()
     tokens = jax.device_get(gen(params, prompt, key))
     dt = time.perf_counter() - t0
-    text = decode_bytes(tokens[0, prompt.shape[1]:])
+    generated = tokens[0, prompt.shape[1]:]
+    if args.eos_id is not None:
+        # early EOS leaves pad_id (0) in the post-EOS slots (inference.py
+        # done-mask); cut at the first EOS so the text carries no NULs
+        hits = (generated == args.eos_id).nonzero()[0]
+        if hits.size:
+            generated = generated[: int(hits[0])]
+    text = decode_bytes(generated)
     print(text)
     print(
         f"[generate] ckpt step {step}, {args.max_new_tokens} tokens in "

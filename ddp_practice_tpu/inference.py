@@ -62,14 +62,17 @@ def sample_logits(
     """Sample token ids (b,) from fp32 logits (b, vocab).
 
     temperature=0 is greedy argmax (no key needed). top_k keeps the k
-    highest logits; top_p keeps the smallest prefix of the sorted
-    distribution whose cumulative probability reaches p (the most likely
-    token always survives). Both filters compose: k first, then p.
+    highest logits (clamped to the vocab size — asking for more than the
+    vocab has is a no-op filter, not a lax.top_k shape error); top_p keeps
+    the smallest prefix of the sorted distribution whose cumulative
+    probability reaches p (the most likely token always survives). Both
+    filters compose: k first, then p.
     """
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1)
     logits = logits.astype(jnp.float32) / temperature
     neg = jnp.asarray(-1e30, logits.dtype)
+    top_k = min(top_k, logits.shape[-1])
     if top_k > 0:
         kth = lax.top_k(logits, top_k)[0][..., -1:]
         logits = jnp.where(logits < kth, neg, logits)
