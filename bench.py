@@ -67,10 +67,15 @@ _SUITE = {
     ),
     # autoregressive generation (KV-cache decode, inference.py): tokens/sec
     # + model-bandwidth utilization — decode re-reads all params per token,
-    # so the roofline is HBM, not the MXU (67.8% of the params-streaming
-    # bound single-stream at bs=1; the default bs=8 trades MBU for rate)
+    # so the roofline is HBM, not the MXU. bs=1 is the single-stream MBU
+    # flagship (params-streaming bound); bs=8 trades MBU for batch rate.
+    # Params stream as bf16 (inference needs no fp32 masters).
     "lm_decode": dict(
         kind="decode", prompt_len=128, max_new_tokens=512, batch_size=8,
+        calls=3,
+    ),
+    "lm_decode_bs1": dict(
+        kind="decode", prompt_len=128, max_new_tokens=512, batch_size=1,
         calls=3,
     ),
 }
@@ -80,7 +85,7 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser("bench")
     p.add_argument("--models",
                    default="vit_base,vit_tiny,convnet,resnet18,resnet50,"
-                           "lm_long,lm_decode",
+                           "lm_long,lm_decode,lm_decode_bs1",
                    help="comma-separated; first successful is the headline")
     p.add_argument("--precision", default="bf16", choices=["fp32", "bf16"])
     p.add_argument("--batch_size", type=int, default=0, help="override")
@@ -105,7 +110,13 @@ def main(argv=None) -> int:
         kind = kw.pop("kind", "image")
         kw["precision"] = args.precision
         if args.batch_size:
-            kw["batch_size"] = args.batch_size
+            if name.endswith("_bs1"):
+                # the entry's identity pins its batch size; an override
+                # would record a wrong number under the bs1 name
+                print(f"[bench] --batch_size ignored for {name}",
+                      file=sys.stderr)
+            else:
+                kw["batch_size"] = args.batch_size
         if args.steps_per_call:
             kw["steps_per_call"] = args.steps_per_call
         if args.calls:
@@ -125,7 +136,11 @@ def main(argv=None) -> int:
             errors.append({"model": name, "error": traceback.format_exc(limit=3)})
 
     if not results:
-        _write_suite({"headline": None, "results": [], "errors": errors})
+        # deliberately do NOT touch BENCHMARKS.json here: a transient
+        # all-models failure must not clobber the last good recorded suite
+        for e in errors:
+            print(f"[bench] {e['model']} failed:\n{e['error']}",
+                  file=sys.stderr)
         print(json.dumps({
             "metric": "bench failed", "value": 0.0, "unit": "images/sec/chip",
             "vs_baseline": 0.0, "n_errors": len(errors),

@@ -353,6 +353,12 @@ def bench_lm_decode(
     top_k: int = 0,
     model_kwargs: Optional[dict] = None,
     seed: int = 0,
+    # dtype the params are STREAMED in during decode. None follows the
+    # precision policy: bf16 compute -> bf16 streaming (inference needs no
+    # fp32 masters, and the cast is bit-identical to what every matmul
+    # already does per-step — inference.cast_params_for_streaming), fp32
+    # policy -> fp32 streaming. Pass explicitly to measure the other path.
+    stream_dtype: Optional[str] = None,
     # accepted for bench.py CLI-override uniformity; decode has no chunking
     steps_per_call: int = 0,
 ) -> dict:
@@ -362,11 +368,15 @@ def bench_lm_decode(
     re-reads the full parameter set (plus the growing KV cache), so the
     roofline metric is model-bandwidth utilization (MBU) = bytes actually
     streamed per second / chip HBM bandwidth — reported alongside
-    tokens/sec. Params are fp32 in HBM under both precision policies
-    (bf16 keeps fp32 master params), so the per-step traffic floor is
-    4 bytes/param + the bf16 KV cache read. The whole generation (prefill
-    + lax.scan of single-token steps, inference.py) is ONE jitted call;
-    timing fences on a host readback of the final tokens.
+    tokens/sec. Training keeps fp32 master params, but inference does not
+    need them: under the bf16 policy the resident params are cast once,
+    so the per-step traffic floor is 2 bytes/param + the bf16 KV cache
+    read (`--precision fp32` / `stream_dtype="fp32"` measures the
+    master-param path at 4 bytes/param — the two knobs move together
+    unless stream_dtype is passed explicitly, so the reported precision
+    always matches what streams). The whole generation (prefill + lax.scan of
+    single-token steps, inference.py) is ONE jitted call; timing fences
+    on a host readback of the final tokens.
 
     tokens_per_sec is the end-to-end generation rate (prefill included —
     that is what a caller of gen() experiences). The per-decode-step
@@ -406,6 +416,15 @@ def bench_lm_decode(
     params = model.init(
         jax.random.PRNGKey(seed), jnp.zeros((1, 8), jnp.int32)
     )["params"]
+    if stream_dtype is None:
+        stream_dtype = "bf16" if precision == "bf16" else "fp32"
+    if stream_dtype not in ("bf16", "fp32"):
+        raise ValueError(f"stream_dtype {stream_dtype!r} (want bf16|fp32)")
+    param_bytes = 2 if stream_dtype == "bf16" else 4
+    if stream_dtype == "bf16":
+        from ddp_practice_tpu.inference import cast_params_for_streaming
+
+        params = cast_params_for_streaming(params)
     n_params = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(params))
     gen = jax.jit(
         make_generate_fn(
@@ -468,6 +487,7 @@ def bench_lm_decode(
         "batch_size": batch_size,
         "vocab_size": vocab_size,
         "precision": precision,
+        "stream_dtype": stream_dtype,
         "device_kind": device_kind,
         "n_chips": n_chips,
         "n_params": n_params,
@@ -479,9 +499,168 @@ def bench_lm_decode(
     }
     bw = chip_hbm_bandwidth(device_kind)
     if bw:
-        # params-only traffic floor (fp32 master weights); the KV-cache
+        # params-only traffic floor at the streamed dtype; the KV-cache
         # read adds ~2*depth*ctx*d bf16 bytes per sequence per step on top
-        bytes_per_sec = n_params * 4 * steps_per_sec
+        bytes_per_sec = n_params * param_bytes * steps_per_sec
         out["mbu_pct"] = round(100.0 * bytes_per_sec / (bw * n_chips), 2)
         out["hbm_gbps"] = bw / 1e9
     return out
+
+
+def bench_pipeline(
+    *,
+    num_stages: int = 4,
+    microbatch_counts=(2, 4, 8),
+    hidden_dim: int = 256,
+    depth: int = 4,
+    num_heads: int = 8,
+    mlp_dim: int = 1024,
+    vocab_size: int = 256,
+    seq_len: int = 256,
+    mb_rows: int = 4,
+    fixed_global_batch: int = 0,
+    steps: int = 5,
+    warmup: int = 2,
+    precision: str = "bf16",
+) -> list:
+    """Pipeline schedule comparison: GPipe vs 1F1B over the microbatch
+    count M, on whatever mesh the current devices allow (pipe=num_stages,
+    data=rest).
+
+    Two quantities per (schedule, M):
+
+    - ms/step. Default mode holds the per-microbatch size FIXED (global
+      batch grows with M), so pipeline efficiency = ideal/actual falls
+      out of the schedule-length model t(M) ~ slope * (M + overhead):
+      efficiency = slope * M / t(M), slope estimated from the two largest
+      M. With `fixed_global_batch` set, the global batch stays constant
+      (microbatches shrink as M grows) — the memory-story mode;
+    - compiled temp memory (XLA memory_analysis) — at fixed global batch
+      every input/output buffer is M-independent, so this isolates the
+      schedules' activation state: GPipe's scan-transpose stash grows
+      with M, 1F1B's ring stash must not.
+
+    Run on the 8-virtual-device CPU mesh for the schedule comparison
+    (pipe > 1 needs multiple devices; the CI TPU is a single chip) — the
+    RELATIVE schedule behavior is device-independent; absolute ms/step on
+    CPU is not a TPU number and BENCHMARKS.md never quotes it as one.
+    """
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from ddp_practice_tpu.config import MeshConfig, PrecisionPolicy, TrainConfig
+    from ddp_practice_tpu.models import create_model
+    from ddp_practice_tpu.parallel.mesh import (
+        batch_sharding,
+        build_mesh,
+        shard_state,
+    )
+    from ddp_practice_tpu.parallel.ring import set_current_mesh
+    from ddp_practice_tpu.parallel.sharding_rules import param_sharding_rules
+    from ddp_practice_tpu.train.state import create_state, make_optimizer
+    from ddp_practice_tpu.train.steps import make_lm_train_step
+
+    n_dev = jax.device_count()
+    if n_dev % num_stages != 0:
+        raise ValueError(f"{n_dev} devices not divisible by pipe={num_stages}")
+    dp = n_dev // num_stages
+    policy = PrecisionPolicy.from_name(precision)
+    results = []
+    for schedule in ("gpipe", "1f1b"):
+        for mb_count in microbatch_counts:
+            mesh = build_mesh(MeshConfig(data=dp, pipe=num_stages))
+            set_current_mesh(mesh)
+            try:
+                model = create_model(
+                    "lm_pipe", policy=policy, vocab_size=vocab_size,
+                    max_len=seq_len, hidden_dim=hidden_dim, depth=depth,
+                    num_heads=num_heads, mlp_dim=mlp_dim,
+                    num_stages=num_stages, num_microbatches=mb_count,
+                    schedule=schedule,
+                )
+                tx = make_optimizer(
+                    TrainConfig(optimizer="adamw", learning_rate=1e-3)
+                )
+                if fixed_global_batch:
+                    if fixed_global_batch % (mb_count * dp):
+                        raise ValueError(
+                            f"fixed_global_batch {fixed_global_batch} not "
+                            f"divisible by M*dp = {mb_count * dp}"
+                        )
+                    b = fixed_global_batch
+                else:
+                    b = mb_count * mb_rows * dp
+                sample = jnp.zeros((b, seq_len), jnp.int32)
+
+                def init_fn(r):
+                    return create_state(
+                        model, tx, rng=r, sample_input=sample
+                    )
+
+                abstract = jax.eval_shape(init_fn, jax.random.PRNGKey(0))
+                shardings = shard_state(
+                    abstract, mesh, param_sharding_rules("lm_pipe")
+                )
+                state = jax.jit(init_fn, out_shardings=shardings)(
+                    jax.random.PRNGKey(0)
+                )
+                step = make_lm_train_step(
+                    model, tx, mesh=mesh, state_shardings=shardings,
+                    batch_shardings=batch_sharding(mesh),
+                )
+                rng = np.random.default_rng(0)
+                batch = {
+                    "tokens": jnp.asarray(
+                        rng.integers(0, vocab_size, (b, seq_len + 1)),
+                        jnp.int32,
+                    )
+                }
+                temp_bytes = None
+                try:
+                    compiled = step.lower(state, batch).compile()
+                    mem = compiled.memory_analysis()
+                    if mem is not None:
+                        temp_bytes = int(mem.temp_size_in_bytes)
+                except Exception:  # noqa: BLE001 — backend-dependent API
+                    pass
+                for _ in range(max(warmup, 1)):  # >=1: compile + metrics
+                    state, metrics = step(state, batch)
+                _ = float(metrics["loss"])
+                steps = max(steps, 1)
+                t0 = time.perf_counter()
+                for _ in range(steps):
+                    state, metrics = step(state, batch)
+                    _ = float(metrics["loss"])  # fence (serializes on CPU)
+                dt = time.perf_counter() - t0
+                results.append({
+                    "schedule": schedule,
+                    "num_stages": num_stages,
+                    "microbatches": mb_count,
+                    "global_batch": b,
+                    "seq_len": seq_len,
+                    "ms_per_step": round(dt / steps * 1e3, 1),
+                    "temp_bytes": temp_bytes,
+                    "loss": round(float(metrics["loss"]), 4),
+                })
+            finally:
+                set_current_mesh(None)
+    if fixed_global_batch:
+        return results  # constant work per step: the slope model is moot
+    # schedule-length model: slope from the two largest M of each schedule
+    for schedule in ("gpipe", "1f1b"):
+        rs = [r for r in results if r["schedule"] == schedule]
+        rs.sort(key=lambda r: r["microbatches"])
+        if len(rs) >= 2:
+            a, bb = rs[-2], rs[-1]
+            slope = (bb["ms_per_step"] - a["ms_per_step"]) / (
+                bb["microbatches"] - a["microbatches"]
+            )
+            for r in rs:
+                if slope > 0:
+                    r["efficiency_pct"] = round(
+                        100.0 * slope * r["microbatches"] / r["ms_per_step"],
+                        1,
+                    )
+    return results

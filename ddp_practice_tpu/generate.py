@@ -23,6 +23,7 @@ import jax.numpy as jnp
 from ddp_practice_tpu import checkpoint as ckpt
 from ddp_practice_tpu.config import PrecisionPolicy, TrainConfig
 from ddp_practice_tpu.inference import (
+    cast_params_for_streaming,
     decode_bytes,
     encode_bytes,
     make_generate_fn,
@@ -96,7 +97,12 @@ def load_lm(args) -> tuple:
         jax.random.PRNGKey(0),
     )
     state = ckpt.restore(args.ckpt_dir, abstract)
-    return model, jax.device_put(state.params), int(extra.get("step", -1))
+    params = state.params
+    if extra.get("precision_policy") == "bf16":
+        # inference needs no fp32 masters: stream bf16 params (half the
+        # HBM traffic per decode step; bit-identical under this policy)
+        params = cast_params_for_streaming(params)
+    return model, jax.device_put(params), int(extra.get("step", -1))
 
 
 def main(argv=None) -> int:

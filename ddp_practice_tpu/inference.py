@@ -34,6 +34,24 @@ import numpy as np
 from jax import lax
 
 
+def cast_params_for_streaming(params: Any) -> Any:
+    """fp32 leaves -> bf16 for inference-time param streaming.
+
+    Training keeps fp32 master params, but decode re-reads the whole tree
+    every token step, so streaming them as bf16 halves the HBM traffic.
+    Under the bf16 compute policy the cast is BIT-IDENTICAL to applying
+    the fp32 tree (every layer casts its kernel to the compute dtype
+    before use — pinned in tests/test_generate.py); under an fp32 policy
+    it changes numerics (weights round to bf16) and is not applied by
+    default anywhere.
+    """
+    return jax.tree.map(
+        lambda l: l.astype(jnp.bfloat16)
+        if l.dtype == jnp.float32 else l,
+        params,
+    )
+
+
 def make_cache(model, batch: int, total_len: int) -> Any:
     """Zero-initialized KV cache for `batch` sequences of `total_len`.
 

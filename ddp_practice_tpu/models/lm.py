@@ -43,6 +43,9 @@ class TransformerLM(nn.Module):
     seq_axis: Optional[str] = None  # mesh axis for sequence parallelism
     sp_impl: str = "ring"
     attn_impl: str = "xla"
+    # KV-cache storage dtype for decode (None = compute dtype); see
+    # models/vit.py SelfAttention.kv_cache_dtype
+    kv_cache_dtype: Optional[jnp.dtype] = None
     # rematerialize each block's activations in the backward pass
     # (jax.checkpoint): trades ~1/3 more FLOPs for O(depth) less
     # activation memory — the standard long-context lever (with the
@@ -160,6 +163,7 @@ class TransformerLM(nn.Module):
                 attn_impl=self.attn_impl,
                 causal=True,
                 rope=self.pos_emb == "rope",
+                kv_cache_dtype=self.kv_cache_dtype,
                 dropout_rate=self.dropout_rate,
                 name=f"block{i}",
             )

@@ -100,6 +100,11 @@ class SelfAttention(nn.Module):
     attn_impl: str = "xla"          # "xla" | "flash" (Pallas kernel)
     causal: bool = False            # decoder (LM) blocks mask the future
     rope: bool = False              # rotary Q/K (ops/rope.py) vs none here
+    # decode-mode KV-cache storage dtype. None = the compute dtype (bf16
+    # under the bf16 policy — already the small option there); set
+    # jnp.bfloat16 to halve cache traffic under an fp32 policy. Writes
+    # round to this dtype; attention math runs at the q/k promotion.
+    kv_cache_dtype: Optional[jnp.dtype] = None
 
     @nn.compact
     def __call__(self, x, *, decode: bool = False, attn_start=None):
@@ -139,11 +144,12 @@ class SelfAttention(nn.Module):
                     "decode (KV-cache) mode does not compose with sequence "
                     "parallelism — generate on a data/tensor-sharded mesh"
                 )
+            cache_dtype = self.kv_cache_dtype or k.dtype
             cached_key = self.variable(
-                "cache", "cached_key", jnp.zeros, k.shape, k.dtype
+                "cache", "cached_key", jnp.zeros, k.shape, cache_dtype
             )
             cached_value = self.variable(
-                "cache", "cached_value", jnp.zeros, v.shape, v.dtype
+                "cache", "cached_value", jnp.zeros, v.shape, cache_dtype
             )
             cache_index = self.variable(
                 "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
@@ -211,6 +217,7 @@ class EncoderBlock(nn.Module):
     attn_impl: str = "xla"
     causal: bool = False
     rope: bool = False
+    kv_cache_dtype: Optional[jnp.dtype] = None
     # residual-branch dropout (after the attention projection and inside
     # the MLP). Deliberately NOT on the attention probabilities: that
     # variant cannot compose with the flash/ring kernels, which never
@@ -234,6 +241,7 @@ class EncoderBlock(nn.Module):
             attn_impl=self.attn_impl,
             causal=self.causal,
             rope=self.rope,
+            kv_cache_dtype=self.kv_cache_dtype,
             name="attn",
         )(y, decode=decode, attn_start=attn_start)
         y = nn.Dropout(self.dropout_rate, deterministic=not train)(y)

@@ -228,6 +228,60 @@ def test_variable_length_needs_rope(devices, lm):
         gen(params, prompt, None, jnp.asarray([2], jnp.int32))
 
 
+def test_bf16_kv_cache_tracks_fp32_cache(devices, lm):
+    """kv_cache_dtype=bf16 under an fp32 policy: the cache stores rounded
+    K/V but the decode logits stay within bf16 rounding of the fp32-cache
+    path (the cache is storage, not math — attention still promotes)."""
+    model, params = lm
+    model_bf16 = _tiny_lm(kv_cache_dtype=jnp.bfloat16)
+    rng = np.random.default_rng(3)
+    tokens = jnp.asarray(rng.integers(0, VOCAB, (2, 12)), jnp.int32)
+
+    def roll(m):
+        cache = make_cache(m, 2, 12)
+        assert (
+            cache["block0"]["attn"]["cached_key"].dtype
+            == (jnp.bfloat16 if m is model_bf16 else jnp.float32)
+        )
+        logits, mut = m.apply(
+            {"params": params, "cache": cache},
+            tokens[:, :5], decode=True, mutable=["cache"],
+        )
+        outs = [logits]
+        for t in range(5, 12):
+            logits, mut = m.apply(
+                {"params": params, "cache": mut["cache"]},
+                tokens[:, t:t + 1], decode=True, mutable=["cache"],
+            )
+            outs.append(logits)
+        return np.concatenate([np.asarray(o) for o in outs], axis=1)
+
+    np.testing.assert_allclose(
+        roll(model_bf16), roll(model), rtol=5e-2, atol=3e-2
+    )
+
+
+def test_bf16_param_stream_bit_identical(devices):
+    """Streaming bf16-cast params under the bf16 policy generates EXACTLY
+    the fp32-master tokens and logit-path bits: every layer casts its fp32
+    kernel to bf16 at compute time anyway, so the one-time cast commutes
+    (this is what lets generate.py/bench halve decode HBM traffic for
+    free)."""
+    from ddp_practice_tpu.config import PrecisionPolicy
+    from ddp_practice_tpu.inference import cast_params_for_streaming
+
+    model = _tiny_lm(policy=PrecisionPolicy.bf16())
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    cast = cast_params_for_streaming(params)
+    prompt = jnp.asarray([[3, 1, 4, 1, 5]], jnp.int32)
+    gen = jax.jit(make_generate_fn(model, max_new_tokens=12, temperature=0.0))
+    np.testing.assert_array_equal(
+        np.asarray(gen(params, prompt)), np.asarray(gen(cast, prompt))
+    )
+
+
 def test_generate_rejects_empty_prompt(devices, lm):
     model, params = lm
     gen = make_generate_fn(model, max_new_tokens=4, temperature=0.0)
