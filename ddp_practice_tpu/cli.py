@@ -89,6 +89,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="local attention kernel (flash = Pallas tiled)")
     p.add_argument("--microbatches", type=int, default=4,
                    help="GPipe microbatches per step (pipe > 1)")
+    p.add_argument("--pipe_schedule", default="gpipe",
+                   choices=["gpipe", "1f1b"],
+                   help="pipeline schedule (pipe > 1): gpipe = autodiff "
+                        "scan, activation memory O(M+P); 1f1b = interleaved "
+                        "backward, O(P) memory (LM models)")
     p.add_argument("--num_experts", type=int, default=0,
                    help="MoE expert count (0 = auto from --expert axis)")
     p.add_argument("--fsdp", action="store_true",
@@ -173,6 +178,7 @@ def config_from_args(args) -> TrainConfig:
         sp_impl=args.sp_impl,
         attn_impl=args.attn_impl,
         num_microbatches=args.microbatches,
+        pipe_schedule=args.pipe_schedule,
         num_experts=args.num_experts,
         num_heads=args.num_heads,
         coordinator_address=args.coordinator,

@@ -192,6 +192,10 @@ class TrainConfig:
     attn_impl: str = "xla"
     # GPipe microbatches per step when mesh.pipe > 1
     num_microbatches: int = 4
+    # pipeline schedule: "gpipe" (autodiff-of-scan; activation memory grows
+    # O(M + P)) | "1f1b" (LM only; explicit interleaved backward with an
+    # O(P) input stash — parallel/pipeline_1f1b.py)
+    pipe_schedule: str = "gpipe"
     # MoE expert count when mesh.expert > 1 (0 = auto: 8 rounded up to a
     # multiple of the expert axis)
     num_experts: int = 0

@@ -109,12 +109,15 @@ class PipelinedLM:
         seq_axis: Optional[str] = None,
         sp_impl: str = "ring",
         attn_impl: str = "xla",
+        schedule: str = "gpipe",
         axis_name: Optional[str] = None,
     ):
         if depth % max(num_stages, 1) != 0:
             raise ValueError(f"depth {depth} % stages {num_stages} != 0")
         if pos_emb not in ("learned", "rope"):
             raise ValueError(f"unknown pos_emb {pos_emb!r}")
+        if schedule not in ("gpipe", "1f1b"):
+            raise ValueError(f"unknown schedule {schedule!r} (gpipe|1f1b)")
         self.vocab_size = vocab_size
         self.max_len = max_len
         self.hidden_dim = hidden_dim
@@ -125,6 +128,8 @@ class PipelinedLM:
         self.num_microbatches = num_microbatches
         self.pipe_axis = pipe_axis
         self.remat = remat
+        self.schedule = schedule
+        self.dtype = dtype
         self.embed = _LMEmbed(
             vocab_size=vocab_size,
             max_len=max_len,
@@ -178,6 +183,102 @@ class PipelinedLM:
         if mutable is not None:
             return out, {}
         return out
+
+    def loss_and_grad(self, params, inputs, targets, *, weight=None,
+                      label_smoothing: float = 0.0):
+        """((loss, counts), grads) via the 1F1B schedule — the train-step
+        entry point when schedule='1f1b' (train/steps.py dispatches here
+        instead of jax.value_and_grad; apply() stays on the GPipe forward
+        for eval, where there is no backward to schedule). `counts` is
+        {"correct", "total"} — accuracy pieces accumulated as SCALARS in
+        the last stage's ticks; full logits are deliberately never
+        materialized (an (M, mb, s, V) metrics buffer would dwarf the
+        schedule's O(P) activation stash at real vocab sizes).
+
+        Embedding runs OUTSIDE the pipeline region under plain GSPMD (its
+        vjp closes the loop with the dx cotangents the schedule emits at
+        stage 0); head + loss fold into the LAST stage's backward ticks
+        inside parallel/pipeline_1f1b.py.
+        """
+        from ddp_practice_tpu.ops.losses import (
+            accuracy_counts,
+            cross_entropy_sum,
+        )
+        from ddp_practice_tpu.parallel.pipeline_1f1b import (
+            pipeline_1f1b_loss_and_grad,
+        )
+
+        M = self.num_microbatches
+        b, s = inputs.shape
+        if b % M != 0:
+            raise ValueError(f"batch {b} not divisible by microbatches {M}")
+        if weight is None:
+            weight = jnp.ones((b, s), jnp.float32)
+
+        def embed_fn(ep):
+            return self.embed.apply(
+                {"params": ep}, inputs
+            ).astype(jnp.float32)
+
+        x, embed_vjp = jax.vjp(embed_fn, params["embed"])
+        xs = x.reshape((M, b // M) + x.shape[1:])
+
+        def block_fn(stage_params, xb):
+            def body(h, bp):
+                return self.block.apply({"params": bp}, h), None
+
+            h, _ = lax.scan(body, xb, stage_params)
+            return h
+
+        def head_loss_fn(hp, y, tgt, wgt):
+            logits = self.head.apply({"params": hp}, y)
+            loss_sum, wsum = cross_entropy_sum(
+                logits, tgt, weight=wgt, label_smoothing=label_smoothing
+            )
+            correct, total = accuracy_counts(logits, tgt, weight=wgt)
+            return loss_sum, {
+                "weight": wsum, "correct": correct, "total": total,
+            }
+
+        stages = stack_stages(params["blocks"], self.num_stages)
+        loss_sum, aux, stage_grads, head_grads, dxs = (
+            pipeline_1f1b_loss_and_grad(
+                block_fn,
+                head_loss_fn,
+                stages,
+                params["head"],
+                xs,
+                targets.reshape((M, b // M, s)),
+                weight.reshape((M, b // M, s)),
+                num_microbatches=M,
+                compute_dtype=self.dtype,
+                axis_name=self.pipe_axis,
+            )
+        )
+        denom = jnp.maximum(aux["weight"], 1.0)
+        loss = loss_sum / denom
+        # the schedule differentiates the loss SUM; rescale to mean-loss
+        # gradients and close the embedding's own vjp with the rescaled dx
+        scale = 1.0 / denom
+        (embed_grads,) = embed_vjp(
+            (dxs * scale).reshape(x.shape).astype(x.dtype)
+        )
+        unstack = jax.tree.map(
+            lambda g: g.reshape((self.depth,) + g.shape[2:]), stage_grads
+        )
+        grads = {
+            "embed": embed_grads,
+            "blocks": jax.tree.map(
+                lambda g, p: (g * scale).astype(p.dtype),
+                unstack, params["blocks"],
+            ),
+            "head": jax.tree.map(
+                lambda g, p: (g * scale).astype(p.dtype),
+                head_grads, params["head"],
+            ),
+        }
+        counts = {"correct": aux["correct"], "total": aux["total"]}
+        return (loss, counts), grads
 
     def run_blocks(self, block_params, x):
         if self.num_stages <= 1:

@@ -26,8 +26,29 @@ def cross_entropy(
     uneven batches — the exactness fix for the reference's padded-eval
     double counting (SURVEY §2.5).
     """
+    loss_sum, weight_sum = cross_entropy_sum(
+        logits, labels, weight=weight, label_smoothing=label_smoothing
+    )
+    if weight is None:
+        return loss_sum / weight_sum
+    return loss_sum / jnp.maximum(weight_sum, 1.0)
+
+
+def cross_entropy_sum(
+    logits: jnp.ndarray,
+    labels: jnp.ndarray,
+    *,
+    weight: Optional[jnp.ndarray] = None,
+    label_smoothing: float = 0.0,
+) -> tuple:
+    """(loss_sum, weight_sum) — the un-normalized pieces of cross_entropy.
+
+    For accumulation schemes that see the batch in parts (the 1F1B
+    pipeline schedule reduces per-microbatch sums and divides once at the
+    end, parallel/pipeline_1f1b.py): sum(parts) / sum(weights) equals the
+    global weighted mean exactly.
+    """
     logits = logits.astype(jnp.float32)
-    num_classes = logits.shape[-1]
     logprobs = logits - jnp.max(logits, axis=-1, keepdims=True)
     logprobs = logprobs - jnp.log(
         jnp.sum(jnp.exp(logprobs), axis=-1, keepdims=True)
@@ -45,9 +66,8 @@ def cross_entropy(
             -logprobs, axis=-1
         )
     if weight is None:
-        return jnp.mean(nll)
-    denom = jnp.maximum(jnp.sum(weight), 1.0)
-    return jnp.sum(nll * weight) / denom
+        return jnp.sum(nll), jnp.asarray(nll.size, jnp.float32)
+    return jnp.sum(nll * weight), jnp.sum(weight)
 
 
 def accuracy_counts(

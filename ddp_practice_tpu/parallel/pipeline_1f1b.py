@@ -1,0 +1,266 @@
+"""1F1B pipeline schedule: memory-bounded training over the 'pipe' axis.
+
+The GPipe path (parallel/pipeline.py) runs all forwards as one scan and
+lets XLA differentiate it — simple, but the scan transpose stashes one
+boundary activation per tick, so training memory grows O(M + P) with the
+microbatch count M. This module is the memory-bounded alternative the
+scale story needs (reference has no pipeline at all — SURVEY §2.3): the
+backward is NOT autodiff-of-scan; each backward microbatch runs as an
+explicit `jax.vjp` inside the schedule, so the only cross-tick activation
+state is a ring stash of the last 2P-1 stage INPUTS — O(P), independent
+of M. Double the microbatches and GPipe's activation memory doubles;
+this schedule's stays put.
+
+Schedule ("eager 1F1B", one combined F+B tick):
+
+- F(i, m) at tick i + m — the GPipe forward flood, unchanged;
+- B(i, m) at tick 2(P-1) - i + m — each cotangent drains back the moment
+  it exists: the LAST stage runs B(m) in the same tick as its input
+  arrives (head + loss fold into its vjp, loss cotangent = 1), stage i
+  one tick after stage i+1;
+- total ticks T = M + 2(P-1) vs GPipe's fwd+bwd 2(M+P-1); in-flight
+  microbatches at stage i are bounded by 2(P-1-i)+1 <= 2P-1 = the stash.
+
+SPMD form mirrors _pipeline_local: ONE jitted program, partial-manual
+shard_map over {'pipe', 'data'} (tensor/seq axes stay GSPMD-automatic
+inside the stage body, so TP/SP compose exactly as in GPipe), activations
+and cotangents hop via paired forward/backward `lax.ppermute`s every
+tick. Work is masked, not branched: every device executes the F and the
+B compute each tick and gates the results by schedule validity — ticks a
+stage spends in the bubble cost compute anyway (same lockstep property
+as the GPipe scan; collectives would deadlock under divergent control
+flow, so masking is the safe SPMD idiom). The price is a longer schedule
+than GPipe wall-clock-wise at equal M; the purchase is O(P) activation
+memory. BENCHMARKS.md records both sides of that trade, measured.
+
+Boundary values (hops, stash, psums) stay fp32 — same JAX 0.9
+partial-manual sub-fp32 psum CHECK-failure workaround as pipeline.py;
+stage compute still runs in the model's own (bf16) dtype inside the vjp.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ddp_practice_tpu.config import MeshConfig
+from ddp_practice_tpu.parallel.ring import get_current_mesh
+
+
+def pipeline_1f1b_loss_and_grad(
+    block_fn: Callable,
+    head_loss_fn: Callable,
+    stage_params,
+    head_params,
+    xs: jnp.ndarray,
+    targets: jnp.ndarray,
+    weights: jnp.ndarray,
+    *,
+    num_microbatches: int,
+    compute_dtype=jnp.float32,
+    axis_name: str = MeshConfig.AXIS_PIPE,
+    mesh=None,
+):
+    """Run the 1F1B schedule; return loss/metric sums, grads and dx.
+
+    block_fn(stage_params_local, x_mb) -> y_mb: one stage's blocks
+    (leading leaf dim of `stage_params` = global stage count, as in
+    pipeline_apply). head_loss_fn(head_params, y_mb, targets_mb,
+    weights_mb) -> (loss_sum, aux) applies the head and a SUM-reduced
+    loss for one microbatch; `aux` is a pytree of fp32 SCALARS (e.g.
+    weight and correct-prediction counts) accumulated across microbatches
+    and summed over every axis. Deliberately scalars only: full logits
+    would put an (M, mb, s, V) buffer in the scan carry of EVERY stage
+    and a V-wide psum at the end — at real vocab sizes that single
+    metrics buffer dwarfs the O(P) activation stash this schedule exists
+    to provide.
+
+    xs: (M, mb, ...) fp32 embedded activations, microbatch dim first,
+    per-microbatch batch sharded over 'data'. targets/weights: (M, mb, s).
+
+    Returns (loss_sum, aux_sums, stage_grads, head_grads, dxs
+    (M, mb, ...)): loss/aux/grads summed over 'data' (and replicated over
+    'pipe'); dxs keeps the microbatch layout for the caller to un-permute
+    into its embedding vjp. Grads are of the loss SUM — divide by the
+    caller's token count for mean-loss gradients.
+    """
+    mesh = mesh or get_current_mesh()
+    if mesh is None:
+        raise ValueError(
+            "pipeline_1f1b needs a mesh (set via parallel.ring.set_current_mesh)"
+        )
+    data = MeshConfig.AXIS_DATA
+    mb_spec = P(None, data)  # microbatch dim replicated, batch over 'data'
+    param_spec = jax.tree.map(lambda _: P(axis_name), stage_params)
+    head_spec = jax.tree.map(lambda _: P(), head_params)
+    fn = jax.shard_map(
+        functools.partial(
+            _1f1b_local,
+            block_fn=block_fn,
+            head_loss_fn=head_loss_fn,
+            num_mb=num_microbatches,
+            axis_name=axis_name,
+            compute_dtype=compute_dtype,
+        ),
+        mesh=mesh,
+        in_specs=(param_spec, head_spec, mb_spec, mb_spec, mb_spec),
+        out_specs=(P(), P(), param_spec, head_spec, mb_spec),
+        axis_names=frozenset({axis_name, data}),
+        check_vma=False,
+    )
+    return jax.jit(fn)(
+        stage_params, head_params, xs.astype(jnp.float32), targets, weights
+    )
+
+
+def _1f1b_local(stage_params, head_params, xs, targets, weights, *,
+                block_fn, head_loss_fn, num_mb, axis_name, compute_dtype):
+    sp = jax.tree.map(lambda p: jnp.squeeze(p, axis=0), stage_params)
+    n_stages = lax.psum(1, axis_name)  # trace-time constant
+    idx = lax.axis_index(axis_name)
+    M = xs.shape[0]
+    assert M == num_mb, (M, num_mb)
+    mb_shape = xs.shape[1:]
+    W = 2 * n_stages - 1               # stash ring: max in-flight per stage
+    T = M + 2 * (n_stages - 1)
+    fwd_perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+    bwd_perm = [(i, (i - 1) % n_stages) for i in range(n_stages)]
+    f32 = jnp.float32
+
+    def fwd(sp_, x_):
+        return block_fn(sp_, x_.astype(compute_dtype)).astype(f32)
+
+    def tick(carry, t):
+        (stash, y_in, dy_in, dsp_acc, dhp_acc, loss_acc, aux_acc,
+         dxs_buf) = carry
+
+        # ---- F sub-phase: stage i forwards microbatch t - i
+        fm = t - idx
+        f_valid = (fm >= 0) & (fm < M) & (idx < n_stages - 1)
+        fm_c = jnp.clip(fm, 0, M - 1)
+        x_f = jnp.where(
+            idx == 0, lax.dynamic_index_in_dim(xs, fm_c, 0, False), y_in
+        )
+        y_f = fwd(sp, x_f)
+        stash = jnp.where(
+            f_valid,
+            lax.dynamic_update_index_in_dim(stash, x_f, fm_c % W, 0),
+            stash,
+        )
+
+        # ---- B sub-phase: stage i backwards microbatch t - (2(P-1) - i).
+        # Blocks re-run under jax.vjp on every stage (that is the work);
+        # the vocab-wide head + loss runs under lax.cond on the LAST
+        # stage only — `is_last` is uniform across the 'tensor'/'seq'
+        # shards of a stage, so GSPMD collectives inside the branch are
+        # taken (or skipped) by every member of their group together.
+        # Elsewhere the cotangent flows in from the next stage's B of the
+        # previous tick.
+        bm = t - (2 * (n_stages - 1) - idx)
+        b_valid = (bm >= 0) & (bm < M)
+        bm_c = jnp.clip(bm, 0, M - 1)
+        is_last = idx == n_stages - 1
+        # last stage consumes straight from its inbox (it never forwards);
+        # a single-stage pipeline (last AND first) reads the source batch
+        x_b = jnp.where(
+            is_last,
+            jnp.where(
+                idx == 0, lax.dynamic_index_in_dim(xs, bm_c, 0, False), y_in
+            ),
+            lax.dynamic_index_in_dim(stash, bm_c % W, 0, False),
+        )
+        tgt = lax.dynamic_index_in_dim(targets, bm_c, 0, False)
+        wgt = lax.dynamic_index_in_dim(weights, bm_c, 0, False)
+
+        y_b, blocks_vjp = jax.vjp(fwd, sp, x_b)
+
+        def do_head(operands):
+            hp_, y_ = operands
+            loss_sum, h_vjp, aux = jax.vjp(
+                lambda h, yy: head_loss_fn(h, yy, tgt, wgt),
+                hp_, y_, has_aux=True,
+            )
+            dhp, dy = h_vjp(jnp.ones((), loss_sum.dtype))
+            return loss_sum, aux, dhp, dy.astype(f32)
+
+        def skip_head(operands):
+            hp_, y_ = operands
+            return (
+                jnp.zeros((), f32),
+                jax.tree.map(lambda a: jnp.zeros((), f32), aux_shape),
+                jax.tree.map(lambda p: jnp.zeros(p.shape, p.dtype), hp_),
+                jnp.zeros_like(y_),
+            )
+
+        loss_m, aux_m, dhp_m, dy_head = lax.cond(
+            is_last, do_head, skip_head, (head_params, y_b)
+        )
+        zero_f = jnp.asarray(0.0, f32)
+        dy_ct = jnp.where(is_last, dy_head, dy_in)
+        dsp_m, dx_m = blocks_vjp(dy_ct)
+
+        bmask = b_valid.astype(f32)
+        dsp_acc = jax.tree.map(
+            lambda a, gr: a + gr.astype(f32) * bmask, dsp_acc, dsp_m
+        )
+        dhp_acc = jax.tree.map(
+            lambda a, gr: a + gr.astype(f32) * bmask, dhp_acc, dhp_m
+        )
+        emit = b_valid & is_last
+        loss_acc = loss_acc + jnp.where(emit, loss_m, zero_f)
+        aux_acc = jax.tree.map(
+            lambda a, v: a + jnp.where(emit, v.astype(f32), zero_f),
+            aux_acc, aux_m,
+        )
+        dxs_buf = jnp.where(
+            b_valid & (idx == 0),
+            lax.dynamic_update_index_in_dim(
+                dxs_buf, dx_m.astype(f32), bm_c, 0
+            ),
+            dxs_buf,
+        )
+
+        # ---- hops: activations forward, cotangents backward. Invalid
+        # slots carry garbage; every consumer gates by its own schedule.
+        y_next = lax.ppermute(y_f, axis_name, fwd_perm)
+        dy_next = lax.ppermute(dx_m.astype(f32), axis_name, bwd_perm)
+        return (stash, y_next, dy_next, dsp_acc, dhp_acc, loss_acc,
+                aux_acc, dxs_buf), None
+
+    aux_shape = jax.eval_shape(
+        lambda hp, y, t, w: head_loss_fn(hp, y, t, w)[1],
+        head_params, jnp.zeros(mb_shape, f32), targets[0], weights[0],
+    )
+    carry0 = (
+        jnp.zeros((W,) + mb_shape, f32),            # stash
+        jnp.zeros(mb_shape, f32),                   # y inbox
+        jnp.zeros(mb_shape, f32),                   # dy inbox
+        jax.tree.map(lambda p: jnp.zeros(p.shape, f32), sp),
+        jax.tree.map(lambda p: jnp.zeros(p.shape, f32), head_params),
+        jnp.zeros((), f32),                         # loss sum
+        jax.tree.map(lambda a: jnp.zeros((), f32), aux_shape),
+        jnp.zeros((M,) + mb_shape, f32),            # dxs
+    )
+    (_, _, _, dsp_acc, dhp_acc, loss_acc, aux_acc,
+     dxs_buf), _ = lax.scan(tick, carry0, jnp.arange(T))
+
+    data = MeshConfig.AXIS_DATA
+    # reductions: grads/loss sum over 'data'; last-stage-only values
+    # (loss, aux counts, dxs-at-stage-0, head grads) replicate over
+    # 'pipe' via the masked-psum idiom (the accumulators are already zero
+    # off their producing stage, so a plain psum IS the mask)
+    loss = lax.psum(loss_acc, (axis_name, data))
+    aux = jax.tree.map(lambda a: lax.psum(a, (axis_name, data)), aux_acc)
+    stage_grads = jax.tree.map(
+        lambda g: lax.psum(g, data)[None], dsp_acc
+    )
+    head_grads = jax.tree.map(
+        lambda g: lax.psum(g, (axis_name, data)), dhp_acc
+    )
+    dxs = lax.psum(dxs_buf, axis_name)
+    return loss, aux, stage_grads, head_grads, dxs

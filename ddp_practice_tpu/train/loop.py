@@ -160,12 +160,29 @@ class Trainer:
             # only attention models accept this; a conv model raises loudly
             # rather than silently ignoring the requested kernel
             model_kwargs["attn_impl"] = config.attn_impl
+        if config.pipe_schedule != "gpipe":
+            # same fail-loudly convention as the other pipeline flags: a
+            # schedule request on a pipe-less mesh, or for a model family
+            # that only implements GPipe, must not train something else
+            if self.pp <= 1:
+                raise ValueError(
+                    f"--pipe_schedule {config.pipe_schedule} needs a "
+                    "pipeline mesh axis (--pipe > 1)"
+                )
+            if not config.model.startswith("lm_"):
+                raise ValueError(
+                    f"--pipe_schedule {config.pipe_schedule} is an LM "
+                    "pipeline feature (models/pipeline_lm.py); "
+                    f"{config.model} schedules with GPipe only"
+                )
         if self.pp > 1:
             # pipeline-capable models take the stage count from the mesh; a
             # non-pipeline model with mesh.pipe > 1 fails loudly here rather
             # than silently training unpipelined
             model_kwargs["num_stages"] = self.pp
             model_kwargs["num_microbatches"] = config.num_microbatches
+            if config.pipe_schedule != "gpipe":
+                model_kwargs["schedule"] = config.pipe_schedule
             # tensor parallelism composes: the pipeline shard_map is manual
             # over 'pipe'/'data' only, so the _vit_pipe_rule tensor specs
             # ride GSPMD inside each stage (parallel/pipeline.py)

@@ -218,12 +218,24 @@ def _lm_train_step_fn(model, tx, label_smoothing: float = 0.0, seed: int = 0):
             )
             return loss, logits
 
-        (loss, logits), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            state.params
-        )
+        if getattr(model, "schedule", None) == "1f1b":
+            # memory-bounded pipeline: the model runs its own fwd+bwd
+            # interleaving (parallel/pipeline_1f1b.py) — autodiff of the
+            # forward would force the GPipe all-F-then-all-B order. The
+            # accuracy counts come back as scalars (full logits would be
+            # an O(batch*seq*vocab) metrics buffer inside the schedule)
+            (loss, counts), grads = model.loss_and_grad(
+                state.params, inputs, targets, weight=weight,
+                label_smoothing=label_smoothing,
+            )
+            correct, total = counts["correct"], counts["total"]
+        else:
+            (loss, logits), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(state.params)
+            correct, total = accuracy_counts(logits, targets, weight=weight)
         updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
-        correct, total = accuracy_counts(logits, targets, weight=weight)
         metrics = {
             "loss": loss,
             "perplexity": jnp.exp(loss),
