@@ -143,6 +143,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="persistent XLA compilation cache dir (repeat runs "
                         "skip compile); auto = ~/.cache/ddp_practice_tpu/xla, "
                         "off = disable")
+    p.add_argument("--augment", action="store_true",
+                   help="on-device random crop + horizontal flip inside the "
+                        "jitted train step (image models; deterministic per "
+                        "seed/step — ops/augment.py)")
     p.add_argument("--json", action="store_true", help="print summary as JSON")
     return p
 
@@ -179,6 +183,7 @@ def config_from_args(args) -> TrainConfig:
         attn_impl=args.attn_impl,
         num_microbatches=args.microbatches,
         pipe_schedule=args.pipe_schedule,
+        augment=args.augment,
         num_experts=args.num_experts,
         num_heads=args.num_heads,
         coordinator_address=args.coordinator,

@@ -196,6 +196,9 @@ class TrainConfig:
     # O(M + P)) | "1f1b" (LM only; explicit interleaved backward with an
     # O(P) input stash — parallel/pipeline_1f1b.py)
     pipe_schedule: str = "gpipe"
+    # on-device input augmentation (random crop + horizontal flip inside
+    # the jitted train step, ops/augment.py); image models only
+    augment: bool = False
     # MoE expert count when mesh.expert > 1 (0 = auto: 8 rounded up to a
     # multiple of the expert axis)
     num_experts: int = 0

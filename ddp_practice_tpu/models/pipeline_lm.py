@@ -223,9 +223,18 @@ class PipelinedLM:
         x, embed_vjp = jax.vjp(embed_fn, params["embed"])
         xs = x.reshape((M, b // M) + x.shape[1:])
 
+        # honor remat here exactly like run_blocks/_sequential do: the
+        # backward tick's vjp otherwise stashes every block's internals
+        # (attention matrices, 4x MLP hiddens) — in the schedule whose
+        # whole point is bounded activation memory
+        apply_block = (
+            jax.checkpoint(self.block.apply) if self.remat
+            else self.block.apply
+        )
+
         def block_fn(stage_params, xb):
             def body(h, bp):
-                return self.block.apply({"params": bp}, h), None
+                return apply_block({"params": bp}, h), None
 
             h, _ = lax.scan(body, xb, stage_params)
             return h

@@ -1,0 +1,69 @@
+"""On-device input augmentation: PRNG-keyed random crop + horizontal flip.
+
+The reference trains with bare `ToTensor()` (origin_main.py:89) — no
+augmentation exists to port, but the ImageNet rung (ResNet-50, BASELINE
+config 5) cannot train to real accuracy without crop/flip, so the data
+layer needs the hook. TPU-first placement: augmentation runs INSIDE the
+jitted train step, after the (device-resident) batch gather and the
+uint8 -> float normalize — the host never touches pixels, the whole
+epoch stays one dispatch under the resident driver (train/steps.py), and
+XLA fuses the flip/crop gathers into the first conv's input read.
+
+Determinism contract: the caller keys each step as
+fold_in(fold_in(PRNGKey(seed), AUGMENT_TAG), global_step) — reproducible
+for a given --seed, decorrelated from the dropout stream (different
+fold-in tag), identical under the per-step, chunked-scan and resident
+drivers at the same global step (which encodes epoch), and stable across
+checkpoint resume (state.step restores).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+# fold_in tag separating the augmentation stream from dropout (tag-free)
+AUGMENT_TAG = 0x415547  # "AUG"
+
+
+def random_crop_flip(
+    images: jnp.ndarray,
+    key: jax.Array,
+    *,
+    pad: int = 4,
+    flip: bool = True,
+) -> jnp.ndarray:
+    """Pad-and-crop plus horizontal flip, per image, one fused program.
+
+    images: (B, H, W, C) float (post-normalize). Zero-pads H/W by `pad`,
+    takes a per-image random (H, W) window (offsets uniform in
+    [0, 2*pad]), then mirrors each image left-right with probability 1/2.
+    Static shapes throughout: the crop is a vmapped dynamic_slice, the
+    flip a mask-select — no data-dependent shapes, scan/jit-safe.
+    """
+    b, h, w, c = images.shape
+    kc, kf = jax.random.split(key)
+    if pad > 0:
+        padded = jnp.pad(
+            images, ((0, 0), (pad, pad), (pad, pad), (0, 0))
+        )
+        off = jax.random.randint(kc, (b, 2), 0, 2 * pad + 1)
+
+        def crop(img, o):
+            return lax.dynamic_slice(img, (o[0], o[1], 0), (h, w, c))
+
+        images = jax.vmap(crop)(padded, off)
+    if flip:
+        mirror = jax.random.bernoulli(kf, 0.5, (b,))
+        images = jnp.where(
+            mirror[:, None, None, None], images[:, :, ::-1, :], images
+        )
+    return images
+
+
+def augment_rng(seed: int, step) -> jax.Array:
+    """The per-step augmentation key (see module docstring contract)."""
+    return jax.random.fold_in(
+        jax.random.fold_in(jax.random.PRNGKey(seed), AUGMENT_TAG), step
+    )

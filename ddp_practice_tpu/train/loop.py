@@ -300,10 +300,19 @@ class Trainer:
             state_shardings=self.state_shardings,
             batch_shardings=self.batch_shardings,
         )
-        self.train_step = train_factory(
-            self.model, self.tx,
+        step_kwargs = dict(
             label_smoothing=config.label_smoothing, seed=config.seed,
-            **common,
+        )
+        if config.augment:
+            if self.task == "lm":
+                raise ValueError(
+                    "--augment is image-input augmentation (random crop + "
+                    "flip, ops/augment.py); it does not apply to token "
+                    "streams"
+                )
+            step_kwargs["augment"] = True
+        self.train_step = train_factory(
+            self.model, self.tx, **step_kwargs, **common,
         )
         self.chunk_step = None
         if config.steps_per_call > 1:
@@ -313,8 +322,7 @@ class Trainer:
             self.chunk_step = chunk_factory(
                 self.model, self.tx,
                 num_steps=config.steps_per_call,
-                label_smoothing=config.label_smoothing, seed=config.seed,
-                **common,
+                **step_kwargs, **common,
             )
         self.eval_step = eval_factory(self.model, **common)
         # device-resident data: corpus uploaded to HBM once, epochs driven
@@ -392,6 +400,7 @@ class Trainer:
                     self.tx,
                     label_smoothing=config.label_smoothing,
                     seed=config.seed,
+                    augment=config.augment,
                     mesh=self.mesh,
                     state_shardings=self.state_shardings,
                 )

@@ -52,12 +52,24 @@ def _step_rngs(step, seed: int = 0):
     return {"dropout": jax.random.fold_in(jax.random.PRNGKey(seed), step)}
 
 
-def _train_step_fn(model, tx, label_smoothing: float, seed: int = 0):
+def _train_step_fn(model, tx, label_smoothing: float, seed: int = 0,
+                   augment: bool = False):
     """The pure (state, batch) -> (state, metrics) function both the
     per-step and the scan-chunked factories jit."""
 
     def train_step(state: TrainState, batch):
         has_bn = state.batch_stats is not None
+        images = prepare_image(batch["image"])
+        if augment:
+            # inside the jitted step, after the (resident) gather +
+            # normalize; keyed on the global step so every driver variant
+            # sees the same crops at the same step (ops/augment.py)
+            from ddp_practice_tpu.ops.augment import (
+                augment_rng,
+                random_crop_flip,
+            )
+
+            images = random_crop_flip(images, augment_rng(seed, state.step))
 
         def loss_fn(params):
             variables = {"params": params}
@@ -66,7 +78,7 @@ def _train_step_fn(model, tx, label_smoothing: float, seed: int = 0):
                 variables["batch_stats"] = state.batch_stats
                 mutable.append("batch_stats")
             logits, updated = model.apply(
-                variables, prepare_image(batch["image"]), train=True,
+                variables, images, train=True,
                 mutable=mutable, rngs=_step_rngs(state.step, seed),
             )
             new_stats = updated["batch_stats"] if has_bn else None
@@ -114,6 +126,7 @@ def make_train_step(
     *,
     label_smoothing: float = 0.0,
     seed: int = 0,
+    augment: bool = False,
     mesh=None,
     state_shardings=None,
     batch_shardings=None,
@@ -123,7 +136,7 @@ def make_train_step(
     When mesh/shardings are given, they pin input/output layouts (GSPMD);
     the state buffer is donated so parameters update in place in HBM.
     """
-    train_step = _train_step_fn(model, tx, label_smoothing, seed)
+    train_step = _train_step_fn(model, tx, label_smoothing, seed, augment)
     if mesh is not None and state_shardings is not None:
         from ddp_practice_tpu.parallel.mesh import replicated
 
@@ -160,6 +173,7 @@ def make_chunked_train_step(
     num_steps: int,
     label_smoothing: float = 0.0,
     seed: int = 0,
+    augment: bool = False,
     mesh=None,
     state_shardings=None,
     batch_shardings=None,
@@ -173,7 +187,7 @@ def make_chunked_train_step(
     XLA program amortizes both by K. Identical math to K calls of
     make_train_step. Returned metrics are the final step's.
     """
-    step_fn = _train_step_fn(model, tx, label_smoothing, seed)
+    step_fn = _train_step_fn(model, tx, label_smoothing, seed, augment)
 
     def chunk_step(state, batches):
         state, ms = jax.lax.scan(step_fn, state, batches)
@@ -365,6 +379,7 @@ def make_resident_train_step(
     *,
     label_smoothing: float = 0.0,
     seed: int = 0,
+    augment: bool = False,
     mesh=None,
     state_shardings=None,
 ):
@@ -384,7 +399,7 @@ def make_resident_train_step(
     G is read from idx's shape — one factory serves any group size; each
     distinct G compiles once. Returned metrics are the final step's.
     """
-    step_fn = _train_step_fn(model, tx, label_smoothing, seed)
+    step_fn = _train_step_fn(model, tx, label_smoothing, seed, augment)
     bsh = None
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec as P
