@@ -55,6 +55,16 @@ _SUITE = {
     "lm_long": dict(
         kind="lm", seq_len=2048, batch_size=8, steps_per_call=4, calls=4,
     ),
+    # MoE LM at lm_base dims, experts every other block (GShard layout):
+    # tokens/sec + MFU (active-FLOPs accounting) + router drop rate
+    "lm_moe": dict(
+        kind="lm", model="lm_moe", seq_len=2048, batch_size=8,
+        steps_per_call=4, calls=4,
+        model_kwargs={
+            "hidden_dim": 768, "depth": 12, "num_heads": 12,
+            "mlp_dim": 3072, "moe_every": 2, "num_experts": 8,
+        },
+    ),
     "lm_8k": dict(
         kind="lm", seq_len=8192, batch_size=2, steps_per_call=2, calls=3,
     ),
@@ -85,7 +95,7 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser("bench")
     p.add_argument("--models",
                    default="vit_base,vit_tiny,convnet,resnet18,resnet50,"
-                           "lm_long,lm_decode,lm_decode_bs1",
+                           "lm_long,lm_moe,lm_decode,lm_decode_bs1",
                    help="comma-separated; first successful is the headline")
     p.add_argument("--precision", default="bf16", choices=["fp32", "bf16"])
     p.add_argument("--batch_size", type=int, default=0, help="override")
@@ -123,7 +133,7 @@ def main(argv=None) -> int:
             kw["calls"] = args.calls
         try:
             if kind == "lm":
-                r = bench_lm_train("lm_base", **kw)
+                r = bench_lm_train(kw.pop("model", "lm_base"), **kw)
                 r["model"] = name
                 results.append(r)
             elif kind == "decode":

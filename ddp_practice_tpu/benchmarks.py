@@ -311,6 +311,8 @@ def bench_lm_train(
             hidden_dim=model.hidden_dim, depth=model.depth,
             mlp_dim=model.mlp_dim, vocab_size=vocab_size, seq_len=seq_len,
             causal=True,
+            moe_every=getattr(model, "moe_every", 0),
+            moe_top_k=getattr(model, "moe_top_k", 2),
         )
         out = {
             "model": model_name,
@@ -334,6 +336,10 @@ def bench_lm_train(
         if peak:
             out["mfu_pct"] = round(100.0 * tflops_chip * 1e12 / peak, 2)
             out["peak_bf16_tflops"] = peak / 1e12
+        # router health from the final step's metrics (lm_moe)
+        for k in ("moe_drop_rate", "moe_load_max", "moe_load_min"):
+            if k in metrics:
+                out[k] = round(float(metrics[k]), 4)
         return out
     finally:
         set_current_mesh(None)

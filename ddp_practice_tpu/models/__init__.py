@@ -154,6 +154,19 @@ def _vit_tiny_pipe(*, num_classes, policy, axis_name, **kw):
     )
 
 
+@register("lm_moe")
+def _lm_moe(*, num_classes, policy, axis_name, **kw):
+    # decoder LM with routed expert MLPs every other block (GShard
+    # layout); dims default to lm_tiny's — the bench sizes it up via
+    # model_kwargs
+    kw.setdefault("moe_every", 2)
+    return LMTiny(
+        dtype=policy.compute_dtype,
+        param_dtype=policy.param_dtype,
+        **kw,
+    )
+
+
 @register("lm_pipe")
 def _lm_pipe(*, num_classes, policy, axis_name, **kw):
     # LM registry convention: num_classes/axis_name accepted and ignored

@@ -65,6 +65,13 @@ class TransformerLM(nn.Module):
     # embedding + residual-branch dropout (GPT-2 placement); never active
     # in decode mode (generation always runs deterministic)
     dropout_rate: float = 0.0
+    # MoE composition: every `moe_every`-th block (GShard layout) swaps
+    # its dense MLP for a routed expert MLP (ops/moe.py). 0 = dense.
+    # Router health flows out as moe_* metrics (train/steps.py).
+    moe_every: int = 0
+    num_experts: int = 8
+    moe_top_k: int = 2
+    capacity_factor: float = 1.25
     axis_name: Optional[str] = None  # registry uniformity (no BN anywhere)
 
     @nn.compact
@@ -165,6 +172,13 @@ class TransformerLM(nn.Module):
                 rope=self.pos_emb == "rope",
                 kv_cache_dtype=self.kv_cache_dtype,
                 dropout_rate=self.dropout_rate,
+                use_moe=(
+                    self.moe_every > 0
+                    and i % self.moe_every == self.moe_every - 1
+                ),
+                num_experts=self.num_experts,
+                moe_top_k=self.moe_top_k,
+                capacity_factor=self.capacity_factor,
                 name=f"block{i}",
             )
             # positional (decode, train): nn.remat's static_argnums are

@@ -223,6 +223,13 @@ class EncoderBlock(nn.Module):
     # variant cannot compose with the flash/ring kernels, which never
     # materialize the probability matrix.
     dropout_rate: float = 0.0
+    # swap the dense MLP for a routed expert MLP (ops/moe.py) — the LM
+    # MoE composition (models/lm.py moe_every); ViT's dedicated MoE
+    # blocks live in models/vit_moe.py
+    use_moe: bool = False
+    num_experts: int = 8
+    moe_top_k: int = 2
+    capacity_factor: float = 1.25
 
     @nn.compact
     def __call__(self, x, decode: bool = False, train: bool = False, *,
@@ -247,10 +254,27 @@ class EncoderBlock(nn.Module):
         y = nn.Dropout(self.dropout_rate, deterministic=not train)(y)
         x = x + y
         y = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype, name="ln2")(x)
-        y = MlpBlock(
-            self.mlp_dim, dtype=self.dtype, param_dtype=self.param_dtype,
-            dropout_rate=self.dropout_rate, name="mlp",
-        )(y, train=train)
+        if self.use_moe:
+            from ddp_practice_tpu.ops.moe import MoEMlp
+
+            y = MoEMlp(
+                num_experts=self.num_experts,
+                top_k=self.moe_top_k,
+                capacity_factor=self.capacity_factor,
+                mlp_dim=self.mlp_dim,
+                dtype=self.dtype,
+                param_dtype=self.param_dtype,
+                name="moe",
+            )(y)
+            # residual-branch dropout for the routed MLP — the dense
+            # MlpBlock applies its own internally; without this the MoE
+            # blocks would silently train unregularized under --dropout
+            y = nn.Dropout(self.dropout_rate, deterministic=not train)(y)
+        else:
+            y = MlpBlock(
+                self.mlp_dim, dtype=self.dtype, param_dtype=self.param_dtype,
+                dropout_rate=self.dropout_rate, name="mlp",
+            )(y, train=train)
         return x + y
 
 

@@ -15,53 +15,7 @@ from typing import Optional
 import flax.linen as nn
 import jax.numpy as jnp
 
-from ddp_practice_tpu.models.vit import MlpBlock, SelfAttention, ViTEmbed, ViTHead
-from ddp_practice_tpu.ops.moe import MoEMlp
-
-
-class MoEEncoderBlock(nn.Module):
-    num_heads: int
-    mlp_dim: int
-    num_experts: int = 8
-    top_k: int = 2
-    capacity_factor: float = 1.25
-    dtype: jnp.dtype = jnp.float32
-    param_dtype: jnp.dtype = jnp.float32
-    seq_axis: Optional[str] = None
-    sp_impl: str = "ring"
-    attn_impl: str = "xla"
-    use_moe: bool = True
-
-    @nn.compact
-    def __call__(self, x):
-        y = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype, name="ln1")(x)
-        y = SelfAttention(
-            self.num_heads,
-            dtype=self.dtype,
-            param_dtype=self.param_dtype,
-            seq_axis=self.seq_axis,
-            sp_impl=self.sp_impl,
-            attn_impl=self.attn_impl,
-            name="attn",
-        )(y)
-        x = x + y
-        y = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype, name="ln2")(x)
-        if self.use_moe:
-            y = MoEMlp(
-                num_experts=self.num_experts,
-                top_k=self.top_k,
-                capacity_factor=self.capacity_factor,
-                mlp_dim=self.mlp_dim,
-                dtype=self.dtype,
-                param_dtype=self.param_dtype,
-                name="moe",
-            )(y)
-        else:
-            y = MlpBlock(
-                self.mlp_dim, dtype=self.dtype, param_dtype=self.param_dtype,
-                name="mlp",
-            )(y)
-        return x + y
+from ddp_practice_tpu.models.vit import EncoderBlock, ViTEmbed, ViTHead
 
 
 class ViTMoE(nn.Module):
@@ -92,11 +46,14 @@ class ViTMoE(nn.Module):
             name="embed",
         )(x)
         for i in range(self.depth):
-            x = MoEEncoderBlock(
+            # the one shared dense/MoE block swap (models/vit.py
+            # EncoderBlock use_moe) — identical submodule names keep
+            # existing vit_tiny_moe param trees valid
+            x = EncoderBlock(
                 self.num_heads,
                 self.mlp_dim,
                 num_experts=self.num_experts,
-                top_k=self.top_k,
+                moe_top_k=self.top_k,
                 capacity_factor=self.capacity_factor,
                 dtype=self.dtype,
                 param_dtype=self.param_dtype,
@@ -105,7 +62,7 @@ class ViTMoE(nn.Module):
                 attn_impl=self.attn_impl,
                 use_moe=(i % self.moe_every == self.moe_every - 1),
                 name=f"block{i}",
-            )(x)
+            )(x, False, train)
         return ViTHead(
             num_classes=self.num_classes,
             dtype=self.dtype,

@@ -129,6 +129,19 @@ class MoEMlp(nn.Module):
             logits, k=self.top_k, capacity=capacity
         )
         self.sow("intermediates", "moe_aux_loss", self.aux_loss_weight * aux)
+        # router health (diagnostic sows — no "aux_loss" in the name, so
+        # they never join the objective; train/steps.py surfaces them as
+        # moe_* metrics): per-expert share of ROUTED tokens, and the
+        # fraction of the k*T assignment slots lost to capacity drops
+        routed = jnp.sum(dispatch)
+        self.sow(
+            "intermediates", "moe_load_frac",
+            jnp.sum(dispatch, axis=(0, 1, 3)) / jnp.maximum(routed, 1.0),
+        )
+        self.sow(
+            "intermediates", "moe_drop_rate",
+            1.0 - routed / (self.top_k * g * t),
+        )
 
         w_in = self.param(
             "expert_w_in",

@@ -74,15 +74,24 @@ def _vit_pipe_rule(path, leaf) -> Optional[P]:
     return None
 
 
-def _vit_moe_rule(path, leaf) -> Optional[P]:
-    """ViT-MoE: stacked expert weights shard their leading E dim over
-    'expert'; router replicated; dense attention/MLP follow the TP rules."""
-    name = keystr(path)
-    if "expert_" in name:
-        return P(MeshConfig.AXIS_EXPERT)
-    if "router" in name:
-        return None
-    return _vit_rule(path, leaf)
+def _moe_rule(dense_rule: Callable) -> Callable:
+    """Wrap a dense rule with the MoE leaves: stacked expert weights
+    shard their leading E dim over 'expert'; router replicated. One
+    definition serves ViT-MoE and the MoE LM — the param naming
+    (ops/moe.py) is shared, so the sharding must be too."""
+
+    def rule(path, leaf) -> Optional[P]:
+        name = keystr(path)
+        if "expert_" in name:
+            return P(MeshConfig.AXIS_EXPERT)
+        if "router" in name:
+            return None
+        return dense_rule(path, leaf)
+
+    return rule
+
+
+_vit_moe_rule = _moe_rule(_vit_rule)
 
 
 def _lm_rule(path, leaf) -> Optional[P]:
@@ -100,6 +109,9 @@ def _lm_rule(path, leaf) -> Optional[P]:
     if "pos_embed" in name:
         return None
     return _vit_rule(path, leaf)
+
+
+_lm_moe_rule = _moe_rule(_lm_rule)
 
 
 def _lm_pipe_rule(path, leaf) -> Optional[P]:
@@ -120,6 +132,7 @@ _RULES: dict = {
     "vit_tiny_moe": _vit_moe_rule,
     "lm_tiny": _lm_rule,
     "lm_base": _lm_rule,
+    "lm_moe": _lm_moe_rule,
     "lm_pipe": _lm_pipe_rule,
 }
 

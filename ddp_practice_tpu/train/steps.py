@@ -40,6 +40,42 @@ def prepare_image(img):
     return img
 
 
+def _sown_aux_loss(intermediates):
+    """Sum every sown leaf whose name carries the "aux_loss" suffix (MoE
+    load-balance); diagnostic sows (router health, activations) never
+    leak into the objective."""
+    return sum(
+        jnp.sum(leaf)
+        for path, leaf in jax.tree_util.tree_flatten_with_path(
+            intermediates
+        )[0]
+        if "aux_loss" in jax.tree_util.keystr(path)
+    )
+
+
+def _moe_metrics(intermediates):
+    """Router-health scalars from the MoE diagnostic sows (ops/moe.py):
+    worst/best per-expert share of routed tokens (ideal = 1/E each) and
+    the mean assignment-slot drop rate, aggregated over MoE layers."""
+    fracs, drops = [], []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(
+        intermediates
+    )[0]:
+        name = jax.tree_util.keystr(path)
+        if "moe_load_frac" in name:
+            fracs.append(jnp.ravel(leaf))
+        elif "moe_drop_rate" in name:
+            drops.append(jnp.ravel(leaf))
+    out = {}
+    if fracs:
+        stacked = jnp.concatenate(fracs)
+        out["moe_load_max"] = jnp.max(stacked)
+        out["moe_load_min"] = jnp.min(stacked)
+    if drops:
+        out["moe_drop_rate"] = jnp.mean(jnp.concatenate(drops))
+    return out
+
+
 def _step_rngs(step, seed: int = 0):
     """Per-step RNGs for stochastic layers (dropout).
 
@@ -85,20 +121,11 @@ def _train_step_fn(model, tx, label_smoothing: float, seed: int = 0,
             loss = cross_entropy(
                 logits, batch["label"], label_smoothing=label_smoothing
             )
-            # sown auxiliary losses (MoE load-balance), pre-scaled by their
-            # layers; keyed on the "aux_loss" name suffix so diagnostic sows
-            # (activations, entropies) never leak into the objective
-            aux = sum(
-                jnp.sum(leaf)
-                for path, leaf in jax.tree_util.tree_flatten_with_path(
-                    updated.get("intermediates", {})
-                )[0]
-                if "aux_loss" in jax.tree_util.keystr(path)
-            )
-            loss = loss + aux
-            return loss, (logits, new_stats)
+            inter = updated.get("intermediates", {})
+            loss = loss + _sown_aux_loss(inter)
+            return loss, (logits, new_stats, inter)
 
-        (loss, (logits, new_stats)), grads = jax.value_and_grad(
+        (loss, (logits, new_stats, inter)), grads = jax.value_and_grad(
             loss_fn, has_aux=True
         )(state.params)
         updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
@@ -108,6 +135,7 @@ def _train_step_fn(model, tx, label_smoothing: float, seed: int = 0,
             "loss": loss,
             "accuracy": correct / total,
             "grad_norm": optax.global_norm(grads),
+            **_moe_metrics(inter),
         }
         new_state = TrainState(
             step=state.step + 1,
@@ -222,15 +250,20 @@ def _lm_train_step_fn(model, tx, label_smoothing: float = 0.0, seed: int = 0):
         weight = batch.get("weight")
 
         def loss_fn(params):
-            logits = model.apply(
+            logits, updated = model.apply(
                 {"params": params}, inputs, train=True,
+                mutable=["intermediates"],
                 rngs=_step_rngs(state.step, seed),
             )
             loss = cross_entropy(
                 logits, targets, weight=weight,
                 label_smoothing=label_smoothing,
             )
-            return loss, logits
+            inter = updated.get("intermediates", {})
+            # MoE blocks (lm_moe) sow their load-balance loss + router
+            # health here, exactly like the image step
+            loss = loss + _sown_aux_loss(inter)
+            return loss, (logits, inter)
 
         if getattr(model, "schedule", None) == "1f1b":
             # memory-bounded pipeline: the model runs its own fwd+bwd
@@ -243,8 +276,9 @@ def _lm_train_step_fn(model, tx, label_smoothing: float = 0.0, seed: int = 0):
                 label_smoothing=label_smoothing,
             )
             correct, total = counts["correct"], counts["total"]
+            inter = {}
         else:
-            (loss, logits), grads = jax.value_and_grad(
+            (loss, (logits, inter)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True
             )(state.params)
             correct, total = accuracy_counts(logits, targets, weight=weight)
@@ -255,6 +289,7 @@ def _lm_train_step_fn(model, tx, label_smoothing: float = 0.0, seed: int = 0):
             "perplexity": jnp.exp(loss),
             "accuracy": correct / jnp.maximum(total, 1.0),
             "grad_norm": optax.global_norm(grads),
+            **_moe_metrics(inter),
         }
         new_state = TrainState(
             step=state.step + 1,

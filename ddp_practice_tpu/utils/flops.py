@@ -115,17 +115,29 @@ def vit_forward_flops(image_shape=(32, 32, 3), *, patch_size: int = 4,
 
 def lm_forward_flops_per_token(*, hidden_dim: int, depth: int, mlp_dim: int,
                                vocab_size: int, seq_len: int,
-                               causal: bool = True) -> float:
+                               causal: bool = True, moe_every: int = 0,
+                               moe_top_k: int = 2) -> float:
     """Decoder LM (models/lm.py) forward FLOPs per token. Per layer:
     8*d^2 (qkv + out projections) + 4*d*mlp (MLP) + attention score/value
     matmuls 4*s*d, halved under causal masking (each query attends to s/2
     keys on average — flash skips the masked blocks; the dense path
     wastes them, so causal MFU there is conservative). Plus the 2*d*V
-    lm_head. Embedding lookups are gathers, not FLOPs."""
+    lm_head. Embedding lookups are gathers, not FLOPs.
+
+    moe_every > 0 (lm_moe): every moe_every-th layer's MLP routes each
+    token through top_k experts, so its ACTIVE MLP FLOPs are k * dense
+    (plus the negligible d*E router). Dropped tokens make this an upper
+    bound on active FLOPs — MFU for MoE is conservative."""
     d, m, v, s = hidden_dim, mlp_dim, vocab_size, seq_len
     attn = 4.0 * s * d * (0.5 if causal else 1.0)
-    per_layer = 8.0 * d * d + 4.0 * d * m + attn
-    return depth * per_layer + 2.0 * d * v
+    mlp = 4.0 * d * m
+    total = depth * (8.0 * d * d + attn) + 2.0 * d * v
+    if moe_every > 0:
+        n_moe = depth // moe_every
+        total += (depth - n_moe) * mlp + n_moe * moe_top_k * mlp
+    else:
+        total += depth * mlp
+    return total
 
 
 def lm_train_flops_per_token(**kw) -> float:

@@ -109,6 +109,66 @@ def test_vit_moe_sharded_train_step(expert_mesh):
     assert np.isfinite(float(metrics["loss"]))
 
 
+def test_lm_moe_every_zero_is_dense_lm(devices):
+    """lm_moe with moe_every=0 IS the dense decoder: identical param tree
+    and bit-identical logits to lm_tiny — the MoE composition is additive,
+    not a fork of the family."""
+    kw = dict(vocab_size=32, max_len=32, hidden_dim=32, depth=2,
+              num_heads=4, mlp_dim=64)
+    moe0 = create_model("lm_moe", moe_every=0, **kw)
+    dense = create_model("lm_tiny", **kw)
+    tokens = jnp.asarray(
+        np.random.default_rng(4).integers(0, 32, (2, 16)), jnp.int32
+    )
+    v = dense.init(jax.random.PRNGKey(0), tokens)
+    assert (
+        jax.tree.structure(moe0.init(jax.random.PRNGKey(0), tokens))
+        == jax.tree.structure(v)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(moe0.apply(v, tokens)), np.asarray(dense.apply(v, tokens))
+    )
+
+
+def test_lm_moe_sharded_train_step_with_router_metrics(expert_mesh):
+    """dp x ep MoE LM: expert-sharded params train; the step surfaces
+    router health (load fractions bounded, drop rate in [0,1])."""
+    from ddp_practice_tpu.train.steps import make_lm_train_step
+
+    model = create_model(
+        "lm_moe", vocab_size=32, max_len=32, hidden_dim=32, depth=2,
+        num_heads=4, mlp_dim=64, num_experts=4, moe_every=2,
+    )
+    cfg = TrainConfig(optimizer="adamw", learning_rate=1e-3)
+    tx = make_optimizer(cfg)
+    sample = jnp.zeros((8, 16), jnp.int32)
+
+    def init_fn(r):
+        return create_state(model, tx, rng=r, sample_input=sample)
+
+    abstract = jax.eval_shape(init_fn, jax.random.PRNGKey(0))
+    shardings = shard_state(
+        abstract, expert_mesh, param_sharding_rules("lm_moe")
+    )
+    state = jax.jit(init_fn, out_shardings=shardings)(jax.random.PRNGKey(0))
+    w = state.params["block1"]["moe"]["expert_w_in"]
+    assert w.addressable_shards[0].data.shape[0] == w.shape[0] // 4
+
+    step = make_lm_train_step(
+        model, tx, mesh=expert_mesh, state_shardings=shardings,
+        batch_shardings=batch_sharding(expert_mesh),
+    )
+    tokens = jnp.asarray(
+        np.random.default_rng(5).integers(0, 32, (8, 17)), jnp.int32
+    )
+    state, metrics = step(state, {"tokens": tokens})
+    assert np.isfinite(float(metrics["loss"]))
+    assert 0.0 <= float(metrics["moe_drop_rate"]) <= 1.0
+    assert 0.0 <= float(metrics["moe_load_min"]) <= float(
+        metrics["moe_load_max"]
+    ) <= 1.0
+
+
 def test_aux_loss_increases_total_loss(expert_mesh):
     """The sown aux loss reaches the optimized objective: total loss with
     aux weight > 0 differs from the pure CE value."""
