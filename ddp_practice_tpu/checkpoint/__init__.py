@@ -20,14 +20,23 @@ good checkpoint):
   oldest-first only after the new one is complete;
 - `restore` picks the newest *complete* step dir, ignoring temp debris.
 
-Multi-host: gathering is collective — EVERY process calls save(); leaves
-whose shards span hosts (FSDP/TP state) are all-gathered to host memory
-via multihost_utils, then only process 0 writes, and all processes
-barrier before returning so a restart can't read a half-written dir.
+Multi-host: EVERY process calls save() (the barriers are collective).
+Leaves whose shards span hosts (FSDP/TP state) are NOT gathered — each
+process writes its own addressable shards (replica 0 only, so exactly one
+copy of each region lands on disk) to `shards.<proc>.npz` with an index
+sidecar, and process 0 writes the dense leaves + manifest last. That
+keeps host memory and network traffic O(local shards) per save instead of
+O(model) per HOST that a process_allgather costs — the difference between
+a demo and a checkpoint path that scales with FSDP. The directory must be
+shared storage (NFS/GCS-style), the standard contract for distributed
+checkpointing. restore() reassembles the full arrays from the shard files
+under any process count — including a single host reading a multi-host
+checkpoint — and re-shards onto whatever mesh the target dictates.
 
-Format: one .npz of flattened leaves keyed by pytree path + manifest.json.
-Self-contained (no orbax API surface). The single-file layout of early
-development (leaves.npz directly in `directory`) still restores.
+Format: one .npz of flattened dense leaves keyed by pytree path +
+shards.<p>.npz/json for cross-host leaves + manifest.json. Self-contained
+(no orbax API surface). The single-file layout of early development
+(leaves.npz directly in `directory`) still restores.
 """
 
 from __future__ import annotations
@@ -49,16 +58,39 @@ _SCHEMA_VERSION = 2
 
 
 def _leaf_to_host(leaf) -> np.ndarray:
-    """Bring a (possibly multi-host-sharded) leaf to host memory.
-
-    With FSDP/TP rules, params and optimizer state shard across processes;
-    `device_get` alone raises on non-addressable shards, so those leaves
-    are all-gathered first (a collective — all processes participate)."""
-    if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
-        from jax.experimental import multihost_utils
-
-        leaf = multihost_utils.process_allgather(leaf, tiled=True)
+    """Bring a fully-addressable leaf to host memory. Cross-host leaves
+    never come through here — they take the per-process shard-file path
+    (save() splits them out; no full-leaf gather exists in this module)."""
     return np.asarray(jax.device_get(leaf))
+
+
+def _slices_to_index(slices, shape):
+    """Serialize a Shard.index (tuple of slices) as [[start, stop], ...]."""
+    out = []
+    for sl, dim in zip(slices, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = dim if sl.stop is None else int(sl.stop)
+        out.append([start, stop])
+    return out
+
+
+def _local_shard_files(sharded):
+    """(arrays, index) for THIS process's replica-0 shards of the given
+    {leaf_i: jax.Array} map — each cross-host region is written by exactly
+    one process, no duplication, no gather."""
+    arrays, index = {}, []
+    for i, leaf in sharded.items():
+        for k, s in enumerate(leaf.addressable_shards):
+            if s.replica_id != 0:
+                continue
+            key = f"leaf_{i}.s{k}"
+            arrays[key] = np.asarray(s.data)
+            index.append({
+                "leaf": i,
+                "key": key,
+                "index": _slices_to_index(s.index, leaf.shape),
+            })
+    return arrays, index
 
 
 def _complete_steps(directory: str) -> List[int]:
@@ -119,16 +151,54 @@ def save(
     ddp_main.py:165-169). Returns the final checkpoint path.
     """
     extra, step = _normalize_step(extra, step)
-    arrays, names = _gather(state)
+    arrays, names, sharded = _gather(
+        state, host_dense=jax.process_index() == 0
+    )
     final = os.path.join(directory, f"step_{step}")
-    if jax.process_index() == 0:
-        _write(directory, arrays, names, extra, step, keep_last)
-    if jax.process_count() > 1:
-        from jax.experimental import multihost_utils
+    if not sharded:
+        if jax.process_index() == 0:
+            _write(directory, arrays, names, extra, step, keep_last)
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
 
-        # no process may return (and possibly restart+restore) before the
-        # checkpoint is fully on disk
-        multihost_utils.sync_global_devices(f"ckpt_save_{step}")
+            # no process may return (and possibly restart+restore) before
+            # the checkpoint is fully on disk
+            multihost_utils.sync_global_devices(f"ckpt_save_{step}")
+        return final
+
+    # cross-host leaves: per-process shard writes into a SHARED temp dir
+    # (deterministic name), manifest written last by process 0 after every
+    # writer has finished — completeness still implies integrity
+    from jax.experimental import multihost_utils
+
+    pid = jax.process_index()
+    tmp = os.path.join(directory, f"tmp.step_{step}.shared")
+    if pid == 0:
+        os.makedirs(directory, exist_ok=True)
+        if os.path.isdir(tmp):
+            # a crashed earlier save may have left this as the ONLY
+            # complete checkpoint (_resolve's last resort accepts it) —
+            # move it aside, never delete before the new one is durable
+            # (_publish's debris sweep runs after the rename)
+            os.rename(tmp, f"{tmp}.old.{os.getpid()}")
+        os.makedirs(tmp)
+    multihost_utils.sync_global_devices(f"ckpt_tmpdir_{step}")
+    shard_arrays, shard_index = _local_shard_files(sharded)
+    np.savez(os.path.join(tmp, f"shards.{pid}.npz"), **shard_arrays)
+    with open(os.path.join(tmp, f"shards.{pid}.json"), "w") as f:
+        json.dump(shard_index, f)
+    multihost_utils.sync_global_devices(f"ckpt_shards_{step}")
+    if pid == 0:
+        sharded_meta = {
+            str(i): {
+                "shape": list(leaf.shape),
+                "dtype": str(np.dtype(leaf.dtype)),
+            }
+            for i, leaf in sharded.items()
+        }
+        _serialize_into(tmp, arrays, names, extra, sharded_meta)
+        _publish(directory, tmp, final, keep_last)
+    multihost_utils.sync_global_devices(f"ckpt_save_{step}")
     return final
 
 
@@ -182,7 +252,8 @@ def save_async(
     import threading
 
     extra, step = _normalize_step(extra, step)
-    arrays, names = _gather(state)
+    arrays, names, sharded = _gather(state)
+    assert not sharded, "single-process leaves are always fully addressable"
     final = os.path.join(directory, f"step_{step}")
 
     def _run():
@@ -207,15 +278,41 @@ def _normalize_step(extra, step):
     return extra, step
 
 
-def _gather(state):
-    """Flatten + bring every leaf to host memory (collective multi-host)."""
+def _gather(state, *, host_dense: bool = True):
+    """Flatten the state: fully-addressable leaves to host memory
+    (arrays), cross-host leaves left on device for the per-process
+    shard-file path (sharded: {leaf_i: jax.Array}).
+
+    host_dense=False skips the D2H copies of the dense leaves — only
+    process 0 ever writes them, so the other processes should not pay a
+    device fence + transfer per save."""
     paths_and_leaves, _ = tree_flatten_with_path(state)
     arrays = {}
     names = []
+    sharded = {}
     for i, (path, leaf) in enumerate(paths_and_leaves):
         names.append(keystr(path))
-        arrays[f"leaf_{i}"] = _leaf_to_host(leaf)
-    return arrays, names
+        if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+            sharded[i] = leaf
+        elif host_dense:
+            arrays[f"leaf_{i}"] = _leaf_to_host(leaf)
+    return arrays, names, sharded
+
+
+def _serialize_into(tmp, arrays, names, extra, sharded_meta=None) -> None:
+    """Write leaves.npz then manifest.json (LAST — its presence marks the
+    checkpoint complete) into an existing temp dir. One implementation
+    for the dense and sharded save paths, so the schema cannot drift."""
+    np.savez(os.path.join(tmp, _LEAVES), **arrays)
+    manifest = {
+        "schema_version": _SCHEMA_VERSION,
+        "paths": names,
+        "extra": extra,
+    }
+    if sharded_meta:
+        manifest["sharded_leaves"] = sharded_meta
+    with open(os.path.join(tmp, _MANIFEST), "w") as f:
+        json.dump(manifest, f, indent=2)
 
 
 def _write(directory, arrays, names, extra, step, keep_last) -> str:
@@ -226,15 +323,12 @@ def _write(directory, arrays, names, extra, step, keep_last) -> str:
     if os.path.isdir(tmp):
         shutil.rmtree(tmp)
     os.makedirs(tmp)
-    np.savez(os.path.join(tmp, _LEAVES), **arrays)
-    manifest = {
-        "schema_version": _SCHEMA_VERSION,
-        "paths": names,
-        "extra": extra,
-    }
-    # manifest last: its presence marks the checkpoint complete
-    with open(os.path.join(tmp, _MANIFEST), "w") as f:
-        json.dump(manifest, f, indent=2)
+    _serialize_into(tmp, arrays, names, extra)
+    return _publish(directory, tmp, final, keep_last)
+
+
+def _publish(directory, tmp, final, keep_last) -> str:
+    """Atomically swing a complete temp dir into place, prune, sweep."""
     if os.path.isdir(final):
         # re-save at the same step (e.g. the end-of-fit save landing on
         # the last periodic save's step): move the old dir aside before
@@ -274,6 +368,7 @@ def restore(directory: str, target: Any, *, shardings: Any = None) -> Any:
     data = np.load(os.path.join(src, _LEAVES))
     with open(os.path.join(src, _MANIFEST)) as f:
         manifest = json.load(f)
+    assembled = _assemble_shards(src, manifest)
     paths_and_leaves, treedef = tree_flatten_with_path(target)
     if len(paths_and_leaves) != len(manifest["paths"]):
         raise ValueError(
@@ -286,7 +381,7 @@ def restore(directory: str, target: Any, *, shardings: Any = None) -> Any:
         got = manifest["paths"][i]
         if want != got:
             raise ValueError(f"checkpoint leaf {i} is {got!r}; target wants {want!r}")
-        arr = data[f"leaf_{i}"]
+        arr = assembled[i] if i in assembled else data[f"leaf_{i}"]
         want_shape = getattr(leaf, "shape", None)
         if want_shape is not None and tuple(arr.shape) != tuple(want_shape):
             # e.g. generate.py --seq_len different from the training run:
@@ -305,6 +400,43 @@ def restore(directory: str, target: Any, *, shardings: Any = None) -> Any:
             lambda x, s: jax.device_put(x, s), restored, shardings
         )
     return restored
+
+
+def _assemble_shards(src: str, manifest: dict) -> dict:
+    """Reassemble cross-host leaves from the per-process shard files.
+
+    Works under ANY process count — a single host restoring a multi-host
+    checkpoint just reads every shards.<p>.npz it finds. Coverage is
+    verified element-exactly (replica-0 shards partition each array), so
+    a missing writer's file fails loudly instead of returning zeros."""
+    meta = manifest.get("sharded_leaves") or {}
+    if not meta:
+        return {}
+    out = {
+        int(i): np.zeros(m["shape"], np.dtype(m["dtype"]))
+        for i, m in meta.items()
+    }
+    filled = {int(i): 0 for i in meta}
+    for name in sorted(os.listdir(src)):
+        if not (name.startswith("shards.") and name.endswith(".json")):
+            continue
+        with open(os.path.join(src, name)) as f:
+            index = json.load(f)
+        shards = np.load(os.path.join(src, name[:-len("json")] + "npz"))
+        for entry in index:
+            i = int(entry["leaf"])
+            sl = tuple(slice(a, b) for a, b in entry["index"])
+            out[i][sl] = shards[entry["key"]]
+            filled[i] += int(np.prod([b - a for a, b in entry["index"]]))
+    for i, m in meta.items():
+        want = int(np.prod(m["shape"]))
+        if filled[int(i)] != want:
+            raise ValueError(
+                f"sharded leaf {i} has {filled[int(i)]} of {want} elements "
+                f"on disk under {src!r} — shard files from some writer "
+                "process are missing (incomplete or non-shared storage?)"
+            )
+    return out
 
 
 def latest_manifest(directory: str) -> Optional[dict]:

@@ -125,7 +125,7 @@ def main() -> int:
     np.testing.assert_allclose(gathered[0], gathered[1], rtol=0, atol=0)
     print(f"[{pid}] train + process-0 checkpoint ok")
 
-    # --- FSDP-sharded state: collective gather inside ckpt.save ---
+    # --- FSDP-sharded state: per-process shard writes (NO full gather) ---
     from ddp_practice_tpu.models import create_model
     from ddp_practice_tpu.parallel.fsdp import fsdp_rules
     from ddp_practice_tpu.parallel.mesh import shard_state
@@ -154,13 +154,31 @@ def main() -> int:
         "expected some FSDP leaves to span processes"
     ck2 = os.path.join(workdir, "ck_fsdp")
     ckpt.save(ck2, state, step=1)  # collective: all processes call
+    # per-process shard files on disk, manifest records the sharded leaves
+    step_dir = os.path.join(ck2, "step_1")
+    for p in range(nproc):
+        assert os.path.exists(
+            os.path.join(step_dir, f"shards.{p}.npz")
+        ), f"missing shard file for process {p}"
+    import json as _json
+
+    with open(os.path.join(step_dir, "manifest.json")) as f:
+        man2 = _json.load(f)
+    assert man2.get("sharded_leaves"), "manifest lists no sharded leaves"
     restored = ckpt.restore(ck2, abstract)
     ref = multihost_utils.process_allgather(big[0], tiled=True)
     leaves = jax.tree_util.tree_leaves(state.params)
     big_idx = next(i for i, l in enumerate(leaves) if l is big[0])
     got = np.asarray(jax.tree_util.tree_leaves(restored.params)[big_idx])
     np.testing.assert_allclose(got, np.asarray(ref))
-    print(f"[{pid}] fsdp sharded save/restore ok")
+    if pid == 0:
+        # evidence for the parent test's SINGLE-process restore of this
+        # multi-process checkpoint (test_multiprocess.py)
+        np.save(os.path.join(workdir, "ck_fsdp_expected.npy"),
+                np.asarray(ref))
+        with open(os.path.join(workdir, "ck_fsdp_leaf.json"), "w") as f:
+            _json.dump({"param_leaf_index": big_idx}, f)
+    print(f"[{pid}] fsdp sharded save/restore ok (no full-leaf gather)")
 
     # --- LM task multi-process: token shards, grad sync, perplexity ---
     cfg_lm = TrainConfig(

@@ -6,10 +6,13 @@ via `jax.distributed.initialize` and drive the code paths single-process
 runs can never reach — the multi-HOST story (VERDICT weak #5): coordinator
 rendezvous, `make_array_from_process_local_data` batches,
 `assert_in_sync`'s allgather both passing and firing, process-0-only
-checkpoint writes, and the collective FSDP leaf gather inside save.
+checkpoint writes, and the per-process FSDP shard-file save (no
+full-leaf gather — checkpoint/__init__.py).
 
-The scenarios live in tests/mp_worker.py; this parent only orchestrates
-processes and asserts their exit status + final ALL_OK line.
+The scenarios live in tests/mp_worker.py; this parent orchestrates
+processes, asserts their exit status + final ALL_OK line, and then
+restores the workers' multi-host FSDP checkpoint from a SINGLE process —
+the cross-world-size restore contract.
 """
 
 import os
@@ -70,3 +73,31 @@ def test_two_process_distributed(tmp_path):
     for i, (p, out) in enumerate(zip(procs, outputs)):
         assert p.returncode == 0, f"worker {i} rc={p.returncode}\n{out}"
         assert "ALL_OK" in out, f"worker {i} did not reach ALL_OK\n{out}"
+
+    # single-host restore of the workers' MULTI-host FSDP checkpoint: the
+    # shard files written by both processes reassemble in this one
+    import json
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ddp_practice_tpu import checkpoint as ckpt
+    from ddp_practice_tpu.config import TrainConfig
+    from ddp_practice_tpu.models import create_model
+    from ddp_practice_tpu.train import create_state, make_optimizer
+
+    model = create_model("convnet")
+    tx = make_optimizer(TrainConfig())
+    abstract = jax.eval_shape(
+        lambda r: create_state(
+            model, tx, rng=r, sample_input=jnp.zeros((4, 28, 28, 1))
+        ),
+        jax.random.PRNGKey(0),
+    )
+    restored = ckpt.restore(str(tmp_path / "ck_fsdp"), abstract)
+    expected = np.load(tmp_path / "ck_fsdp_expected.npy")
+    with open(tmp_path / "ck_fsdp_leaf.json") as f:
+        leaf_idx = json.load(f)["param_leaf_index"]
+    got = np.asarray(jax.tree_util.tree_leaves(restored.params)[leaf_idx])
+    np.testing.assert_allclose(got, expected)
