@@ -32,6 +32,13 @@ _SUITE = {
     "vit_tiny": dict(
         image_shape=(32, 32, 3), batch_size=1024, steps_per_call=32, calls=8,
     ),
+    # the same model through the fused Pallas encoder-layer kernels
+    # (ops/fused_encoder.py) — the HBM-bound small-d fix; BENCHMARKS.md
+    # "Why ViT-Tiny sat at ~17%"
+    "vit_tiny_fused": dict(
+        model="vit_tiny", image_shape=(32, 32, 3), batch_size=1024,
+        steps_per_call=32, calls=8, model_kwargs={"fused": True},
+    ),
     "vit_base": dict(
         # bs swept 96..512 on v5e (2026-07-30): 192 is the plateau top —
         # 54.9% MFU vs 48.0% at the earlier 256 default; throughput falls
@@ -94,8 +101,9 @@ _SUITE = {
 def main(argv=None) -> int:
     p = argparse.ArgumentParser("bench")
     p.add_argument("--models",
-                   default="vit_base,vit_tiny,convnet,resnet18,resnet50,"
-                           "lm_long,lm_moe,lm_decode,lm_decode_bs1",
+                   default="vit_base,vit_tiny,vit_tiny_fused,convnet,"
+                           "resnet18,resnet50,lm_long,lm_moe,lm_decode,"
+                           "lm_decode_bs1",
                    help="comma-separated; first successful is the headline")
     p.add_argument("--precision", default="bf16", choices=["fp32", "bf16"])
     p.add_argument("--batch_size", type=int, default=0, help="override")
@@ -141,7 +149,9 @@ def main(argv=None) -> int:
                 r["model"] = name
                 results.append(r)
             else:
-                results.append(bench_train(name, **kw))
+                r = bench_train(kw.pop("model", name), **kw)
+                r["model"] = name
+                results.append(r)
         except Exception:  # noqa: BLE001 — a failed model must not kill the line
             errors.append({"model": name, "error": traceback.format_exc(limit=3)})
 
@@ -212,16 +222,24 @@ def main(argv=None) -> int:
         "vs_baseline": vs_baseline,
         "vs_baseline_note": vs_note,
         "errors": errors,
-    })
+    }, partial=(
+        args.models != p.get_default("models")
+        or args.precision != p.get_default("precision")
+        or bool(args.batch_size or args.steps_per_call or args.calls)
+    ))
     print(json.dumps(line))
     return 0
 
 
-def _write_suite(suite: dict) -> None:
-    """Dump the full suite next to this file; never kill the stdout line."""
-    path = os.path.join(
-        os.path.dirname(os.path.abspath(__file__)), "BENCHMARKS.json"
-    )
+def _write_suite(suite: dict, *, partial: bool = False) -> None:
+    """Dump the full suite next to this file; never kill the stdout line.
+
+    Partial invocations (a custom --models subset) write to
+    BENCHMARKS.partial.json so they cannot clobber the recorded
+    default-suite results that BENCHMARKS.md cites.
+    """
+    name = "BENCHMARKS.partial.json" if partial else "BENCHMARKS.json"
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)), name)
     try:
         with open(path, "w") as f:
             json.dump(suite, f, indent=1)

@@ -143,6 +143,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="persistent XLA compilation cache dir (repeat runs "
                         "skip compile); auto = ~/.cache/ddp_practice_tpu/xla, "
                         "off = disable")
+    p.add_argument("--fused", action="store_true",
+                   help="run ViT encoder layers as fused Pallas kernels "
+                        "(ops/fused_encoder.py — the small-d HBM-bound fix)")
     p.add_argument("--augment", action="store_true",
                    help="on-device random crop + horizontal flip inside the "
                         "jitted train step (image models; deterministic per "
@@ -184,6 +187,7 @@ def config_from_args(args) -> TrainConfig:
         num_microbatches=args.microbatches,
         pipe_schedule=args.pipe_schedule,
         augment=args.augment,
+        fused_encoder=args.fused,
         num_experts=args.num_experts,
         num_heads=args.num_heads,
         coordinator_address=args.coordinator,

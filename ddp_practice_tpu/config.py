@@ -199,6 +199,8 @@ class TrainConfig:
     # on-device input augmentation (random crop + horizontal flip inside
     # the jitted train step, ops/augment.py); image models only
     augment: bool = False
+    # ViT encoder layers as fused Pallas kernels (ops/fused_encoder.py)
+    fused_encoder: bool = False
     # MoE expert count when mesh.expert > 1 (0 = auto: 8 rounded up to a
     # multiple of the expert axis)
     num_experts: int = 0

@@ -230,6 +230,14 @@ class EncoderBlock(nn.Module):
     num_experts: int = 8
     moe_top_k: int = 2
     capacity_factor: float = 1.25
+    # run the whole layer as ONE Pallas kernel per direction
+    # (ops/fused_encoder.py): the HBM-bound small-d regime's fix
+    # (BENCHMARKS.md ViT-Tiny analysis). Short-sequence bidirectional
+    # blocks only; the default backward is the hand-derived Pallas
+    # kernel, pinned against unfused autodiff at 2e-4 tolerance in
+    # tests/test_fused_encoder.py (bwd_impl="reference" gives the
+    # bit-exact unfused gradients instead).
+    fused: bool = False
 
     @nn.compact
     def __call__(self, x, decode: bool = False, train: bool = False, *,
@@ -238,6 +246,31 @@ class EncoderBlock(nn.Module):
         # this module in nn.remat(static_argnums=(2, 3)), and jax.checkpoint
         # only accepts non-array arguments at static positions. attn_start
         # (an array) is decode-only, where remat never applies.
+        if self.fused and not self.is_initializing():
+            if (decode or self.causal or self.rope
+                    or self.seq_axis is not None
+                    or self.use_moe or self.dropout_rate > 0.0
+                    or self.attn_impl != "xla"):
+                raise ValueError(
+                    "fused encoder layer supports the plain bidirectional "
+                    "block only (no decode/causal/rope/seq-parallel/MoE/"
+                    "dropout/attn_impl override) — those paths keep the "
+                    "per-op pipeline"
+                )
+            from ddp_practice_tpu.ops.fused_encoder import (
+                fused_encoder_layer,
+            )
+
+            ref = EncoderBlock(
+                self.num_heads, self.mlp_dim, dtype=self.dtype,
+                param_dtype=self.param_dtype,
+            )
+            return fused_encoder_layer(
+                x, self.variables["params"],
+                num_heads=self.num_heads,
+                reference_apply=lambda pp, xx: ref.apply({"params": pp}, xx),
+                compute_dtype=self.dtype,
+            )
         y = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype, name="ln1")(x)
         y = SelfAttention(
             self.num_heads,
@@ -291,6 +324,7 @@ class ViT(nn.Module):
     sp_impl: str = "ring"
     attn_impl: str = "xla"
     dropout_rate: float = 0.0       # residual-branch dropout in every block
+    fused: bool = False             # one-Pallas-kernel layers (small-d fix)
     axis_name: Optional[str] = None  # accepted for registry uniformity (no BN)
 
     @nn.compact
@@ -312,6 +346,7 @@ class ViT(nn.Module):
                 sp_impl=self.sp_impl,
                 attn_impl=self.attn_impl,
                 dropout_rate=self.dropout_rate,
+                fused=self.fused,
                 name=f"block{i}",
             )(x, train=train)
         return ViTHead(

@@ -1,0 +1,471 @@
+"""Fused transformer encoder layer as one Pallas TPU kernel (forward).
+
+Why this exists (BENCHMARKS.md "Why ViT-Tiny sits at ~17%"): at d=192 the
+per-op XLA pipeline is HBM-bound — every matmul in the layer reads and
+writes (tokens, d)-shaped tensors to HBM at intensity ~77 FLOP/byte, well
+under the v5e ridge (~240). Fusing the WHOLE layer — LN1 → QKV →
+attention → proj + residual → LN2 → MLP + residual — into one kernel
+reads the token tensor from HBM once and writes it once; every
+intermediate lives in VMEM, lifting intrinsic intensity to ~600 FLOP/byte
+(compute-bound). The reference consumes the CUDA analogue of this idea
+through cuDNN's fused blocks (SURVEY §2.2); on TPU it has to be a Pallas
+kernel because XLA will not fuse across matmuls.
+
+Shape contract: short fixed sequences that fit VMEM whole (the ViT
+regime: S = 64 tokens at 32²/patch 4). The grid tiles the BATCH — each
+cell processes `img_tile` images; weights (~0.7 MB at d=192) are
+broadcast to every cell and stay VMEM-resident. Long-sequence models keep
+the streaming flash-attention kernels (ops/flash_attention.py) instead —
+different regime, different kernel.
+
+Backward: also one Pallas kernel (`jax.custom_vjp`; residuals are just
+(x, params) — remat semantics, O(x) training memory). Each backward grid
+cell RECOMPUTES its tile's forward intermediates in VMEM (LN stats,
+attention probabilities, gelu pre-activations — one extra forward's
+FLOPs at fused-kernel efficiency, far cheaper than reading them from
+HBM at d=192 intensity) and then runs the hand-derived transposes in
+VMEM too. Weight gradients accumulate across grid cells directly in the
+revisited output blocks (every cell maps its dW block to (0, 0); the
+TPU grid is sequential, so the block lives in VMEM for the whole sweep
+and flushes once). A `reference_apply` unfused backward is kept as an
+option (`bwd_impl="reference"`) and is what the numerics tests compare
+against.
+
+Runs compiled on TPU; `interpret=True` under the CPU backend so the same
+tests cover it everywhere (the flash-attention pattern).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_LN_EPS = 1e-6  # flax.linen.LayerNorm default
+
+
+def _interpret() -> bool:
+    return jax.default_backend() == "cpu"
+
+
+def _layer_norm(xt, scale, bias):
+    """fp32 LayerNorm over the last dim -> (affine out, normalized, rstd)."""
+    mu = jnp.mean(xt, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(xt - mu), axis=-1, keepdims=True)
+    r = jax.lax.rsqrt(var + _LN_EPS)
+    yhat = (xt - mu) * r
+    return yhat * scale + bias, yhat, r
+
+
+def _layer_norm_bwd(dya, yhat, r, scale):
+    """Cotangent of the LN input given the affine output's; plus the
+    scale/bias grads. dya/yhat: (t, d); r: (t, 1)."""
+    dscale = jnp.sum(dya * yhat, axis=0, keepdims=True)
+    dbias = jnp.sum(dya, axis=0, keepdims=True)
+    dxhat = dya * scale
+    m1 = jnp.mean(dxhat, axis=-1, keepdims=True)
+    m2 = jnp.mean(dxhat * yhat, axis=-1, keepdims=True)
+    dx = r * (dxhat - m1 - yhat * m2)
+    return dx, dscale, dbias
+
+
+_GELU_C = 0.7978845608028654  # sqrt(2/pi)
+_GELU_A = 0.044715
+
+
+def _gelu_grad(x, t):
+    """d gelu(x)/dx given t = tanh(c(x + a x^3)) (tanh approximation —
+    what flax nn.gelu computes)."""
+    return 0.5 * (1.0 + t) + 0.5 * x * (1.0 - t * t) * _GELU_C * (
+        1.0 + 3.0 * _GELU_A * x * x
+    )
+
+
+def _mm(a, w, cd):
+    return jax.lax.dot(a.astype(cd), w.astype(cd),
+                       preferred_element_type=jnp.float32)
+
+
+def _bdot(a, b, contract_a, contract_b, cd):
+    """Batched (leading-dim) dot in the compute dtype, fp32 accumulate."""
+    return jax.lax.dot_general(
+        a.astype(cd), b.astype(cd),
+        (((contract_a,), (contract_b,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def _fwd_core(xt, imgs, s, ln1_s, ln1_b, wqkv, bqkv, wproj, bproj,
+              ln2_s, ln2_b, w_in, b_in, w_out, b_out,
+              *, num_heads, head_dim, compute_dtype):
+    """The whole layer on a (t, d) fp32 token tile; returns every
+    intermediate the backward needs (the fwd kernel uses `out` only and
+    the compiler drops the rest).
+
+    Attention runs per head in a Python loop (heads are few at small d)
+    with images as the dot_general batch dim: Mosaic has no 4D head
+    transpose, but 64-aligned column slices + major-dim reshapes lower
+    cleanly. Head outputs accumulate straight into the projection so no
+    concat materializes. Matmuls take compute-dtype (bf16) operands with
+    fp32 accumulation — the MXU contract, matching the unfused policy;
+    LN/softmax/residual math runs in fp32.
+    """
+    cd = compute_dtype
+    f32 = jnp.float32
+    t, d = xt.shape
+    h, hd = num_heads, head_dim
+    y1a, y1hat, r1 = _layer_norm(xt, ln1_s, ln1_b)
+    qkv = _mm(y1a, wqkv, cd) + bqkv                   # (t, 3*h*hd)
+    scale = 1.0 / (hd ** 0.5)
+    proj_acc = jnp.zeros((t, d), f32)
+    heads = []
+    for hi in range(h):
+        def head_slice(base):
+            col = base + hi * hd
+            return qkv[:, col: col + hd].reshape(imgs, s, hd)
+
+        q = head_slice(0)
+        k = head_slice(h * hd)
+        v = head_slice(2 * h * hd)
+        scores = _bdot(q, k, 2, 2, cd) * scale        # (imgs, s, s)
+        scores = scores - jnp.max(scores, axis=-1, keepdims=True)
+        p = jnp.exp(scores)
+        p = p / jnp.sum(p, axis=-1, keepdims=True)
+        o = _bdot(p, v, 2, 1, cd)                     # (imgs, s, hd)
+        proj_acc = proj_acc + _mm(
+            o.reshape(t, hd), wproj[hi * hd: (hi + 1) * hd, :], cd
+        )
+        heads.append((q, k, v, p, o))
+    x2 = xt + proj_acc + bproj
+    y2a, y2hat, r2 = _layer_norm(x2, ln2_s, ln2_b)
+    hpre = _mm(y2a, w_in, cd) + b_in                  # (t, mlp)
+    tanh = jnp.tanh(_GELU_C * (hpre + _GELU_A * hpre * hpre * hpre))
+    hg = 0.5 * hpre * (1.0 + tanh)
+    out = x2 + _mm(hg, w_out, cd) + b_out
+    return dict(
+        y1a=y1a, y1hat=y1hat, r1=r1, qkv=qkv, heads=heads, x2=x2,
+        y2a=y2a, y2hat=y2hat, r2=r2, hpre=hpre, tanh=tanh, hg=hg, out=out,
+    )
+
+
+def _weights_f32(ln1_s, ln1_b, wqkv, bqkv, wproj, bproj, ln2_s, ln2_b,
+                 w_in, b_in, w_out, b_out):
+    f32 = jnp.float32
+    return (
+        ln1_s[0].astype(f32), ln1_b[0].astype(f32), wqkv[:], bqkv[0]
+        .astype(f32), wproj[:], bproj[0].astype(f32), ln2_s[0].astype(f32),
+        ln2_b[0].astype(f32), w_in[:], b_in[0].astype(f32), w_out[:],
+        b_out[0].astype(f32),
+    )
+
+
+def _fused_kernel(
+    x_ref, ln1_s, ln1_b, wqkv, bqkv, wproj, bproj, ln2_s, ln2_b,
+    w_in, b_in, w_out, b_out, o_ref,
+    *, num_heads, head_dim, compute_dtype,
+):
+    """Forward grid cell: the full encoder layer for `img_tile` images."""
+    imgs, s, d = x_ref.shape
+    xt = x_ref[:].astype(jnp.float32).reshape(imgs * s, d)
+    core = _fwd_core(
+        xt, imgs, s,
+        *_weights_f32(ln1_s, ln1_b, wqkv, bqkv, wproj, bproj, ln2_s,
+                      ln2_b, w_in, b_in, w_out, b_out),
+        num_heads=num_heads, head_dim=head_dim, compute_dtype=compute_dtype,
+    )
+    o_ref[:] = core["out"].reshape(imgs, s, d).astype(o_ref.dtype)
+
+
+def _fused_bwd_kernel(
+    x_ref, g_ref, ln1_s, ln1_b, wqkv, bqkv, wproj, bproj, ln2_s, ln2_b,
+    w_in, b_in, w_out, b_out,
+    dx_ref, dln1_s, dln1_b, dwqkv, dbqkv, dwproj, dbproj, dln2_s, dln2_b,
+    dw_in, db_in, dw_out, db_out,
+    *, num_heads, head_dim, compute_dtype,
+):
+    """Backward grid cell: recompute the tile's forward in VMEM, then the
+    hand-derived transposes. Weight-gradient outputs map every cell to
+    block (0, 0): the TPU grid is sequential and Pallas keeps revisited
+    output blocks in VMEM, so `ref[:] += ...` accumulates across the
+    whole sweep and flushes once at the end (`@pl.when(cell 0)` zeroes)."""
+    cd = compute_dtype
+    f32 = jnp.float32
+    imgs, s, d = x_ref.shape
+    h, hd = num_heads, head_dim
+    t = imgs * s
+    xt = x_ref[:].astype(f32).reshape(t, d)
+    g = g_ref[:].astype(f32).reshape(t, d)
+    ws = _weights_f32(ln1_s, ln1_b, wqkv, bqkv, wproj, bproj, ln2_s,
+                      ln2_b, w_in, b_in, w_out, b_out)
+    (l1s, l1b, Wqkv, Bqkv, Wproj, Bproj, l2s, l2b,
+     Win, Bin, Wout, Bout) = ws
+    core = _fwd_core(
+        xt, imgs, s, *ws,
+        num_heads=num_heads, head_dim=head_dim, compute_dtype=cd,
+    )
+
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        for ref in (dln1_s, dln1_b, dwqkv, dbqkv, dwproj, dbproj, dln2_s,
+                    dln2_b, dw_in, db_in, dw_out, db_out):
+            ref[:] = jnp.zeros(ref.shape, ref.dtype)
+
+    def mmT_left(a, b):
+        # a^T @ b without materializing the transpose: contract dim 0
+        return jax.lax.dot_general(
+            a.astype(cd), b.astype(cd), (((0,), (0,)), ((), ())),
+            preferred_element_type=f32,
+        )
+
+    def mmT_right(a, w):
+        # a @ w^T: contract both dim 1
+        return jax.lax.dot_general(
+            a.astype(cd), w.astype(cd), (((1,), (1,)), ((), ())),
+            preferred_element_type=f32,
+        )
+
+    # ---- MLP branch (out = x2 + hg @ Wout + Bout)
+    dw_out[:] += mmT_left(core["hg"], g)
+    db_out[:] += jnp.sum(g, axis=0, keepdims=True)
+    dhg = mmT_right(g, Wout)                          # (t, mlp)
+    dhpre = dhg * _gelu_grad(core["hpre"], core["tanh"])
+    dw_in[:] += mmT_left(core["y2a"], dhpre)
+    db_in[:] += jnp.sum(dhpre, axis=0, keepdims=True)
+    dy2a = mmT_right(dhpre, Win)                      # (t, d)
+    dx2_ln, ds2, db2 = _layer_norm_bwd(dy2a, core["y2hat"], core["r2"], l2s)
+    dln2_s[:] += ds2
+    dln2_b[:] += db2
+    dx2 = g + dx2_ln
+
+    # ---- attention branch (x2 = xt + sum_h o_h @ Wproj_h + Bproj)
+    dbproj[:] += jnp.sum(dx2, axis=0, keepdims=True)
+    scale = 1.0 / (hd ** 0.5)
+    dqkv_cols = []
+    for hi, (q, k, v, p, o) in enumerate(core["heads"]):
+        Wp_h = Wproj[hi * hd: (hi + 1) * hd, :]
+        dwproj[hi * hd: (hi + 1) * hd, :] += mmT_left(o.reshape(t, hd), dx2)
+        do = mmT_right(dx2, Wp_h).reshape(imgs, s, hd)
+        dp = _bdot(do, v, 2, 2, cd)                   # (imgs, s, s)
+        dv = _bdot(p, do, 1, 1, cd)                   # (imgs, s, hd)
+        dsc = p * (dp - jnp.sum(dp * p, axis=-1, keepdims=True))
+        dsc = dsc * scale
+        dq = _bdot(dsc, k, 2, 1, cd)                  # (imgs, s, hd)
+        dk = _bdot(dsc, q, 1, 1, cd)                  # (imgs, s, hd)
+        dqkv_cols.append((dq.reshape(t, hd), dk.reshape(t, hd),
+                          dv.reshape(t, hd)))
+    # columns in qkv order: all q heads, all k heads, all v heads
+    dqkv = jnp.concatenate(
+        [c[0] for c in dqkv_cols] + [c[1] for c in dqkv_cols]
+        + [c[2] for c in dqkv_cols], axis=1,
+    )                                                  # (t, 3*h*hd)
+    dwqkv[:] += mmT_left(core["y1a"], dqkv)
+    dbqkv[:] += jnp.sum(dqkv, axis=0, keepdims=True)
+    dy1a = mmT_right(dqkv, Wqkv)
+    dx1_ln, ds1, db1 = _layer_norm_bwd(dy1a, core["y1hat"], core["r1"], l1s)
+    dln1_s[:] += ds1
+    dln1_b[:] += db1
+    dx = dx2 + dx1_ln
+    dx_ref[:] = dx.reshape(imgs, s, d).astype(dx_ref.dtype)
+
+
+def _fit_tile(n, tile):
+    tile = min(tile, n)
+    while n % tile:
+        tile -= 1
+    return max(tile, 1)
+
+
+def _auto_tile(imgs, s, compute_dtype, *, fwd: bool):
+    """Default images-per-cell honoring the 16 MB scoped-VMEM budget.
+
+    Calibrated on v5e at d=192/mlp 768: the forward fits 2048 bf16-compute
+    tokens per cell (tile 32 at s=64 — the bench shape), the backward 256
+    (~3x the live intermediates); fp32 compute doubles the matmul operand
+    copies, so halve the token budget. Sequence length scales the token
+    count per image, hence the division.
+    """
+    bytes_ = jnp.dtype(compute_dtype).itemsize
+    tokens = (2048 if fwd else 256) * 2 // max(bytes_, 2)
+    return max(1, tokens // s)
+
+
+def _check_vmem_residency(d, mlp_dim, compute_dtype):
+    """The kernel keeps ALL weights VMEM-resident; past ~8 MB of weights
+    there is no room left for a useful tile. Fail loudly — this is the
+    small-d kernel (d=192-class); wide models are compute-bound under
+    per-op XLA anyway (BENCHMARKS.md: ViT-Base trains at ~55% unfused)."""
+    w_bytes = (d * 3 * d + d * d + 2 * d * mlp_dim) * jnp.dtype(
+        compute_dtype
+    ).itemsize
+    if w_bytes > 8 * 1024 * 1024:
+        raise ValueError(
+            f"fused encoder layer: weights at d={d}, mlp={mlp_dim} need "
+            f"{w_bytes / 2**20:.1f} MB of VMEM residency — over the "
+            "budget. This kernel targets the small-d HBM-bound regime; "
+            "use the per-op path for wide models"
+        )
+
+
+def _prep(x, params, num_heads, img_tile, compute_dtype):
+    """(dims, weight mats, weight specs) shared by the fwd/bwd wrappers."""
+    imgs, s, d = x.shape
+    if d % num_heads:
+        raise ValueError(f"d={d} % heads={num_heads}")
+    tile = _fit_tile(imgs, img_tile)
+    cd = compute_dtype
+
+    def w2(a, shape):
+        return jnp.asarray(a).reshape(shape).astype(cd)
+
+    attn, mlp = params["attn"], params["mlp"]
+    mats = [
+        w2(params["ln1"]["scale"], (1, d)), w2(params["ln1"]["bias"], (1, d)),
+        w2(attn["qkv"]["kernel"], (d, 3 * d)),
+        w2(attn["qkv"]["bias"], (1, 3 * d)),
+        w2(attn["out"]["kernel"], (d, d)), w2(attn["out"]["bias"], (1, d)),
+        w2(params["ln2"]["scale"], (1, d)), w2(params["ln2"]["bias"], (1, d)),
+        w2(mlp["fc_in"]["kernel"], (d, -1)), w2(mlp["fc_in"]["bias"], (1, -1)),
+        w2(mlp["fc_out"]["kernel"], (-1, d)), w2(mlp["fc_out"]["bias"], (1, d)),
+    ]
+    _check_vmem_residency(d, mats[8].shape[1], compute_dtype)
+    full = lambda shape: pl.BlockSpec(shape, lambda i: (0,) * len(shape))
+    w_specs = [full(tuple(m.shape)) for m in mats]
+    return imgs, s, d, tile, mats, w_specs
+
+
+def fused_encoder_forward(
+    x, params, *, num_heads: int, compute_dtype=jnp.bfloat16,
+    img_tile: int = 0, interpret=None,
+):
+    """Pallas forward of one encoder layer. x: (imgs, s, d); params: the
+    flax EncoderBlock param subtree (ln1/attn/ln2/mlp). img_tile 0 =
+    auto (VMEM-budget-aware, _auto_tile)."""
+    if interpret is None:
+        interpret = _interpret()
+    img_tile = img_tile or _auto_tile(
+        x.shape[0], x.shape[1], compute_dtype, fwd=True
+    )
+    imgs, s, d, tile, mats, w_specs = _prep(
+        x, params, num_heads, img_tile, compute_dtype
+    )
+    kernel = functools.partial(
+        _fused_kernel, num_heads=num_heads, head_dim=d // num_heads,
+        compute_dtype=compute_dtype,
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=(imgs // tile,),
+        in_specs=[pl.BlockSpec((tile, s, d), lambda i: (i, 0, 0))] + w_specs,
+        out_specs=pl.BlockSpec((tile, s, d), lambda i: (i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        interpret=interpret,
+    )(x, *mats)
+
+
+def fused_encoder_backward(
+    x, g, params, *, num_heads: int, compute_dtype=jnp.bfloat16,
+    img_tile: int = 4, interpret=None,
+):
+    # smaller default tile than the forward: the backward holds ~3x the
+    # live intermediates (recompute + cotangents), and tile 8 blows the
+    # 16 MB VMEM budget at mlp_dim 768
+    """Pallas backward: (dx, dparams-tree). Recompute + transpose per grid
+    cell; weight grads accumulate across cells in revisited fp32 blocks."""
+    if interpret is None:
+        interpret = _interpret()
+    imgs, s, d, tile, mats, w_specs = _prep(
+        x, params, num_heads, img_tile, compute_dtype
+    )
+    mlp_dim = mats[8].shape[1]
+    f32 = jnp.float32
+    kernel = functools.partial(
+        _fused_bwd_kernel, num_heads=num_heads, head_dim=d // num_heads,
+        compute_dtype=compute_dtype,
+    )
+    full = lambda shape: pl.BlockSpec(shape, lambda i: (0,) * len(shape))
+    dw_shapes = [
+        (1, d), (1, d), (d, 3 * d), (1, 3 * d), (d, d), (1, d),
+        (1, d), (1, d), (d, mlp_dim), (1, mlp_dim), (mlp_dim, d), (1, d),
+    ]
+    x_spec = pl.BlockSpec((tile, s, d), lambda i: (i, 0, 0))
+    outs = pl.pallas_call(
+        kernel,
+        grid=(imgs // tile,),
+        in_specs=[x_spec, x_spec] + w_specs,
+        out_specs=[x_spec] + [full(sh) for sh in dw_shapes],
+        out_shape=[jax.ShapeDtypeStruct(x.shape, x.dtype)]
+        + [jax.ShapeDtypeStruct(sh, f32) for sh in dw_shapes],
+        interpret=interpret,
+    )(x, g.astype(x.dtype), *mats)
+    dx = outs[0]
+    (dl1s, dl1b, dwqkv, dbqkv, dwproj, dbproj, dl2s, dl2b,
+     dwin, dbin, dwout, dbout) = outs[1:]
+
+    def like(mat, leaf):
+        return mat.reshape(jnp.shape(leaf)).astype(jnp.asarray(leaf).dtype)
+
+    attn, mlp = params["attn"], params["mlp"]
+    dparams: dict = {
+        "ln1": {"scale": like(dl1s, params["ln1"]["scale"]),
+                "bias": like(dl1b, params["ln1"]["bias"])},
+        "attn": {
+            "qkv": {"kernel": like(dwqkv, attn["qkv"]["kernel"]),
+                    "bias": like(dbqkv, attn["qkv"]["bias"])},
+            "out": {"kernel": like(dwproj, attn["out"]["kernel"]),
+                    "bias": like(dbproj, attn["out"]["bias"])},
+        },
+        "ln2": {"scale": like(dl2s, params["ln2"]["scale"]),
+                "bias": like(dl2b, params["ln2"]["bias"])},
+        "mlp": {
+            "fc_in": {"kernel": like(dwin, mlp["fc_in"]["kernel"]),
+                      "bias": like(dbin, mlp["fc_in"]["bias"])},
+            "fc_out": {"kernel": like(dwout, mlp["fc_out"]["kernel"]),
+                       "bias": like(dbout, mlp["fc_out"]["bias"])},
+        },
+    }
+    if hasattr(params, "unfreeze"):  # match a FrozenDict input's structure
+        from flax.core import freeze
+
+        dparams = freeze(dparams)
+    return dx, dparams
+
+
+def fused_encoder_layer(x, params, *, num_heads: int, reference_apply,
+                        compute_dtype=jnp.bfloat16, img_tile: int = 0,
+                        bwd_impl: str = "kernel"):
+    """Differentiable fused layer: Pallas forward AND backward.
+
+    Residuals are just (x, params) — remat semantics. bwd_impl="kernel"
+    (default) runs the fused Pallas backward; "reference" recomputes
+    `reference_apply(params, x)` under jax.vjp instead — the unfused flax
+    block, bit-exact unfused gradients, used by the numerics tests as the
+    ground truth the kernel is pinned against. `img_tile` tunes the
+    FORWARD only; the backward always auto-sizes (its VMEM budget is ~3x
+    tighter — _auto_tile).
+    """
+    if bwd_impl not in ("kernel", "reference"):
+        raise ValueError(f"bwd_impl {bwd_impl!r} (kernel|reference)")
+
+    @jax.custom_vjp
+    def layer(x, p):
+        return fused_encoder_forward(
+            x, p, num_heads=num_heads, compute_dtype=compute_dtype,
+            img_tile=img_tile,
+        )
+
+    def fwd(x, p):
+        return layer(x, p), (x, p)
+
+    def bwd(res, g):
+        x, p = res
+        if bwd_impl == "kernel":
+            return fused_encoder_backward(
+                x, g, p, num_heads=num_heads, compute_dtype=compute_dtype,
+            )
+        _, vjp = jax.vjp(lambda xx, pp: reference_apply(pp, xx), x, p)
+        return vjp(g)
+
+    layer.defvjp(fwd, bwd)
+    return layer(x, params)

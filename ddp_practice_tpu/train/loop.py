@@ -160,6 +160,15 @@ class Trainer:
             # only attention models accept this; a conv model raises loudly
             # rather than silently ignoring the requested kernel
             model_kwargs["attn_impl"] = config.attn_impl
+        if config.fused_encoder:
+            if config.model != "vit_tiny":
+                raise ValueError(
+                    "--fused is the small-d ViT fused encoder-layer kernel "
+                    "(ops/fused_encoder.py, vit_tiny); wide/LM/conv/"
+                    "pipelined/MoE models keep their own paths — ViT-Base "
+                    "is compute-bound unfused (BENCHMARKS.md)"
+                )
+            model_kwargs["fused"] = True
         if config.pipe_schedule != "gpipe":
             # same fail-loudly convention as the other pipeline flags: a
             # schedule request on a pipe-less mesh, or for a model family
