@@ -261,14 +261,9 @@ class EncoderBlock(nn.Module):
                 fused_encoder_layer,
             )
 
-            ref = EncoderBlock(
-                self.num_heads, self.mlp_dim, dtype=self.dtype,
-                param_dtype=self.param_dtype,
-            )
             return fused_encoder_layer(
                 x, self.variables["params"],
                 num_heads=self.num_heads,
-                reference_apply=lambda pp, xx: ref.apply({"params": pp}, xx),
                 compute_dtype=self.dtype,
             )
         y = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype, name="ln1")(x)

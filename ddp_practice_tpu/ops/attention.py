@@ -71,6 +71,19 @@ def attention_with_mask(q, k, v, mask) -> jnp.ndarray:
     """
     if mask.ndim == 2:
         mask = mask[None, None]
+    if q.shape[1] == 1 and q.shape[0] <= 16:
+        # small-batch single-token decode steps: a 1-row query makes both
+        # attention contractions matvecs, which XLA lowers to VPU
+        # multiply-reduce loop fusions at ~1/5 of HBM bandwidth — 81% of
+        # the decode step in the bs=8 profile (BENCHMARKS.md).
+        # Broadcasting the query to 8 rows (the sublane width) turns them
+        # into real MXU matmuls; rows 1-7 compute the identical result
+        # and are discarded — FLOPs are free in a bandwidth-bound step.
+        # Gated to b <= 16: at larger batches the batch dim already feeds
+        # the vector units and the 8x score/prob tensors cost more than
+        # the matvec saves (measured 2x SLOWER at bs 64).
+        q8 = jnp.broadcast_to(q, (q.shape[0], 8) + q.shape[2:])
+        return _attention(q8, k, v, causal=False, mask=mask)[:, :1]
     return _attention(q, k, v, causal=False, mask=mask)
 
 

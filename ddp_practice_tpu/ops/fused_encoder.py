@@ -366,15 +366,17 @@ def fused_encoder_forward(
 
 def fused_encoder_backward(
     x, g, params, *, num_heads: int, compute_dtype=jnp.bfloat16,
-    img_tile: int = 4, interpret=None,
+    img_tile: int = 0, interpret=None,
 ):
-    # smaller default tile than the forward: the backward holds ~3x the
-    # live intermediates (recompute + cotangents), and tile 8 blows the
-    # 16 MB VMEM budget at mlp_dim 768
     """Pallas backward: (dx, dparams-tree). Recompute + transpose per grid
-    cell; weight grads accumulate across cells in revisited fp32 blocks."""
+    cell; weight grads accumulate across cells in revisited fp32 blocks.
+    img_tile 0 = auto — a much tighter budget than the forward's (the
+    backward holds ~3x the live intermediates; see _auto_tile)."""
     if interpret is None:
         interpret = _interpret()
+    img_tile = img_tile or _auto_tile(
+        x.shape[0], x.shape[1], compute_dtype, fwd=False
+    )
     imgs, s, d, tile, mats, w_specs = _prep(
         x, params, num_heads, img_tile, compute_dtype
     )
@@ -432,7 +434,7 @@ def fused_encoder_backward(
     return dx, dparams
 
 
-def fused_encoder_layer(x, params, *, num_heads: int, reference_apply,
+def fused_encoder_layer(x, params, *, num_heads: int, reference_apply=None,
                         compute_dtype=jnp.bfloat16, img_tile: int = 0,
                         bwd_impl: str = "kernel"):
     """Differentiable fused layer: Pallas forward AND backward.
@@ -447,6 +449,8 @@ def fused_encoder_layer(x, params, *, num_heads: int, reference_apply,
     """
     if bwd_impl not in ("kernel", "reference"):
         raise ValueError(f"bwd_impl {bwd_impl!r} (kernel|reference)")
+    if bwd_impl == "reference" and reference_apply is None:
+        raise ValueError("bwd_impl='reference' needs reference_apply")
 
     @jax.custom_vjp
     def layer(x, p):
