@@ -1,0 +1,211 @@
+"""XProf trace analysis without TensorBoard: per-op device-time summaries.
+
+`utils/profiling.py` captures traces (`--profile_dir`); this module reads
+them back. The usual consumer is TensorBoard's profile plugin, but this
+build environment has no xplane proto bindings (tensorboard_plugin_profile
+ships without xplane_pb2 here) and no browser — so this parses the
+`.xplane.pb` by structure instead: `protoc --decode_raw` (protoc is in
+the image) emits a field-number tree, and the XPlane schema is stable
+enough to read by field ids. The fields used (verified against traces
+from this JAX/libtpu build):
+
+    XSpace.planes = 1;  XPlane.name = 2, .lines = 3,
+    .event_metadata = 4 (map entry: key=1, value=2 {id=1, name=2,
+    stats=5}), .stat_metadata = 5;  XLine.events = 4;
+    XEvent.metadata_id = 1, .stats = 4 (XStat.metadata_id = 1,
+    int64=3, uint64=4, str ref=5)
+    stat-metadata names: 2=device_duration_ps, 24=hlo_category,
+    27=flops, 31=bytes_accessed (ids resolved by NAME, not hardcoded)
+
+Every ResNet-50 / decode / LM profile analysis in BENCHMARKS.md came out
+of this parser (the per-category table: device ms, share, achieved
+bytes/s from XLA's bytes_accessed).
+
+CLI:  python -m ddp_practice_tpu.utils.xprof <trace_dir_or_xplane.pb>
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import re
+import subprocess
+import sys
+from typing import Optional
+
+_BLOCK_RE = re.compile(r"(\d+) \{$")
+_FIELD_RE = re.compile(r"(\d+): (.*)$")
+
+
+def _parse_decoded(text: str):
+    """decode_raw output -> nested {field_number: [value_or_subdict]}."""
+    lines = text.splitlines()
+    n = len(lines)
+
+    def block(i):
+        fields = collections.defaultdict(list)
+        while i < n:
+            s = lines[i].strip()
+            if s == "}":
+                return fields, i + 1
+            m = _BLOCK_RE.match(s)
+            if m:
+                sub, i = block(i + 1)
+                fields[int(m.group(1))].append(sub)
+                continue
+            m = _FIELD_RE.match(s)
+            if m:
+                fields[int(m.group(1))].append(m.group(2))
+                i += 1
+                continue
+            i += 1
+        return fields, i
+
+    i = 0
+    planes = []
+    while i < n:
+        if lines[i].strip() == "1 {":
+            blk, i = block(i + 1)
+            planes.append(blk)
+        else:
+            i += 1
+    return planes
+
+
+def _find_xplane(path: str) -> str:
+    if os.path.isfile(path):
+        return path
+    hits = []
+    for root, _, files in os.walk(path):
+        hits += [os.path.join(root, f) for f in files
+                 if f.endswith(".xplane.pb")]
+    if not hits:
+        raise FileNotFoundError(f"no .xplane.pb under {path!r}")
+    return max(hits, key=os.path.getmtime)  # newest capture
+
+
+def op_summary(path: str, *, device_substr: str = "TPU",
+               line_substr: str = "XLA Ops") -> dict:
+    """Aggregate a trace: device time/bytes per HLO category and per op.
+
+    Returns {"total_ps", "categories": {cat: {"ps", "count", "bytes"}},
+    "ops": {(cat, name): ps}}. `ps` are device picoseconds summed over
+    every captured execution (divide by your step count for ms/step).
+    """
+    xplane = _find_xplane(path)
+    with open(xplane, "rb") as f:
+        decoded = subprocess.run(
+            ["protoc", "--decode_raw"],
+            stdin=f,
+            capture_output=True,
+            check=True,
+        ).stdout.decode("utf-8", errors="replace")
+    planes = _parse_decoded(decoded)
+
+    def text(v):
+        # decode_raw heuristically prints some short strings as nested
+        # messages; anything non-string becomes a best-effort repr
+        return v.strip('"') if isinstance(v, str) else str(v)
+
+    cats: dict = collections.defaultdict(
+        lambda: {"ps": 0, "count": 0, "bytes": 0}
+    )
+    ops = collections.Counter()
+    matched = 0
+    for p in planes:
+        if device_substr not in text(p.get(2, ["?"])[0]):
+            continue
+        # stat-metadata ids resolved by name (ids vary across builds)
+        sid = {}
+        for m in p.get(5, []):
+            sub = m.get(2, [None])[0]
+            if isinstance(sub, dict):
+                sid[text(sub.get(2, ["?"])[0])] = str(m.get(1, ["?"])[0])
+        id_dur = sid.get("device_duration_ps")
+        id_cat = sid.get("hlo_category")
+        id_bytes = sid.get("bytes_accessed")
+        if id_dur is None:
+            raise ValueError(
+                f"plane {text(p.get(2, ['?'])[0])!r} has no "
+                "device_duration_ps stat metadata — xplane schema drift? "
+                f"(known stats: {sorted(sid)[:12]})"
+            )
+        emeta = {}
+        for m in p.get(4, []):
+            sub = m.get(2, [None])[0]
+            if not isinstance(sub, dict):
+                continue
+            nm = text(sub.get(2, ["?"])[0])
+            cat, bts = "?", 0
+            for st in sub.get(5, []):
+                s_id = st.get(1, ["?"])[0]
+                if s_id == id_cat:
+                    cat = text(st.get(5, ['"?"'])[0])
+                elif s_id == id_bytes:
+                    bts = int(st.get(4, ["0"])[0])
+            emeta[str(m.get(1, ["?"])[0])] = (nm, cat, bts)
+        for line in p.get(3, []):
+            if line_substr not in text(line.get(2, ["?"])[0]):
+                continue
+            matched += 1
+            for ev in line.get(4, []):
+                nm, cat, bts = emeta.get(
+                    str(ev.get(1, ["0"])[0]), ("?", "?", 0)
+                )
+                if nm.startswith("%while"):
+                    continue  # container: children are recorded separately
+                d = 0
+                for st in ev.get(4, []):
+                    if st.get(1, ["?"])[0] == id_dur:
+                        d = int(st.get(3, ["0"])[0])
+                cats[cat]["ps"] += d
+                cats[cat]["count"] += 1
+                cats[cat]["bytes"] += bts
+                ops[(cat, nm.split(" = ")[0])] += d
+    if not matched:
+        raise ValueError(
+            f"no plane matching {device_substr!r} with line {line_substr!r}"
+        )
+    return {
+        "planes": matched,
+        "total_ps": sum(c["ps"] for c in cats.values()),
+        "categories": dict(cats),
+        "ops": dict(ops),
+    }
+
+
+def print_summary(path: str, *, steps: int = 1, top: int = 12,
+                  out=None) -> None:
+    """Human-readable per-category + top-op table (the BENCHMARKS.md
+    format). `steps` divides totals into per-step numbers."""
+    out = out or sys.stdout
+    s = op_summary(path)
+    tot = s["total_ps"]
+    print(f"device op time: {tot / steps / 1e12 * 1e3:.2f} ms/step "
+          f"({steps} step(s))", file=out)
+    for cat, c in sorted(s["categories"].items(), key=lambda kv: -kv[1]["ps"]):
+        if not c["ps"]:
+            continue
+        gbps = c["bytes"] / (c["ps"] / 1e12) / 1e9
+        print(f"  {c['ps'] / tot * 100:5.1f}%  "
+              f"{c['ps'] / steps / 1e12 * 1e3:8.2f} ms/step  "
+              f"x{c['count'] // steps:6d}  {cat:28s} {gbps:7.0f} GB/s",
+              file=out)
+    print(f"top {top} ops:", file=out)
+    for (cat, nm), d in sorted(s["ops"].items(), key=lambda kv: -kv[1])[:top]:
+        print(f"  {d / steps / 1e12 * 1e3:7.3f} ms/step  [{cat[:18]}] "
+              f"{nm[:58]}", file=out)
+
+
+def main(argv=None) -> int:
+    args = argv if argv is not None else sys.argv[1:]
+    if not args or args[0] in ("-h", "--help"):
+        print(__doc__)
+        return 0
+    steps = int(args[1]) if len(args) > 1 else 1
+    print_summary(args[0], steps=steps)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
