@@ -13,7 +13,9 @@ module adds generation designed for the XLA compilation model:
 - data-dependent stopping (EOS) is a done-mask folded through the scan,
   not a dynamic loop exit — sampled-after-done positions emit `pad_id`;
 - sampling (greedy / temperature / top-k / nucleus top-p) happens
-  on-device from fp32 logits with an explicit PRNG key chain, so a given
+  on-device with an explicit PRNG key chain — logits arrive in the policy
+  compute dtype (bf16 under the bf16 policy, models/lm.py) and
+  `sample_logits` upcasts to fp32 before filtering — so a given
   (params, prompt, key) triple is reproducible across hosts and backends.
 
 The cache lives in a flax "cache" variable collection (see
@@ -77,7 +79,8 @@ def sample_logits(
     top_k: int = 0,
     top_p: float = 0.0,
 ) -> jnp.ndarray:
-    """Sample token ids (b,) from fp32 logits (b, vocab).
+    """Sample token ids (b,) from logits (b, vocab); any float dtype —
+    upcast to fp32 here before temperature/filter math.
 
     temperature=0 is greedy argmax (no key needed). top_k keeps the k
     highest logits (clamped to the vocab size — asking for more than the

@@ -77,7 +77,8 @@ class TransformerLM(nn.Module):
     @nn.compact
     def __call__(self, tokens, *, train: bool = False, decode: bool = False,
                  attn_start=None):
-        """tokens (batch, seq) int32 -> logits (batch, seq, vocab) fp32.
+        """tokens (batch, seq) int32 -> logits (batch, seq, vocab) in the
+        policy compute dtype (consumers upcast — see the return comment).
 
         `decode=True` is KV-cache inference mode (inference.py): the call
         appends `s` tokens at the cache cursor instead of reading positions
@@ -202,7 +203,15 @@ class TransformerLM(nn.Module):
                 param_dtype=self.param_dtype,
                 name="lm_head",
             )(x)
-        return logits.astype(jnp.float32)
+        # logits stay in the policy compute dtype: at LM vocab sizes an
+        # fp32 logit tensor is gigabytes of HBM traffic per step (~5% of
+        # the lm_base step, round-4 profile), and every consumer
+        # (ops.losses cross-entropy, inference.sample_logits) upcasts
+        # per-element inside its own fused reductions. This mirrors the
+        # reference's autocast semantics exactly: its model emits
+        # half-precision logits and nn.CrossEntropyLoss upcasts
+        # (origin_main.py autocast block).
+        return logits
 
 
 def LMTiny(**kw):

@@ -67,7 +67,14 @@ class _LMEmbed(nn.Module):
 
 
 class _LMHead(nn.Module):
-    """Final LN + vocab projection; logits fp32."""
+    """Final LN + vocab projection; logits fp32.
+
+    DELIBERATELY fp32 (unlike TransformerLM's policy-dtype logits): these
+    logits cross the pipeline shard_map's masked-psum boundary
+    (parallel/pipeline_1f1b.py:79), and sub-fp32 psums over manual axes
+    CHECK-fail in JAX 0.9 (the workaround documented at
+    pipeline_1f1b.py:36). The bf16-logit HBM saving applies only to the
+    dense LM."""
 
     vocab_size: int
     dtype: jnp.dtype = jnp.float32

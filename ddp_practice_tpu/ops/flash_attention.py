@@ -11,22 +11,42 @@ sequence length is bounded by HBM, not VMEM. Forward, per (q-block,
 k-block) grid step:
 
     @when(kj == 0):   m, l, acc := -inf, 0, 0  # scratch init
-    s   = q @ k^T * scale                      # MXU, fp32 accumulate
+    s   = (q*scale) @ k^T                      # MXU, fp32 accumulate
     m'  = max(m, rowmax(s))                    # online softmax rescale
-    acc = acc*exp(m-m') + exp(s-m') @ v        # MXU
-    @when(kj == last): out = acc / l, lse = m + log l
+    acc = acc*(l*corr/l') + exp(s-m') @ v / l' # MXU; acc stays normalized
+    @when(kj == last): out = acc, lse = m + log l
 
 so the (seq x seq) score matrix never materializes in HBM — O(seq) memory,
 one pass over K/V. Causal masking skips whole k-blocks above the diagonal
 (@when(visible) gates the FLOPs).
+
+Performance structure (the round-4 restructure; measured on TPU v5e —
+see BENCHMARKS.md kernel table):
+  * softmax state (m, l) is kept LANE-REPLICATED at (block_q, 128) and
+    widened to block_k by lane-tiling — never a width-1 cross-lane
+    broadcast over the (block_q, block_k) tile, which dominated VPU time
+    in the round-3 kernel;
+  * the accumulator is renormalized every step, so the epilogue is a bare
+    cast (no wide divide), and all broadcasts against acc slice the
+    replicated 128-lane state down to head_dim;
+  * all contractions are `lax.dot_general` with explicit dimension
+    numbers — k^T / p^T / ds^T are never materialized;
+  * sm_scale is folded into the q tile at load ((block_q, d) mul — for
+    d=64 the scale 1/8 is exact in bf16) so no (block_q, block_k) scale
+    pass runs;
+  * p / ds are cast to bf16 before their MXU consumers (FlashAttention-2
+    staging); softmax statistics stay fp32.
+With head_dim 64 the MXU contraction/output width caps useful utilization
+at 50% of peak; the restructured forward reaches ~49% of bf16 peak on the
+executed-dot basis at lm_base shapes — at the structural ceiling.
 
 Backward is tiled the same way (FlashAttention-2 scheme), recomputing
 p = exp(s - lse) blockwise from the saved logsumexp:
 
     delta = rowsum(do * o)                    # XLA, cheap
     dKdV kernel (grid bh x k-blocks x q-blocks, q innermost):
-        p = exp(q@k^T*scale - lse);  dv += p^T @ do     # scratch accum
-        ds = p * (do @ v^T - delta); dk += ds^T @ (q*scale)
+        p = exp(qs@k^T - lse);  dv += p^T @ do          # scratch accum
+        ds = p * (do @ v^T - delta); dk += ds^T @ qs
     dQ kernel (grid bh x q-blocks x k-blocks, k innermost):
         dq += (ds @ k) * scale                          # scratch accum
 
@@ -39,12 +59,6 @@ attention and merge normalized partials across ring steps
 
 Runs compiled on TPU; `interpret=True` under the CPU backend so the same
 tests cover it everywhere (tests/conftest.py).
-
-Hardware validation (TPU v5e, 2026-07-30, compiled — not interpret):
-fwd+bwd vs a Precision.HIGHEST dense reference at (4, 1024, 8, 64),
-causal and non-causal: max relative grad error 3-7e-3 — MXU default-
-precision (bf16-pass) noise, the same regime XLA's own dense attention
-computes in at default precision.
 """
 
 from __future__ import annotations
@@ -53,22 +67,50 @@ import functools
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 from jax.experimental import pallas as pl
 
 _NEG_INF = -1e30
+_LANES = 128
+
+# dot_general dimension numbers: contract the LAST dim of both operands
+# (x @ y^T without materializing the transpose) and the FIRST dim of both
+# (x^T @ y likewise).
+_TRANS_B = (((1,), (1,)), ((), ()))
+_TRANS_A = (((0,), (0,)), ((), ()))
 
 
-def _causal_mask(s, qi, kj, block_q, block_k, offset):
-    """Bottom-right-aligned causal mask for one (q-block, k-block) tile:
-    query i attends keys <= i + offset, offset = seq_k - seq_q (matches
-    _attention's tril)."""
+def _dot_tb(x, y):
+    return lax.dot_general(x, y, _TRANS_B, preferred_element_type=jnp.float32)
+
+
+def _dot_ta(x, y):
+    return lax.dot_general(x, y, _TRANS_A, preferred_element_type=jnp.float32)
+
+
+def _widen(x128, w):
+    """Widen lane-replicated (rows, 128) state to (rows, w) without a
+    width-1 cross-lane broadcast: slice when w <= 128, lane-tile when w is
+    a multiple of 128, fall back to a plain broadcast otherwise (rare,
+    non-tiled shapes)."""
+    if w <= _LANES:
+        return x128[:, :w]
+    if w % _LANES == 0:
+        return jnp.tile(x128, (1, w // _LANES))
+    return jnp.broadcast_to(x128[:, :1], (x128.shape[0], w))
+
+
+def _causal_penalty(qi, kj, block_q, block_k, offset):
+    """Additive mask for one (q-block, k-block) tile: 0 where query i may
+    attend key j (j <= i + offset, offset = seq_k - seq_q), -inf-like
+    otherwise. Added to s (cheaper than select on Mosaic)."""
     q_pos = qi * block_q + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0
     )
     k_pos = kj * block_k + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 1
     )
-    return jnp.where(q_pos + offset >= k_pos, s, _NEG_INF)
+    return jnp.where(q_pos + offset >= k_pos, 0.0, _NEG_INF)
 
 
 def _fwd_kernel(
@@ -77,17 +119,18 @@ def _fwd_kernel(
 ):
     """Streaming grid cell (bh, q-block, k-block): k innermost, so only one
     (block_q, d) + one (block_k, d) tile live in VMEM at a time — sequence
-    length is unbounded by VMEM. Online-softmax state (m, l, acc) persists
-    in scratch across the k sweep; the output block writes on the last k
-    step (Pallas copies revisited out-blocks out once, at the end)."""
+    length is unbounded by VMEM. Online-softmax state (m, l) persists
+    lane-replicated at (block_q, 128) in scratch across the k sweep; acc
+    is kept normalized every step so the final write is a cast."""
     qi = pl.program_id(1)
     kj = pl.program_id(2)
     n_k = pl.num_programs(2)
     offset = seq_k - seq_q if causal else 0
+    d = v_ref.shape[-1]
 
     @pl.when(kj == 0)
     def _init():
-        m_scr[:] = jnp.full(m_scr.shape, _NEG_INF, jnp.float32)
+        m_scr[:] = jnp.full(m_scr.shape, -jnp.inf, jnp.float32)
         l_scr[:] = jnp.zeros(l_scr.shape, jnp.float32)
         acc_scr[:] = jnp.zeros(acc_scr.shape, jnp.float32)
 
@@ -99,28 +142,34 @@ def _fwd_kernel(
 
     @pl.when(visible)
     def _compute():
-        q = q_ref[:].astype(jnp.float32) * sm_scale
-        k = k_ref[:].astype(jnp.float32)
-        v = v_ref[:].astype(jnp.float32)
-        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        q = (q_ref[:] * sm_scale).astype(q_ref.dtype)  # (bq, d), cheap
+        s = _dot_tb(q, k_ref[:])                       # (bq, bk) fp32
         if causal:
-            s = _causal_mask(s, qi, kj, block_q, block_k, offset)
-        m_prev = m_scr[:, 0]
-        l_prev = l_scr[:, 0]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
-        p = jnp.exp(s - m_new[:, None])
-        corr = jnp.exp(m_prev - m_new)
-        l_scr[:] = (l_prev * corr + jnp.sum(p, axis=-1))[:, None]
-        acc_scr[:] = acc_scr[:] * corr[:, None] + jnp.dot(
-            p, v, preferred_element_type=jnp.float32
+            s = s + _causal_penalty(qi, kj, block_q, block_k, offset)
+        m_prev = m_scr[:]                              # (bq, 128)
+        l_prev = l_scr[:]
+        m_next = jnp.maximum(m_prev, jnp.max(s, axis=1)[:, None])
+        p = jnp.exp(s - _widen(m_next, block_k))
+        alpha = jnp.exp(m_prev - m_next)               # (bq, 128)
+        l_corr = alpha * l_prev
+        l_next = l_corr + jnp.sum(p, axis=1)[:, None]
+        l_inv = jnp.where(l_next == 0.0, 1.0, 1.0 / l_next)
+        m_scr[:] = m_next
+        l_scr[:] = l_next
+        pv = lax.dot_general(                          # p @ v
+            p.astype(v_ref.dtype), v_ref[:], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
-        m_scr[:] = m_new[:, None]
+        acc_scr[:] = (
+            acc_scr[:] * _widen(l_corr * l_inv, d) + pv * _widen(l_inv, d)
+        )
 
     @pl.when(kj == n_k - 1)
     def _finalize():
-        l_safe = jnp.maximum(l_scr[:, 0], 1e-30)
-        o_ref[:] = (acc_scr[:] / l_safe[:, None]).astype(o_ref.dtype)
-        lse_ref[:] = (m_scr[:, 0] + jnp.log(l_safe))[:, None]
+        o_ref[:] = acc_scr[:].astype(o_ref.dtype)
+        l_col = l_scr[:, :1]
+        l_safe = jnp.maximum(l_col, 1e-30)
+        lse_ref[:] = m_scr[:, :1] + jnp.log(l_safe)
 
 
 def _fit_block(seq, block):
@@ -145,6 +194,28 @@ def _check_blocks(seq_q, seq_k, block_q, block_k, causal):
     return block_q, block_k
 
 
+def _block_visible(block_q, block_k, offset):
+    """Predicate: does causal q-block i see any of k-block j?"""
+    return lambda i, j: (i * block_q + block_q - 1 + offset) >= (j * block_k)
+
+
+def _redirect(causal, vis, i, j, idx):
+    """Prefetch-redirect for swept block indices: a block belonging to a
+    cell the kernel will skip (fully above the diagonal) redirects its
+    DMA to block 0 instead of fetching data that `@pl.when(visible)`
+    discards (the bundled jax TPU kernel's prefetch trick). All six
+    sweep index maps below (folded + packed, kv- and q-swept) are built
+    from this one predicate+select so the visibility condition lives in
+    exactly one place."""
+    return lax.select(vis(i, j), idx, 0) if causal else idx
+
+
+def _kv_index_map(causal, block_q, block_k, offset):
+    """kv-block index map for k-innermost folded sweeps."""
+    vis = _block_visible(block_q, block_k, offset)
+    return lambda b, i, j: (b, _redirect(causal, vis, i, j, j), 0)
+
+
 def _flash_fwd(q, k, v, *, causal, block_q, block_k, interpret):
     """q/k/v: (bh, seq, d). Returns (out, lse)."""
     from jax.experimental.pallas import tpu as pltpu
@@ -158,13 +229,15 @@ def _flash_fwd(q, k, v, *, causal, block_q, block_k, interpret):
         _fwd_kernel, sm_scale=sm_scale, block_q=block_q, block_k=block_k,
         causal=causal, seq_q=seq_q, seq_k=seq_k,
     )
+    kv_map = _kv_index_map(causal, block_q, block_k,
+                           seq_k - seq_q if causal else 0)
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec((None, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((None, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((None, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((None, block_k, d), kv_map),
+            pl.BlockSpec((None, block_k, d), kv_map),
         ],
         out_specs=[
             pl.BlockSpec((None, block_q, d), lambda b, i, j: (b, i, 0)),
@@ -175,13 +248,29 @@ def _flash_fwd(q, k, v, *, causal, block_q, block_k, interpret):
             jax.ShapeDtypeStruct((bh, seq_q, 1), jnp.float32),
         ],
         scratch_shapes=[
-            pltpu.VMEM((block_q, 1), jnp.float32),
-            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
             pltpu.VMEM((block_q, d), jnp.float32),
         ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ),
         interpret=interpret,
     )(q, k, v)
     return out, lse[..., 0]
+
+
+def _bwd_probs(q_scaled, k_ref, lse_ref, qi, kj, block_q, block_k, causal,
+               offset):
+    """Recompute the (block_q, block_k) probability tile from the saved
+    logsumexp: p = exp(qs@k^T - lse). lse arrives as a (block_q, 1) column;
+    it is broadcast once to the 128-lane replicated form and lane-widened
+    from there (never a width-1 broadcast at block_k width)."""
+    s = _dot_tb(q_scaled, k_ref[:])
+    if causal:
+        s = s + _causal_penalty(qi, kj, block_q, block_k, offset)
+    lse128 = jnp.broadcast_to(lse_ref[:], (block_q, _LANES))
+    return jnp.exp(s - _widen(lse128, block_k))
 
 
 def _dkdv_kernel(
@@ -210,24 +299,16 @@ def _dkdv_kernel(
 
     @pl.when(visible)
     def _compute():
-        k = k_ref[:].astype(jnp.float32)
-        v = v_ref[:].astype(jnp.float32)
-        q = q_ref[:].astype(jnp.float32) * sm_scale
-        do = do_ref[:].astype(jnp.float32)
-        lse = lse_ref[:]      # (block_q, 1) fp32
-        delta = delta_ref[:]
-        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
-        if causal:
-            s = _causal_mask(s, qi, ki, block_q, block_k, offset)
-        p = jnp.exp(s - lse)  # exact probs from the saved logsumexp
-        dv_scr[:] = dv_scr[:] + jnp.dot(
-            p.T, do, preferred_element_type=jnp.float32
-        )
-        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
-        ds = p * (dp - delta)
-        dk_scr[:] = dk_scr[:] + jnp.dot(
-            ds.T, q, preferred_element_type=jnp.float32
-        )
+        qs = (q_ref[:] * sm_scale).astype(q_ref.dtype)
+        do = do_ref[:]
+        p = _bwd_probs(qs, k_ref, lse_ref, qi, ki, block_q, block_k,
+                       causal, offset)
+        p_lo = p.astype(do.dtype)
+        dv_scr[:] = dv_scr[:] + _dot_ta(p_lo, do)       # p^T @ do
+        dp = _dot_tb(do, v_ref[:])                      # do @ v^T
+        delta128 = jnp.broadcast_to(delta_ref[:], (block_q, _LANES))
+        ds = p * (dp - _widen(delta128, block_k))
+        dk_scr[:] = dk_scr[:] + _dot_ta(ds.astype(qs.dtype), qs)  # ds^T @ qs
 
     @pl.when(qi == n_q - 1)
     def _finalize():
@@ -257,20 +338,16 @@ def _dq_kernel(
 
     @pl.when(visible)
     def _compute():
-        q = q_ref[:].astype(jnp.float32) * sm_scale
-        do = do_ref[:].astype(jnp.float32)
-        lse = lse_ref[:]
-        delta = delta_ref[:]
-        k = k_ref[:].astype(jnp.float32)
-        v = v_ref[:].astype(jnp.float32)
-        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
-        if causal:
-            s = _causal_mask(s, qi, kj, block_q, block_k, offset)
-        p = jnp.exp(s - lse)
-        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
-        ds = p * (dp - delta)
-        dq_scr[:] = dq_scr[:] + jnp.dot(
-            ds, k, preferred_element_type=jnp.float32
+        qs = (q_ref[:] * sm_scale).astype(q_ref.dtype)
+        do = do_ref[:]
+        p = _bwd_probs(qs, k_ref, lse_ref, qi, kj, block_q, block_k,
+                       causal, offset)
+        dp = _dot_tb(do, v_ref[:])
+        delta128 = jnp.broadcast_to(delta_ref[:], (block_q, _LANES))
+        ds = (p * (dp - _widen(delta128, block_k))).astype(q_ref.dtype)
+        dq_scr[:] = dq_scr[:] + lax.dot_general(        # ds @ k
+            ds, k_ref[:], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
 
     @pl.when(kj == n_k - 1)
@@ -290,6 +367,12 @@ def _flash_bwd(q, k, v, do, lse, delta, *, causal, block_q, block_k,
     lse3 = lse[..., None].astype(jnp.float32)
     delta3 = delta[..., None].astype(jnp.float32)
 
+    offset = seq_k - seq_q if causal else 0
+    vis = _block_visible(block_q, block_k, offset)
+
+    def qo_map(b, j, i):
+        return (b, _redirect(causal, vis, i, j, i), 0)
+
     dkdv = functools.partial(
         _dkdv_kernel, sm_scale=sm_scale, block_q=block_q, block_k=block_k,
         causal=causal, seq_q=seq_q, seq_k=seq_k,
@@ -298,10 +381,10 @@ def _flash_bwd(q, k, v, do, lse, delta, *, causal, block_q, block_k,
         dkdv,
         grid=(bh, seq_k // block_k, seq_q // block_q),
         in_specs=[
-            pl.BlockSpec((None, block_q, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((None, block_q, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((None, block_q, 1), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((None, block_q, 1), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((None, block_q, d), qo_map),
+            pl.BlockSpec((None, block_q, d), qo_map),
+            pl.BlockSpec((None, block_q, 1), qo_map),
+            pl.BlockSpec((None, block_q, 1), qo_map),
             pl.BlockSpec((None, block_k, d), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((None, block_k, d), lambda b, j, i: (b, j, 0)),
         ],
@@ -317,6 +400,9 @@ def _flash_bwd(q, k, v, do, lse, delta, *, causal, block_q, block_k,
             pltpu.VMEM((block_k, d), jnp.float32),
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ),
         interpret=interpret,
     )(q, do, lse3, delta3, k, v)
 
@@ -324,6 +410,7 @@ def _flash_bwd(q, k, v, do, lse, delta, *, causal, block_q, block_k,
         _dq_kernel, sm_scale=sm_scale, block_q=block_q, block_k=block_k,
         causal=causal, seq_q=seq_q, seq_k=seq_k,
     )
+    kv_map = _kv_index_map(causal, block_q, block_k, offset)
     dq = pl.pallas_call(
         dqk,
         grid=(bh, seq_q // block_q, seq_k // block_k),
@@ -332,12 +419,15 @@ def _flash_bwd(q, k, v, do, lse, delta, *, causal, block_q, block_k,
             pl.BlockSpec((None, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((None, block_q, 1), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((None, block_q, 1), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((None, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((None, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((None, block_k, d), kv_map),
+            pl.BlockSpec((None, block_k, d), kv_map),
         ],
         out_specs=pl.BlockSpec((None, block_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ),
         interpret=interpret,
     )(q, do, lse3, delta3, k, v)
     return dq, dk, dv
@@ -345,6 +435,388 @@ def _flash_bwd(q, k, v, do, lse, delta, *, causal, block_q, block_k,
 
 def _interpret() -> bool:
     return jax.default_backend() == "cpu"
+
+
+# --------------------------------------------------------------------- #
+# Packed-layout kernels: attention directly on the flat (b, s, h*d)
+# activations the QKV projection produces.
+#
+# The folded path above transposes (b, s, h, d) -> (b*h, s, d) around
+# every kernel call; at lm_base shapes those transposes are ~5% of the
+# whole train step ("data formatting" in the xprof composition —
+# BENCHMARKS.md). Mosaic cannot squeeze a size-h dim out of a 4D block,
+# but it CAN take a 128-wide column block out of the flat h*d dim — so
+# for d <= 128 we pack 128//d heads per grid cell: the q/k/v tiles are
+# (block, 128) contiguous slices of the UNTRANSPOSED activations, and the
+# kernel walks the packed heads with 64-aligned column slices (python-
+# unrolled). Head count h must divide into whole packs; anything else
+# falls back to the folded path. Zero layout ops at the model boundary.
+# --------------------------------------------------------------------- #
+
+
+def _heads_per_pack(h: int, d: int):
+    """Packing arity for head_dim d: how many heads share one 128-lane
+    tile. None = shapes don't pack (fall back to the folded path)."""
+    if d >= _LANES:
+        return 1 if d % _LANES == 0 else None
+    if _LANES % d:
+        return None
+    hpc = _LANES // d
+    return hpc if h % hpc == 0 else None
+
+
+def _fwd_kernel_packed(
+    q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+    *, sm_scale, block_q, block_k, causal, seq_q, seq_k, hpc, d,
+):
+    """Packed grid cell (b, head-pack, q-block, k-block): identical math
+    to _fwd_kernel, repeated over the hpc heads living in this 128-wide
+    column pack. Per-head state is (hpc, block_q, 128) scratch; the
+    accumulator shares one (block_q, hpc*d) buffer whose column blocks
+    belong to the packed heads."""
+    qi = pl.program_id(2)
+    kj = pl.program_id(3)
+    n_k = pl.num_programs(3)
+    offset = seq_k - seq_q if causal else 0
+
+    @pl.when(kj == 0)
+    def _init():
+        m_scr[:] = jnp.full(m_scr.shape, -jnp.inf, jnp.float32)
+        l_scr[:] = jnp.zeros(l_scr.shape, jnp.float32)
+        acc_scr[:] = jnp.zeros(acc_scr.shape, jnp.float32)
+
+    visible = (
+        (qi * block_q + block_q - 1 + offset) >= (kj * block_k)
+        if causal else (kj >= 0)
+    )
+
+    # NOTE: a diagonal/interior split (interior cells skipping the iota/
+    # where penalty) measured NEUTRAL on v5e (1.33 vs 1.30 ms at lm_base
+    # shapes — the duplicated body costs what the skipped pass saves), so
+    # the penalty runs on every visited cell, like the bundled jax kernel.
+    @pl.when(visible)
+    def _compute():
+        penalty = (
+            _causal_penalty(qi, kj, block_q, block_k, offset)
+            if causal else None
+        )
+        for hh in range(hpc):
+            lo, hi = hh * d, (hh + 1) * d
+            q = (q_ref[:, lo:hi] * sm_scale).astype(q_ref.dtype)
+            s = _dot_tb(q, k_ref[:, lo:hi])
+            if causal:
+                s = s + penalty
+            m_prev = m_scr[hh]
+            l_prev = l_scr[hh]
+            m_next = jnp.maximum(m_prev, jnp.max(s, axis=1)[:, None])
+            p = jnp.exp(s - _widen(m_next, block_k))
+            alpha = jnp.exp(m_prev - m_next)
+            l_corr = alpha * l_prev
+            l_next = l_corr + jnp.sum(p, axis=1)[:, None]
+            l_inv = jnp.where(l_next == 0.0, 1.0, 1.0 / l_next)
+            m_scr[hh] = m_next
+            l_scr[hh] = l_next
+            pv = lax.dot_general(
+                p.astype(v_ref.dtype), v_ref[:, lo:hi],
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            acc_scr[:, lo:hi] = (
+                acc_scr[:, lo:hi] * _widen(l_corr * l_inv, d)
+                + pv * _widen(l_inv, d)
+            )
+
+    @pl.when(kj == n_k - 1)
+    def _finalize():
+        o_ref[:] = acc_scr[:].astype(o_ref.dtype)
+        for hh in range(hpc):
+            l_safe = jnp.maximum(l_scr[hh][:, :1], 1e-30)
+            lse_ref[:, hh:hh + 1] = m_scr[hh][:, :1] + jnp.log(l_safe)
+
+
+def _flash_fwd_packed(qf, kf, vf, *, n_heads, causal, block_q, block_k,
+                      interpret):
+    """qf/kf/vf: flat (b, s, h*d). Returns (out_flat, lse_packed) where
+    lse_packed is (b, n_packs, seq_q, hpc) fp32."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, seq_q, hd = qf.shape
+    seq_k = kf.shape[1]
+    d = hd // n_heads
+    hpc = _heads_per_pack(n_heads, d)
+    w = hpc * d
+    n_packs = n_heads // hpc
+    block_q, block_k = _check_blocks(seq_q, seq_k, block_q, block_k, causal)
+    sm_scale = 1.0 / (d ** 0.5)
+    offset = seq_k - seq_q if causal else 0
+    vis = _block_visible(block_q, block_k, offset)
+
+    def kv_map(b_, g, i, j):
+        return (b_, _redirect(causal, vis, i, j, j), g)
+
+    kernel = functools.partial(
+        _fwd_kernel_packed, sm_scale=sm_scale, block_q=block_q,
+        block_k=block_k, causal=causal, seq_q=seq_q, seq_k=seq_k,
+        hpc=hpc, d=d,
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(b, n_packs, seq_q // block_q, seq_k // block_k),
+        in_specs=[
+            pl.BlockSpec((None, block_q, w), lambda b_, g, i, j: (b_, i, g)),
+            pl.BlockSpec((None, block_k, w), kv_map),
+            pl.BlockSpec((None, block_k, w), kv_map),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_q, w), lambda b_, g, i, j: (b_, i, g)),
+            pl.BlockSpec((None, None, block_q, hpc),
+                         lambda b_, g, i, j: (b_, g, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(qf.shape, qf.dtype),
+            jax.ShapeDtypeStruct((b, n_packs, seq_q, hpc), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((hpc, block_q, _LANES), jnp.float32),
+            pltpu.VMEM((hpc, block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, w), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")
+        ),
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out, lse
+
+
+def _dkdv_kernel_packed(
+    q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref, dk_ref, dv_ref,
+    dk_scr, dv_scr,
+    *, sm_scale, block_q, block_k, causal, seq_q, seq_k, hpc, d,
+):
+    ki = pl.program_id(2)
+    qi = pl.program_id(3)
+    n_q = pl.num_programs(3)
+    offset = seq_k - seq_q if causal else 0
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros(dk_scr.shape, jnp.float32)
+        dv_scr[:] = jnp.zeros(dv_scr.shape, jnp.float32)
+
+    visible = (
+        (qi * block_q + block_q - 1 + offset) >= (ki * block_k)
+        if causal else (qi >= 0)
+    )
+
+    @pl.when(visible)
+    def _compute():
+        penalty = (
+            _causal_penalty(qi, ki, block_q, block_k, offset)
+            if causal else None
+        )
+        for hh in range(hpc):
+            lo, hi = hh * d, (hh + 1) * d
+            qs = (q_ref[:, lo:hi] * sm_scale).astype(q_ref.dtype)
+            do = do_ref[:, lo:hi]
+            s = _dot_tb(qs, k_ref[:, lo:hi])
+            if causal:
+                s = s + penalty
+            lse128 = jnp.broadcast_to(lse_ref[:, hh:hh + 1],
+                                      (block_q, _LANES))
+            p = jnp.exp(s - _widen(lse128, block_k))
+            p_lo = p.astype(do.dtype)
+            dv_scr[:, lo:hi] = dv_scr[:, lo:hi] + _dot_ta(p_lo, do)
+            dp = _dot_tb(do, v_ref[:, lo:hi])
+            delta128 = jnp.broadcast_to(delta_ref[:, hh:hh + 1],
+                                        (block_q, _LANES))
+            ds = p * (dp - _widen(delta128, block_k))
+            dk_scr[:, lo:hi] = dk_scr[:, lo:hi] + _dot_ta(
+                ds.astype(qs.dtype), qs
+            )
+
+    @pl.when(qi == n_q - 1)
+    def _finalize():
+        dk_ref[:] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[:] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _dq_kernel_packed(
+    q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref, dq_ref, dq_scr,
+    *, sm_scale, block_q, block_k, causal, seq_q, seq_k, hpc, d,
+):
+    qi = pl.program_id(2)
+    kj = pl.program_id(3)
+    n_k = pl.num_programs(3)
+    offset = seq_k - seq_q if causal else 0
+
+    @pl.when(kj == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros(dq_scr.shape, jnp.float32)
+
+    visible = (
+        (qi * block_q + block_q - 1 + offset) >= (kj * block_k)
+        if causal else (kj >= 0)
+    )
+
+    @pl.when(visible)
+    def _compute():
+        penalty = (
+            _causal_penalty(qi, kj, block_q, block_k, offset)
+            if causal else None
+        )
+        for hh in range(hpc):
+            lo, hi = hh * d, (hh + 1) * d
+            qs = (q_ref[:, lo:hi] * sm_scale).astype(q_ref.dtype)
+            do = do_ref[:, lo:hi]
+            s = _dot_tb(qs, k_ref[:, lo:hi])
+            if causal:
+                s = s + penalty
+            lse128 = jnp.broadcast_to(lse_ref[:, hh:hh + 1],
+                                      (block_q, _LANES))
+            p = jnp.exp(s - _widen(lse128, block_k))
+            dp = _dot_tb(do, v_ref[:, lo:hi])
+            delta128 = jnp.broadcast_to(delta_ref[:, hh:hh + 1],
+                                        (block_q, _LANES))
+            ds = (p * (dp - _widen(delta128, block_k))).astype(q_ref.dtype)
+            dq_scr[:, lo:hi] = dq_scr[:, lo:hi] + lax.dot_general(
+                ds, k_ref[:, lo:hi], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+
+    @pl.when(kj == n_k - 1)
+    def _finalize():
+        dq_ref[:] = (dq_scr[:] * sm_scale).astype(dq_ref.dtype)
+
+
+def _flash_bwd_packed(qf, kf, vf, do, lse_pk, delta_pk, *, n_heads, causal,
+                      block_q, block_k, interpret):
+    """Packed grads. lse_pk/delta_pk: (b, n_packs, seq_q, hpc) fp32."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, seq_q, hd = qf.shape
+    seq_k = kf.shape[1]
+    d = hd // n_heads
+    hpc = _heads_per_pack(n_heads, d)
+    w = hpc * d
+    n_packs = n_heads // hpc
+    block_q, block_k = _check_blocks(seq_q, seq_k, block_q, block_k, causal)
+    sm_scale = 1.0 / (d ** 0.5)
+    offset = seq_k - seq_q if causal else 0
+    vis = _block_visible(block_q, block_k, offset)
+
+    def qo_map(b_, g, j, i):
+        return (b_, _redirect(causal, vis, i, j, i), g)
+
+    def stat_map_dkdv(b_, g, j, i):
+        return (b_, g, _redirect(causal, vis, i, j, i), 0)
+
+    dkdv = functools.partial(
+        _dkdv_kernel_packed, sm_scale=sm_scale, block_q=block_q,
+        block_k=block_k, causal=causal, seq_q=seq_q, seq_k=seq_k,
+        hpc=hpc, d=d,
+    )
+    dk, dv = pl.pallas_call(
+        dkdv,
+        grid=(b, n_packs, seq_k // block_k, seq_q // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, w), qo_map),
+            pl.BlockSpec((None, block_q, w), qo_map),
+            pl.BlockSpec((None, None, block_q, hpc), stat_map_dkdv),
+            pl.BlockSpec((None, None, block_q, hpc), stat_map_dkdv),
+            pl.BlockSpec((None, block_k, w), lambda b_, g, j, i: (b_, j, g)),
+            pl.BlockSpec((None, block_k, w), lambda b_, g, j, i: (b_, j, g)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_k, w), lambda b_, g, j, i: (b_, j, g)),
+            pl.BlockSpec((None, block_k, w), lambda b_, g, j, i: (b_, j, g)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(kf.shape, kf.dtype),
+            jax.ShapeDtypeStruct(vf.shape, vf.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, w), jnp.float32),
+            pltpu.VMEM((block_k, w), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")
+        ),
+        interpret=interpret,
+    )(qf, do, lse_pk, delta_pk, kf, vf)
+
+    def kv_map(b_, g, i, j):
+        return (b_, _redirect(causal, vis, i, j, j), g)
+
+    dqk = functools.partial(
+        _dq_kernel_packed, sm_scale=sm_scale, block_q=block_q,
+        block_k=block_k, causal=causal, seq_q=seq_q, seq_k=seq_k,
+        hpc=hpc, d=d,
+    )
+    dq = pl.pallas_call(
+        dqk,
+        grid=(b, n_packs, seq_q // block_q, seq_k // block_k),
+        in_specs=[
+            pl.BlockSpec((None, block_q, w), lambda b_, g, i, j: (b_, i, g)),
+            pl.BlockSpec((None, block_q, w), lambda b_, g, i, j: (b_, i, g)),
+            pl.BlockSpec((None, None, block_q, hpc),
+                         lambda b_, g, i, j: (b_, g, i, 0)),
+            pl.BlockSpec((None, None, block_q, hpc),
+                         lambda b_, g, i, j: (b_, g, i, 0)),
+            pl.BlockSpec((None, block_k, w), kv_map),
+            pl.BlockSpec((None, block_k, w), kv_map),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, w),
+                               lambda b_, g, i, j: (b_, i, g)),
+        out_shape=jax.ShapeDtypeStruct(qf.shape, qf.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, w), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")
+        ),
+        interpret=interpret,
+    )(qf, do, lse_pk, delta_pk, kf, vf)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_packed(qf, kf, vf, n_heads, causal, block_q, block_k):
+    out, _ = _flash_fwd_packed(
+        qf, kf, vf, n_heads=n_heads, causal=causal, block_q=block_q,
+        block_k=block_k, interpret=_interpret(),
+    )
+    return out
+
+
+def _flash_packed_vjp_fwd(qf, kf, vf, n_heads, causal, block_q, block_k):
+    out, lse_pk = _flash_fwd_packed(
+        qf, kf, vf, n_heads=n_heads, causal=causal, block_q=block_q,
+        block_k=block_k, interpret=_interpret(),
+    )
+    return out, (qf, kf, vf, out, lse_pk)
+
+
+def _flash_packed_vjp_bwd(n_heads, causal, block_q, block_k, res, g_out):
+    qf, kf, vf, out, lse_pk = res
+    g_out = g_out.astype(qf.dtype)
+    b, seq_q, hd = qf.shape
+    d = hd // n_heads
+    hpc = _heads_per_pack(n_heads, d)
+    n_packs = n_heads // hpc
+    # delta = rowsum(do * o) per head, laid out to match lse_pk
+    prod = g_out.astype(jnp.float32) * out.astype(jnp.float32)
+    delta = prod.reshape(b, seq_q, n_packs, hpc, d).sum(-1)
+    delta_pk = jnp.transpose(delta, (0, 2, 1, 3))  # (b, packs, seq, hpc)
+    dq, dk, dv = _flash_bwd_packed(
+        qf, kf, vf, g_out, lse_pk, delta_pk, n_heads=n_heads, causal=causal,
+        block_q=block_q, block_k=block_k, interpret=_interpret(),
+    )
+    return dq, dk, dv
+
+
+_flash_packed.defvjp(_flash_packed_vjp_fwd, _flash_packed_vjp_bwd)
 
 
 # --------------------------------------------------------------------- #
@@ -421,12 +893,23 @@ def flash_attention(
     """Fused multi-head attention; layout-matches ops.attention._attention.
 
     Default blocks (512, 1024) are the measured sweet spot on TPU v5e for
-    lm_base shapes (head_dim 64): lm bench 34.1% MFU at seq 2048 and
-    27.9% at seq 8192, vs 29%/20% at (256, 512) — kernel sweep
-    2026-07-30, BENCHMARKS.md. Blocks clamp to the sequence length, so
-    short-seq callers (ViT at s=64) are unaffected."""
+    lm_base shapes (head_dim 64). Blocks clamp to the sequence length, so
+    short-seq callers (ViT at s=64) are unaffected.
+
+    When head_dim packs into 128 lanes (d <= 128 dividing 128, head count
+    a multiple of the pack; or d a multiple of 128) the packed-layout
+    kernels run directly on the flat (b, s, h*d) activations — no
+    transposes at the model boundary (see the packed section above).
+    Other shapes take the folded (b*h, s, d) path."""
     b, sq, h, d = q.shape
     sk = k.shape[1]
+
+    if _heads_per_pack(h, d) is not None:
+        out = _flash_packed(
+            q.reshape(b, sq, h * d), k.reshape(b, sk, h * d),
+            v.reshape(b, sk, h * d), h, causal, block_q, block_k,
+        )
+        return out.reshape(b, sq, h, d)
 
     def fold(x, s):
         return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, s, x.shape[-1])

@@ -48,22 +48,29 @@ def cross_entropy_sum(
     end, parallel/pipeline_1f1b.py): sum(parts) / sum(weights) equals the
     global weighted mean exactly.
     """
-    logits = logits.astype(jnp.float32)
-    logprobs = logits - jnp.max(logits, axis=-1, keepdims=True)
-    logprobs = logprobs - jnp.log(
-        jnp.sum(jnp.exp(logprobs), axis=-1, keepdims=True)
+    # Never materialize a (..., V) logprobs tensor: at LM vocab sizes it
+    # is gigabytes of HBM per step. Instead nll = lse - logits[target]
+    # where lse is a fused max + exp-sum reduction (reads the logits in
+    # their storage dtype once per pass, fp32 accumulation) and the
+    # target logit is a gather from the RAW logits. A dense one-hot
+    # contraction (and the (V, V) eye behind it) is avoided for the same
+    # reason. Smoothing folds in algebraically: the smoothed one-hot is
+    # (1-ls)*target + ls/V, and mean(-logprobs) = lse - mean(logits) —
+    # still no full-size intermediate.
+    m = jnp.max(logits, axis=-1)
+    lse = m.astype(jnp.float32) + jnp.log(
+        jnp.sum(
+            jnp.exp((logits - m[..., None]).astype(jnp.float32)), axis=-1
+        )
     )
-    # gather the target logprob instead of contracting with a one-hot: a
-    # dense (..., V) one-hot (and the (V, V) eye behind it) is harmless at
-    # 10 classes but allocates gigabytes at LM vocab sizes (V=32768).
-    # Smoothing folds in algebraically: the smoothed one-hot is
-    # (1-ls)*target + ls/V, so nll = (1-ls)*nll_target + ls*mean(-logprobs).
-    nll = -jnp.take_along_axis(
-        logprobs, labels[..., None].astype(jnp.int32), axis=-1
-    )[..., 0]
+    tgt = jnp.take_along_axis(
+        logits, labels[..., None].astype(jnp.int32), axis=-1
+    )[..., 0].astype(jnp.float32)
+    nll = lse - tgt
     if label_smoothing > 0.0:
-        nll = (1.0 - label_smoothing) * nll + label_smoothing * jnp.mean(
-            -logprobs, axis=-1
+        mean_logits = jnp.mean(logits.astype(jnp.float32), axis=-1)
+        nll = (1.0 - label_smoothing) * nll + label_smoothing * (
+            lse - mean_logits
         )
     if weight is None:
         return jnp.sum(nll), jnp.asarray(nll.size, jnp.float32)

@@ -1,0 +1,146 @@
+"""Where does the non-MXU half of the flash fwd cell go? Ablations at
+lm_base shapes (bh=96, s=2048, d=64) on the real chip:
+
+  causal        — v2 kernel as-is (mask + max + exp + sum)
+  noncausal     — mask pass removed (all blocks visible: more dot FLOPs,
+                  but no iota/where passes)
+  nomax         — causal but rowmax pass removed (UNSAFE numerics — cost
+                  probe only)
+  jax_official  — jax.experimental.pallas.ops.tpu.flash_attention at the
+                  same shapes/blocks (what Google's hand-tuned kernel
+                  achieves on this chip = the practical ceiling)
+"""
+import functools
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+sys.path.insert(0, "/root/repo")
+from experiments.flash_variants import (
+    _fwd_v2, fwd_call, timed, visible_fraction, _causal_mask, _NEG_INF)
+
+
+def _fwd_nomax(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+               *, sm_scale, block_q, block_k, causal, seq_q, seq_k):
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+    n_k = pl.num_programs(2)
+    offset = seq_k - seq_q if causal else 0
+
+    @pl.when(kj == 0)
+    def _init():
+        l_scr[:] = jnp.zeros(l_scr.shape, jnp.float32)
+        acc_scr[:] = jnp.zeros(acc_scr.shape, jnp.float32)
+
+    visible = (
+        (qi * block_q + block_q - 1 + offset) >= (kj * block_k)
+        if causal else (kj >= 0)
+    )
+
+    @pl.when(visible)
+    def _compute():
+        q = q_ref[:]
+        k = k_ref[:]
+        v = v_ref[:]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            s = _causal_mask(s, qi, kj, block_q, block_k, offset)
+        p = jnp.exp(s)  # UNSAFE: no running max — cost probe only
+        l_scr[:] = (l_scr[:, 0] + jnp.sum(p, axis=-1))[:, None]
+        acc_scr[:] = acc_scr[:] + jnp.dot(
+            p.astype(jnp.bfloat16), v, preferred_element_type=jnp.float32
+        )
+
+    @pl.when(kj == n_k - 1)
+    def _finalize():
+        l_safe = jnp.maximum(l_scr[:, 0], 1e-30)
+        o_ref[:] = (acc_scr[:] / l_safe[:, None]).astype(o_ref.dtype)
+        lse_ref[:] = jnp.log(l_safe)[:, None]
+
+
+def nomax_call(q, k, v, *, block_q=512, block_k=1024):
+    bh, seq_q, d = q.shape
+    seq_k = k.shape[1]
+    kernel = functools.partial(
+        _fwd_nomax, sm_scale=1.0 / d ** 0.5, block_q=block_q,
+        block_k=block_k, causal=True, seq_q=seq_q, seq_k=seq_k)
+    out, _ = pl.pallas_call(
+        kernel,
+        grid=(bh, seq_q // block_q, seq_k // block_k),
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((None, block_k, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, block_q, 1), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((bh, seq_q, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+    )(q, k, v)
+    return out
+
+
+def main():
+    peak = 197e12
+    bh, s, d = 96, 2048, 64
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(kq, (bh, s, d), jnp.bfloat16)
+    k = jax.random.normal(kk, (bh, s, d), jnp.bfloat16)
+    v = jax.random.normal(kv, (bh, s, d), jnp.bfloat16)
+
+    vis = visible_fraction(s, s, 512, 1024, True)
+
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        BlockSizes, flash_attention as jax_flash)
+
+    q4 = q.reshape(8, 12, s, d)
+    k4 = k.reshape(8, 12, s, d)
+    v4 = v.reshape(8, 12, s, d)
+
+    def official(q, k, v, *, bq=512, bk=1024):
+        bs = BlockSizes(
+            block_q=bq, block_k_major=bk, block_k=bk, block_b=1,
+            block_q_major_dkv=bq, block_k_major_dkv=bk,
+            block_k_dkv=bk, block_q_dkv=bq,
+            block_k_major_dq=bk, block_k_dq=bk, block_q_dq=bq,
+        )
+        o = jax_flash(q.reshape(8, 12, s, d), k.reshape(8, 12, s, d),
+                      v.reshape(8, 12, s, d), causal=True,
+                      sm_scale=1.0 / d ** 0.5, block_sizes=bs)
+        return o.reshape(bh, s, d)
+
+    cases = [
+        ("causal_v2", lambda q, k, v: fwd_call("v2", q, k, v), vis),
+        ("noncausal_v2", lambda q, k, v: fwd_call(
+            "v2", q, k, v, causal=False), 1.0),
+        ("nomax", nomax_call, vis),
+        ("jax_official", official, vis),
+        ("jax_official_b256_512", functools.partial(official, bq=256, bk=512),
+         visible_fraction(s, s, 256, 512, True)),
+    ]
+    for name, fn, vfrac in cases:
+        flops = bh * 2 * 2.0 * s * s * d * vfrac
+        ms = timed(fn, (q, k, v))
+        tflops = flops / (ms / 1e3) / 1e12
+        useful = bh * 2 * 2.0 * s * s * d * 0.5 / (ms / 1e3) / 1e12
+        print(f"fwd {name:22s}: {ms:7.3f} ms  executed {tflops:6.1f} TF/s "
+              f"({100*tflops*1e12/peak:.1f}%)  useful {useful:5.1f} TF/s "
+              f"({100*useful*1e12/peak:.1f}%)")
+
+
+if __name__ == "__main__":
+    main()

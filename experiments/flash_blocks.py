@@ -1,0 +1,63 @@
+"""Block sweep for the restructured kernels: total fwd+bwd device time at
+lm_base shapes, scored on USEFUL throughput (fixed useful causal FLOPs /
+device ms) — finer blocks waste fewer masked FLOPs but pay more per-cell
+overhead."""
+import shutil
+import sys
+import tempfile
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+sys.path.insert(0, "/root/repo")
+from ddp_practice_tpu.utils.xprof import op_summary
+
+K = 24
+
+
+def device_ms(fn, args):
+    @jax.jit
+    def run(q, k, v):
+        def body(c, _):
+            return fn(c, k, v), ()
+        o, _ = lax.scan(body, q, None, length=K)
+        return jnp.float32(o.astype(jnp.float32).sum())
+
+    float(run(*args))
+    tmp = tempfile.mkdtemp(prefix="xp_blk_")
+    with jax.profiler.trace(tmp):
+        float(run(*args))
+    s = op_summary(tmp)
+    shutil.rmtree(tmp, ignore_errors=True)
+    return s["total_ps"] / 1e9 / K
+
+
+def main():
+    from ddp_practice_tpu.ops.flash_attention import flash_attention_with_lse
+
+    bh, s, d = 96, 2048, 64
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(kq, (bh, s, d), jnp.bfloat16)
+    k = jax.random.normal(kk, (bh, s, d), jnp.bfloat16)
+    v = jax.random.normal(kv, (bh, s, d), jnp.bfloat16)
+
+    useful = bh * 9 * 2.0 * s * s * d * 0.5  # 2 fwd + 7 bwd dots, causal
+
+    for bq, bk in [(512, 1024), (512, 512), (256, 512), (1024, 512),
+                   (256, 256), (1024, 1024), (128, 512), (512, 256)]:
+        def fwdbwd(q, k, v, bq=bq, bk=bk):
+            f = lambda q, k, v: flash_attention_with_lse(
+                q, k, v, causal=True, block_q=bq, block_k=bk)[0].sum()
+            dq, dk, dv = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+            return lax.clamp(-1.0, (dq + dk + dv).astype(jnp.float32),
+                             1.0).astype(q.dtype)
+
+        ms = device_ms(fwdbwd, (q, k, v))
+        tf = useful / (ms / 1e3) / 1e12
+        print(f"blocks ({bq:4d},{bk:4d}): {ms:7.3f} ms  useful {tf:6.1f}"
+              f" TF/s ({100 * tf / 197:.1f}% of peak)")
+
+
+if __name__ == "__main__":
+    main()

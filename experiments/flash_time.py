@@ -1,0 +1,94 @@
+"""Time the restructured flash kernels (fwd, fwd+bwd) vs the bundled jax
+TPU kernel at lm_base shapes. Slope-fit over K in {16, 64} chained scans,
+min of 5 reps, scalar-readback fenced."""
+import functools
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+sys.path.insert(0, "/root/repo")
+
+PEAK = 197e12
+
+
+def timed(fn, args, K1=16, K2=64):
+    def chain(K):
+        @jax.jit
+        def run(q, k, v):
+            def body(c, _):
+                return fn(c, k, v), ()
+            o, _ = lax.scan(body, q, None, length=K)
+            return jnp.float32(o.astype(jnp.float32).sum())
+        return run
+
+    r1, r2 = chain(K1), chain(K2)
+    float(r1(*args)); float(r2(*args))
+    best = []
+    for r in (r1, r2):
+        ts = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            float(r(*args))
+            ts.append(time.perf_counter() - t0)
+        best.append(min(ts))
+    return (best[1] - best[0]) / (K2 - K1) * 1e3
+
+
+def main():
+    from ddp_practice_tpu.ops.flash_attention import flash_attention_with_lse
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        BlockSizes, flash_attention as jax_flash)
+
+    bh, s, d = 96, 2048, 64
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(kq, (bh, s, d), jnp.bfloat16)
+    k = jax.random.normal(kk, (bh, s, d), jnp.bfloat16)
+    v = jax.random.normal(kv, (bh, s, d), jnp.bfloat16)
+
+    def ours_fwd(q, k, v):
+        o, _ = flash_attention_with_lse(q, k, v, causal=True)
+        return o
+
+    def ours_fwdbwd(q, k, v):
+        f = lambda q: flash_attention_with_lse(q, k, v, causal=True)[0].sum()
+        return jax.grad(f)(q)
+
+    bs = BlockSizes(
+        block_q=512, block_k_major=1024, block_k=1024, block_b=1,
+        block_q_major_dkv=512, block_k_major_dkv=1024,
+        block_k_dkv=1024, block_q_dkv=512,
+        block_k_major_dq=1024, block_k_dq=1024, block_q_dq=512,
+    )
+
+    def official_fwd(q, k, v):
+        o = jax_flash(q.reshape(8, 12, s, d), k.reshape(8, 12, s, d),
+                      v.reshape(8, 12, s, d), causal=True,
+                      sm_scale=1.0 / d ** 0.5, block_sizes=bs)
+        return o.reshape(bh, s, d)
+
+    def official_fwdbwd(q, k, v):
+        f = lambda q: official_fwd(q, k, v).sum()
+        return jax.grad(f)(q)
+
+    # executed-dot flops at blocks (512, 1024), causal
+    vis = 6 / 8
+    fwd_fl = bh * 2 * 2.0 * s * s * d * vis
+    bwd_fl = bh * 7 * 2.0 * s * s * d * vis  # s,dv,dp,dk + s,dp,dq
+
+    for name, fn, fl in [
+        ("ours fwd", ours_fwd, fwd_fl),
+        ("jaxk fwd", official_fwd, fwd_fl),
+        ("ours fwd+bwd", ours_fwdbwd, fwd_fl + bwd_fl),
+        ("jaxk fwd+bwd", official_fwdbwd, fwd_fl + bwd_fl),
+    ]:
+        ms = timed(fn, (q, k, v))
+        tf = fl / (ms / 1e3) / 1e12
+        print(f"{name:14s}: {ms:7.3f} ms   executed {tf:6.1f} TF/s"
+              f"  ({100 * tf * 1e12 / PEAK:.1f}% of bf16 peak)")
+
+
+if __name__ == "__main__":
+    main()

@@ -111,3 +111,76 @@ def test_flash_indivisible_seq_still_works():
     np.testing.assert_allclose(
         np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5
     )
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_packed_matches_folded(causal):
+    """The packed-layout kernels (round 4: attention directly on the flat
+    (b, s, h*d) activations, head pairs in 128-lane column blocks) must
+    agree with the folded (b*h, s, d) path — forward AND all three grads.
+    On TPU the two are bit-identical; interpret mode gets a float
+    tolerance."""
+    from ddp_practice_tpu.ops.flash_attention import (
+        _flash_lse, _heads_per_pack)
+
+    b, s, h, d = 2, 256, 4, 64
+    assert _heads_per_pack(h, d) == 2  # shapes take the packed path
+    q, k, v = _qkv(b=b, s=s, h=h, d=d, seed=11)
+
+    def folded(q, k, v):
+        fold = lambda x: jnp.transpose(x, (0, 2, 1, 3)).reshape(
+            b * h, x.shape[1], d)
+        out, _ = _flash_lse(fold(q), fold(k), fold(v), causal, 512, 1024)
+        return jnp.transpose(out.reshape(b, h, s, d), (0, 2, 1, 3))
+
+    got = flash_attention(q, k, v, causal=causal)  # dispatches packed
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(folded(q, k, v)), rtol=2e-5, atol=2e-5
+    )
+
+    loss_p = lambda q, k, v: (
+        flash_attention(q, k, v, causal=causal) ** 2).sum()
+    loss_f = lambda q, k, v: (folded(q, k, v) ** 2).sum()
+    gp = jax.grad(loss_p, argnums=(0, 1, 2))(q, k, v)
+    gf = jax.grad(loss_f, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(gp, gf):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b_), rtol=2e-4, atol=2e-4
+        )
+
+
+def test_unpackable_heads_fall_back_to_folded():
+    """h=3 with d=64 cannot pack into whole 128-lane pairs: the dispatch
+    must fall back to the folded path and still match dense."""
+    from ddp_practice_tpu.ops.flash_attention import _heads_per_pack
+
+    assert _heads_per_pack(3, 64) is None
+    q, k, v = _qkv(h=3, seed=13)
+    want = _attention(q, k, v, causal=True)
+    got = flash_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5
+    )
+
+
+@pytest.mark.parametrize("d", [128, 256])
+def test_packed_single_head_per_pack(d):
+    """hpc=1 packing (d a multiple of 128: whole heads own >=128-lane
+    column blocks) and the _widen lane-tile path (w > 128 for d=256) must
+    match dense — the hpc=2 test never reaches either branch."""
+    from ddp_practice_tpu.ops.flash_attention import _heads_per_pack
+
+    assert _heads_per_pack(2, d) == 1
+    q, k, v = _qkv(b=1, s=256, h=2, d=d, seed=17)
+    want = _attention(q, k, v, causal=True)
+    got = flash_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5
+    )
+    gp = jax.grad(lambda q: (flash_attention(q, k, v, causal=True) ** 2
+                             ).sum())(q)
+    gd = jax.grad(lambda q: (_attention(q, k, v, causal=True) ** 2
+                             ).sum())(q)
+    np.testing.assert_allclose(
+        np.asarray(gp), np.asarray(gd), rtol=2e-4, atol=2e-4
+    )

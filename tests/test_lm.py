@@ -43,6 +43,9 @@ def test_lm_forward_shapes_and_dtype(devices):
     variables = model.init(jax.random.PRNGKey(0), tokens)
     logits = model.apply(variables, tokens)
     assert logits.shape == (2, 16, 32)
+    # logits ride the policy compute dtype (fp32 here — the default
+    # policy); under bf16 they stay bf16 and the CE upcasts per-element
+    # inside its fused reductions (models/lm.py return comment).
     assert logits.dtype == jnp.float32
 
 
