@@ -129,14 +129,19 @@ class SelfAttention(nn.Module):
             k = apply_rope(k, positions)
         if decode:
             # KV-cache incremental decoding: the cache collection holds
-            # pre-allocated (b, max_len, h, hd) key/value buffers (shaped by
-            # a full-length init call) plus the write cursor. One code path
-            # serves prefill (s = prompt length at cursor 0) and
-            # single-token steps (s = 1): dynamic_update_slice writes the
-            # new K/V block at the cursor, and validity is the position
-            # inequality j <= cursor + i — static shapes, dynamic offset,
-            # which is what keeps the whole generate loop one compiled
-            # lax.scan (inference.py).
+            # pre-allocated FLAT (b, max_len, h*hd) key/value buffers
+            # (shaped by a full-length init call) plus the write cursor.
+            # The flat layout is load-bearing, not cosmetic: minor dims
+            # (h, hd) tile-pad on TPU and a padded buffer defeats
+            # in-place dynamic_update_slice — every per-token write
+            # became a full cache relayout copy, 53.6% of the bs=8
+            # decode step (round-4 profile; probes in
+            # experiments/decode_layouts.py). Flat updates run in-place
+            # (~0.2 us). One code path serves prefill (s = prompt length
+            # at cursor 0) and single-token steps (s = 1): the step
+            # attention is a packed Pallas kernel reading the flat cache
+            # per head (ops/decode_attention.py), prefill reshapes once
+            # and takes the masked XLA path.
             if not self.causal:
                 raise ValueError("decode=True requires causal attention")
             if self.seq_axis is not None:
@@ -145,11 +150,13 @@ class SelfAttention(nn.Module):
                     "parallelism — generate on a data/tensor-sharded mesh"
                 )
             cache_dtype = self.kv_cache_dtype or k.dtype
+            b_, s_, h_, hd_ = k.shape
+            flat_kv = (b_, s_, h_ * hd_)
             cached_key = self.variable(
-                "cache", "cached_key", jnp.zeros, k.shape, cache_dtype
+                "cache", "cached_key", jnp.zeros, flat_kv, cache_dtype
             )
             cached_value = self.variable(
-                "cache", "cached_value", jnp.zeros, v.shape, cache_dtype
+                "cache", "cached_value", jnp.zeros, flat_kv, cache_dtype
             )
             cache_index = self.variable(
                 "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
@@ -160,6 +167,12 @@ class SelfAttention(nn.Module):
                 from jax import lax
 
                 from ddp_practice_tpu.ops.attention import attention_with_mask
+                from ddp_practice_tpu.ops.decode_attention import (
+                    decode_attention_packed,
+                )
+                from ddp_practice_tpu.ops.flash_attention import (
+                    _heads_per_pack,
+                )
 
                 max_len = cached_key.value.shape[1]
                 cur = cache_index.value
@@ -169,29 +182,44 @@ class SelfAttention(nn.Module):
                     positions = cur + jnp.arange(s)
                     q = apply_rope(q, positions)
                     k = apply_rope(k, positions)
-                k = lax.dynamic_update_slice(
-                    cached_key.value, k.astype(cached_key.value.dtype),
-                    (0, cur, 0, 0),
+                kc = lax.dynamic_update_slice(
+                    cached_key.value,
+                    k.reshape(flat_kv[0], s, -1).astype(cache_dtype),
+                    (0, cur, 0),
                 )
-                v = lax.dynamic_update_slice(
-                    cached_value.value, v.astype(cached_value.value.dtype),
-                    (0, cur, 0, 0),
+                vc = lax.dynamic_update_slice(
+                    cached_value.value,
+                    v.reshape(flat_kv[0], s, -1).astype(cache_dtype),
+                    (0, cur, 0),
                 )
-                cached_key.value = k
-                cached_value.value = v
+                cached_key.value = kc
+                cached_value.value = vc
                 cache_index.value = cur + s
-                pos_q = cur + jnp.arange(s)
-                mask = jnp.arange(max_len)[None, :] <= pos_q[:, None]
-                if attn_start is not None:
-                    # left-padded prompts (inference.py variable-length
-                    # batching): key positions before each sequence's
-                    # first real token never receive attention
-                    mask = mask[None] & (
-                        jnp.arange(max_len)[None, None, :]
-                        >= attn_start[:, None, None]
-                    )
-                    mask = mask[:, None]  # (b, 1, sq, sk)
-                out = attention_with_mask(q, k, v, mask)
+                if s == 1 and _heads_per_pack(h_, hd_) is not None:
+                    # token step: packed kernel on the flat cache —
+                    # no reshape, O(cur) cache reads
+                    out = decode_attention_packed(
+                        q.reshape(flat_kv[0], 1, -1), kc, vc, cur,
+                        attn_start, n_heads=h_,
+                    ).reshape(flat_kv[0], 1, h_, hd_)
+                else:
+                    # prefill (s = prompt length) or unpackable head
+                    # shapes: reshape the cache once and take the masked
+                    # XLA path (amortized over the whole generation)
+                    k4 = kc.reshape(flat_kv[0], max_len, h_, hd_)
+                    v4 = vc.reshape(flat_kv[0], max_len, h_, hd_)
+                    pos_q = cur + jnp.arange(s)
+                    mask = jnp.arange(max_len)[None, :] <= pos_q[:, None]
+                    if attn_start is not None:
+                        # left-padded prompts (inference.py variable-
+                        # length batching): key positions before each
+                        # sequence's first real token never get attention
+                        mask = mask[None] & (
+                            jnp.arange(max_len)[None, None, :]
+                            >= attn_start[:, None, None]
+                        )
+                        mask = mask[:, None]  # (b, 1, sq, sk)
+                    out = attention_with_mask(q, k4, v4, mask)
         else:
             out = dot_product_attention(
                 q, k, v, causal=self.causal, seq_axis=self.seq_axis,

@@ -15,7 +15,17 @@ from __future__ import annotations
 
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
+
+# Decode query-broadcast tuning, measured on TPU v5e (2026-07-30 profile,
+# BENCHMARKS.md decode section): 8 = the sublane width (smallest MXU row
+# tile); b <= 16 because at larger batches the batch dim already feeds
+# the vector units and the 8x score/prob tensors cost more than the
+# matvec saves (measured 2x SLOWER at bs 64). Other chips may warrant
+# different values — they are constants, not hardware-derived.
+_Q8_ROWS = 8
+_Q8_MAX_BATCH = 16
 
 
 def dot_product_attention(
@@ -71,20 +81,32 @@ def attention_with_mask(q, k, v, mask) -> jnp.ndarray:
     """
     if mask.ndim == 2:
         mask = mask[None, None]
-    if q.shape[1] == 1 and q.shape[0] <= 16:
+    if (
+        q.shape[1] == 1
+        and q.shape[0] <= _Q8_MAX_BATCH
+        and jax.default_backend() != "cpu"
+    ):
         # small-batch single-token decode steps: a 1-row query makes both
         # attention contractions matvecs, which XLA lowers to VPU
         # multiply-reduce loop fusions at ~1/5 of HBM bandwidth — 81% of
         # the decode step in the bs=8 profile (BENCHMARKS.md).
-        # Broadcasting the query to 8 rows (the sublane width) turns them
-        # into real MXU matmuls; rows 1-7 compute the identical result
-        # and are discarded — FLOPs are free in a bandwidth-bound step.
-        # Gated to b <= 16: at larger batches the batch dim already feeds
-        # the vector units and the 8x score/prob tensors cost more than
-        # the matvec saves (measured 2x SLOWER at bs 64).
-        q8 = jnp.broadcast_to(q, (q.shape[0], 8) + q.shape[2:])
-        return _attention(q8, k, v, causal=False, mask=mask)[:, :1]
+        # Since round 4 the hot single-token path uses the packed Pallas
+        # decode kernel (ops/decode_attention.py) instead; this broadcast
+        # remains for unpackable head shapes. Skipped on the CPU backend,
+        # where there is no MXU and the 8x score/prob inflation was never
+        # measured to pay for itself (tests still pin the branch's
+        # numerics by calling _q8_attention directly).
+        return _q8_attention(q, k, v, mask)
     return _attention(q, k, v, causal=False, mask=mask)
+
+
+def _q8_attention(q, k, v, mask) -> jnp.ndarray:
+    """Single-token attention with the query broadcast to _Q8_ROWS
+    sublane rows so both contractions are real MXU matmuls; rows 1..n
+    compute the identical result and are discarded — FLOPs are free in a
+    bandwidth-bound decode step."""
+    q8 = jnp.broadcast_to(q, (q.shape[0], _Q8_ROWS) + q.shape[2:])
+    return _attention(q8, k, v, causal=False, mask=mask)[:, :1]
 
 
 def _attention(q, k, v, *, causal: bool, mask=None) -> jnp.ndarray:

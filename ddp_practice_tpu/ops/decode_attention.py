@@ -1,0 +1,243 @@
+"""Single-token KV-cache decode attention as a packed Pallas TPU kernel.
+
+Why this exists (round 4, measured): the decode KV cache used to be stored
+as (b, L, h, head_dim). On TPU that shape's minor dims (h=12, hd=64) are
+tile-padded, and XLA cannot update such a buffer in place — every
+per-token `dynamic_update_slice` lowered to a full cache relayout copy,
+53.6% of the bs=8 decode step (experiments/decode_profile.py). Probing
+update patterns (experiments/decode_layouts.py) showed in-place DUS DOES
+engage when the dynamic index is on a major dim and the minor dims are
+unpadded: a FLAT (b, L, h*hd) cache updates in 0.2 us instead of 24 us.
+
+XLA attention cannot consume the flat cache per head without a reshape
+(which re-introduces the relayout), but a Pallas kernel can — the same
+trick as ops/flash_attention.py's packed family: the kv tile is a
+(block_l, h*hd) slice of the UNTRANSPOSED cache and the kernel walks
+heads via 64-aligned column slices. So decode runs:
+
+    cache: flat (b, L, h*hd), written in place by dynamic_update_slice
+    step attention: this kernel, directly on the flat cache
+
+Kernel structure — grid (batch, L-blocks), one cell covers ALL heads (a
+head-split grid dim would multiply DMA cell count; the head walk is a
+python-unrolled loop over column slices):
+
+    q (1, h*hd) -> per head: broadcast to 8 sublane rows (1-row matvecs
+      cannot use the MXU; rows 1-7 compute identical results and are
+      discarded — the round-3 q8 trick, now inside the kernel for every
+      batch size)
+    s = q8 @ k_block^T  per head                     # MXU
+    mask: k_pos <= cur  (and k_pos >= attn_start[b] for left-padded
+      prompts) — cur/attn_start arrive via scalar prefetch
+    online softmax accumulate across L-blocks (lane-replicated state,
+      normalized acc — same scheme as the flash kernels)
+
+L-blocks past `cur` are skipped: `@pl.when` gates the compute and the
+index map pins their DMA to block 0 (Pallas elides DMAs whose block
+index is unchanged), so a step at position p reads O(p) cache bytes, not
+O(L) — the einsum path always paid O(L).
+
+The reference has no decode path at all (its model is a CNN classifier);
+this backs the generation stack (inference.py), whose API the LM family
+needs for parity with torch generation loops.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+from ddp_practice_tpu.ops.flash_attention import (
+    _LANES,
+    _NEG_INF,
+    _dot_tb,
+    _heads_per_pack,
+    _softmax_accumulate,
+)
+
+
+def _kernel(
+    cur_ref, start_ref,              # scalar prefetch (SMEM)
+    q_ref, k_ref, v_ref, o_ref,      # blocks
+    m_scr, l_scr, acc_scr,
+    *, sm_scale, block_l, n_heads, d, has_start,
+):
+    b_idx = pl.program_id(0)
+    j = pl.program_id(1)
+    n_j = pl.num_programs(1)
+    cur = cur_ref[0]
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full(m_scr.shape, -jnp.inf, jnp.float32)
+        l_scr[:] = jnp.zeros(l_scr.shape, jnp.float32)
+        acc_scr[:] = jnp.zeros(acc_scr.shape, jnp.float32)
+
+    @pl.when(j * block_l <= cur)
+    def _compute():
+        k_pos = j * block_l + jax.lax.broadcasted_iota(
+            jnp.int32, (8, block_l), 1
+        )
+        valid = k_pos <= cur
+        if has_start:
+            valid &= k_pos >= start_ref[b_idx]
+        penalty = jnp.where(valid, 0.0, _NEG_INF)
+        for hh in range(n_heads):
+            lo, hi = hh * d, (hh + 1) * d
+            qs = (q_ref[:, lo:hi] * sm_scale).astype(q_ref.dtype)  # (1, d)
+            q8 = jnp.broadcast_to(qs, (8, d))
+            s = _dot_tb(q8, k_ref[:, lo:hi]) + penalty   # (8, block_l) f32
+            m_scr[hh], l_scr[hh], acc_scr[:, lo:hi] = _softmax_accumulate(
+                s, v_ref[:, lo:hi], m_scr[hh], l_scr[hh], acc_scr[:, lo:hi]
+            )
+
+    @pl.when(j == n_j - 1)
+    def _finalize():
+        o_ref[:] = acc_scr[:1].astype(o_ref.dtype)
+
+
+def _kernel_single(
+    cur_ref, start_ref,
+    q_ref, k_ref, v_ref, o_ref,
+    *, sm_scale, L, n_heads, d, has_start,
+):
+    """Single-block fast path (whole cache in one tile): plain softmax,
+    no online state, no scratch carry — at large batch the multi-block
+    kernel's per-cell state machinery dominates the step (bs=64 profile,
+    round 4), and a cache that fits one tile needs none of it."""
+    b_idx = pl.program_id(0)
+    cur = cur_ref[0]
+    k_pos = jax.lax.broadcasted_iota(jnp.int32, (8, L), 1)
+    valid = k_pos <= cur
+    if has_start:
+        valid &= k_pos >= start_ref[b_idx]
+    penalty = jnp.where(valid, 0.0, _NEG_INF)
+    for hh in range(n_heads):
+        lo, hi = hh * d, (hh + 1) * d
+        qs = (q_ref[:, lo:hi] * sm_scale).astype(q_ref.dtype)
+        q8 = jnp.broadcast_to(qs, (8, d))
+        s = _dot_tb(q8, k_ref[:, lo:hi]) + penalty       # (8, L) f32
+        m = jnp.max(s, axis=1, keepdims=True)
+        p = jnp.exp(s - m)
+        l = jnp.sum(p, axis=1, keepdims=True)
+        pv = lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[:, lo:hi],
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        o_ref[:, lo:hi] = (pv[:1] / l[:1]).astype(o_ref.dtype)
+
+
+def decode_attention_packed(
+    q: jnp.ndarray,        # (b, 1, h*hd) — the current token's queries
+    k_cache: jnp.ndarray,  # (b, L, h*hd) flat cache
+    v_cache: jnp.ndarray,
+    cur: jnp.ndarray,      # int32 scalar: position of the current token
+    attn_start=None,       # optional (b,) int32: first valid key position
+    *,
+    n_heads: int,
+    block_l: int = 256,
+    single_block_max: int = 1024,
+) -> jnp.ndarray:
+    """One decode step of masked attention over the flat KV cache.
+
+    Valid keys for every query are positions [attn_start[b], cur] (cur
+    INCLUSIVE — the current token attends to itself; the caller writes
+    its K/V at `cur` before calling). Returns (b, 1, h*hd).
+
+    Caches up to `single_block_max` positions run the one-tile plain-
+    softmax kernel; longer caches run the multi-block online-softmax
+    kernel, where `block_l` trades DMA granularity against grid
+    overhead: reads round up to whole blocks past `cur` and skipped
+    blocks cost ~nothing.
+    """
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, sq, hd_total = q.shape
+    if sq != 1:
+        raise ValueError(
+            f"decode_attention_packed is the single-token step kernel "
+            f"(got {sq} query rows); prefill takes the masked XLA path"
+        )
+    L = k_cache.shape[1]
+    d = hd_total // n_heads
+    if _heads_per_pack(n_heads, d) is None:
+        raise ValueError(
+            f"heads={n_heads}, head_dim={d} don't pack into 128-lane tiles"
+        )
+    sm_scale = 1.0 / (d ** 0.5)
+    has_start = attn_start is not None
+
+    cur1 = jnp.asarray(cur, jnp.int32).reshape(1)
+    start = (
+        jnp.asarray(attn_start, jnp.int32)
+        if has_start else jnp.zeros((b,), jnp.int32)
+    )
+    interpret = jax.default_backend() == "cpu"
+    sem = pltpu.CompilerParams
+
+    if L <= single_block_max:
+        kernel = functools.partial(
+            _kernel_single, sm_scale=sm_scale, L=L, n_heads=n_heads, d=d,
+            has_start=has_start,
+        )
+        return pl.pallas_call(
+            kernel,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=2,
+                grid=(b,),
+                in_specs=[
+                    pl.BlockSpec((None, 1, hd_total),
+                                 lambda b_, *_: (b_, 0, 0)),
+                    pl.BlockSpec((None, L, hd_total),
+                                 lambda b_, *_: (b_, 0, 0)),
+                    pl.BlockSpec((None, L, hd_total),
+                                 lambda b_, *_: (b_, 0, 0)),
+                ],
+                out_specs=pl.BlockSpec((None, 1, hd_total),
+                                       lambda b_, *_: (b_, 0, 0)),
+            ),
+            out_shape=jax.ShapeDtypeStruct((b, 1, hd_total), q.dtype),
+            compiler_params=sem(dimension_semantics=("parallel",)),
+            interpret=interpret,
+        )(cur1, start, q, k_cache, v_cache)
+
+    block_l = min(block_l, L)
+    while L % block_l:
+        block_l //= 2
+
+    def kv_map(b_, j, cur_ref, start_ref):
+        return (b_, lax.select(j * block_l <= cur_ref[0], j, 0), 0)
+
+    kernel = functools.partial(
+        _kernel, sm_scale=sm_scale, block_l=block_l, n_heads=n_heads, d=d,
+        has_start=has_start,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(b, L // block_l),
+            in_specs=[
+                pl.BlockSpec((None, 1, hd_total),
+                             lambda b_, j, *_: (b_, 0, 0)),
+                pl.BlockSpec((None, block_l, hd_total), kv_map),
+                pl.BlockSpec((None, block_l, hd_total), kv_map),
+            ],
+            out_specs=pl.BlockSpec((None, 1, hd_total),
+                                   lambda b_, j, *_: (b_, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((n_heads, 8, _LANES), jnp.float32),
+                pltpu.VMEM((n_heads, 8, _LANES), jnp.float32),
+                pltpu.VMEM((8, hd_total), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, 1, hd_total), q.dtype),
+        compiler_params=sem(dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(cur1, start, q, k_cache, v_cache)
+    return out

@@ -100,6 +100,28 @@ def _widen(x128, w):
     return jnp.broadcast_to(x128[:, :1], (x128.shape[0], w))
 
 
+def _softmax_accumulate(s, v_tile, m_prev, l_prev, acc_prev):
+    """One online-softmax accumulation step, shared by every forward
+    kernel (folded, packed, decode): fold the fp32 score tile `s`
+    (rows, block_k) and its value tile into lane-replicated (rows, 128)
+    running max/denominator state and a NORMALIZED accumulator
+    (rows, d). Returns (m_next, l_next, acc_next)."""
+    block_k = s.shape[-1]
+    d = acc_prev.shape[-1]
+    m_next = jnp.maximum(m_prev, jnp.max(s, axis=1)[:, None])
+    p = jnp.exp(s - _widen(m_next, block_k))
+    alpha = jnp.exp(m_prev - m_next)
+    l_corr = alpha * l_prev
+    l_next = l_corr + jnp.sum(p, axis=1)[:, None]
+    l_inv = jnp.where(l_next == 0.0, 1.0, 1.0 / l_next)
+    pv = lax.dot_general(
+        p.astype(v_tile.dtype), v_tile, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    acc_next = acc_prev * _widen(l_corr * l_inv, d) + pv * _widen(l_inv, d)
+    return m_next, l_next, acc_next
+
+
 def _causal_penalty(qi, kj, block_q, block_k, offset):
     """Additive mask for one (q-block, k-block) tile: 0 where query i may
     attend key j (j <= i + offset, offset = seq_k - seq_q), -inf-like
@@ -146,22 +168,8 @@ def _fwd_kernel(
         s = _dot_tb(q, k_ref[:])                       # (bq, bk) fp32
         if causal:
             s = s + _causal_penalty(qi, kj, block_q, block_k, offset)
-        m_prev = m_scr[:]                              # (bq, 128)
-        l_prev = l_scr[:]
-        m_next = jnp.maximum(m_prev, jnp.max(s, axis=1)[:, None])
-        p = jnp.exp(s - _widen(m_next, block_k))
-        alpha = jnp.exp(m_prev - m_next)               # (bq, 128)
-        l_corr = alpha * l_prev
-        l_next = l_corr + jnp.sum(p, axis=1)[:, None]
-        l_inv = jnp.where(l_next == 0.0, 1.0, 1.0 / l_next)
-        m_scr[:] = m_next
-        l_scr[:] = l_next
-        pv = lax.dot_general(                          # p @ v
-            p.astype(v_ref.dtype), v_ref[:], (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        acc_scr[:] = (
-            acc_scr[:] * _widen(l_corr * l_inv, d) + pv * _widen(l_inv, d)
+        m_scr[:], l_scr[:], acc_scr[:] = _softmax_accumulate(
+            s, v_ref[:], m_scr[:], l_scr[:], acc_scr[:]
         )
 
     @pl.when(kj == n_k - 1)
@@ -456,10 +464,13 @@ def _interpret() -> bool:
 
 def _heads_per_pack(h: int, d: int):
     """Packing arity for head_dim d: how many heads share one 128-lane
-    tile. None = shapes don't pack (fall back to the folded path)."""
+    tile. None = shapes don't pack (fall back to the folded path).
+    d < 64 is excluded even when it divides 128: the in-kernel head walk
+    slices columns at h*d offsets, and Mosaic only supports 64-aligned
+    column slices (tpu-env-gotchas)."""
     if d >= _LANES:
         return 1 if d % _LANES == 0 else None
-    if _LANES % d:
+    if d < 64 or _LANES % d:
         return None
     hpc = _LANES // d
     return hpc if h % hpc == 0 else None
@@ -506,24 +517,8 @@ def _fwd_kernel_packed(
             s = _dot_tb(q, k_ref[:, lo:hi])
             if causal:
                 s = s + penalty
-            m_prev = m_scr[hh]
-            l_prev = l_scr[hh]
-            m_next = jnp.maximum(m_prev, jnp.max(s, axis=1)[:, None])
-            p = jnp.exp(s - _widen(m_next, block_k))
-            alpha = jnp.exp(m_prev - m_next)
-            l_corr = alpha * l_prev
-            l_next = l_corr + jnp.sum(p, axis=1)[:, None]
-            l_inv = jnp.where(l_next == 0.0, 1.0, 1.0 / l_next)
-            m_scr[hh] = m_next
-            l_scr[hh] = l_next
-            pv = lax.dot_general(
-                p.astype(v_ref.dtype), v_ref[:, lo:hi],
-                (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
-            acc_scr[:, lo:hi] = (
-                acc_scr[:, lo:hi] * _widen(l_corr * l_inv, d)
-                + pv * _widen(l_inv, d)
+            m_scr[hh], l_scr[hh], acc_scr[:, lo:hi] = _softmax_accumulate(
+                s, v_ref[:, lo:hi], m_scr[hh], l_scr[hh], acc_scr[:, lo:hi]
             )
 
     @pl.when(kj == n_k - 1)

@@ -1,0 +1,122 @@
+"""KV-cache layout shootout for batched decode (VERDICT item 2).
+
+One lm_base-shaped layer (b=8, h=12, hd=64, L=1024), 64-step scan of
+single-token decode bodies; per-op device time via xprof. Layouts:
+
+  A_blhd  — current: cache (b, L, h, hd), DUS at (0, cur, 0, 0),
+            attention_with_mask einsums (q broadcast to 8 rows).
+  B_bhld  — cache (b, h, L, hd), DUS at (0, 0, cur, 0);
+            scores "bhqd,bhld->bhql", pv "bhql,bhld->bhqd".
+  C_bhdl  — seq-minor: cache (b, h, hd, L), DUS at (0, 0, 0, cur);
+            scores "bhqd,bhdl->bhql", pv "bhql,bhdl->bhqd".
+
+All three use an 8-row query broadcast (sublane width) so the dots hit
+the MXU; the result row is sliced back out.
+"""
+import shutil
+import sys
+import tempfile
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+sys.path.insert(0, "/root/repo")
+from ddp_practice_tpu.utils.xprof import op_summary
+
+B, H, HD, L = 8, 12, 64, 1024
+STEPS = 64
+Q8 = 8
+
+
+def attn_a(q, kc, vc, cur):
+    """(b, L, h, hd) cache — the current attention_with_mask path."""
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q,
+                        kc.astype(q.dtype),
+                        preferred_element_type=jnp.float32)
+    scale = 1.0 / jnp.sqrt(jnp.asarray(HD, jnp.float32))
+    mask = (jnp.arange(L)[None, :] <= cur)[None, None]
+    scores = jnp.where(mask, scores * scale, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(q.dtype), vc,
+                      preferred_element_type=jnp.float32).astype(q.dtype)
+
+
+def attn_bh(q, kc, vc, cur, *, seq_minor):
+    """(b, h, L, hd) or (b, h, hd, L) caches; q (b, q8, h, hd)."""
+    qh = jnp.transpose(q, (0, 2, 1, 3))  # (b, h, q8, hd) — tiny
+    if seq_minor:
+        scores = jnp.einsum("bhqd,bhdl->bhql", qh, kc.astype(q.dtype),
+                            preferred_element_type=jnp.float32)
+    else:
+        scores = jnp.einsum("bhqd,bhld->bhql", qh, kc.astype(q.dtype),
+                            preferred_element_type=jnp.float32)
+    scale = 1.0 / jnp.sqrt(jnp.asarray(HD, jnp.float32))
+    mask = (jnp.arange(L)[None, :] <= cur)[None, None]
+    scores = jnp.where(mask, scores * scale, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    if seq_minor:
+        out = jnp.einsum("bhql,bhdl->bhqd", probs, vc,
+                         preferred_element_type=jnp.float32)
+    else:
+        out = jnp.einsum("bhql,bhld->bhqd", probs, vc,
+                         preferred_element_type=jnp.float32)
+    return jnp.transpose(out.astype(q.dtype), (0, 2, 1, 3))
+
+
+def body_a(carry, _):
+    kc, vc, x, cur = carry
+    k = x.reshape(B, 1, H, HD)
+    kc = lax.dynamic_update_slice(kc, k, (0, cur, 0, 0))
+    vc = lax.dynamic_update_slice(vc, k, (0, cur, 0, 0))
+    q8 = jnp.broadcast_to(x.reshape(B, 1, H, HD), (B, Q8, H, HD))
+    out = attn_a(q8, kc, vc, cur)[:, :1]
+    return (kc, vc, out.reshape(B, H * HD), cur + 1), ()
+
+
+def body_b(carry, _):
+    kc, vc, x, cur = carry
+    k = jnp.transpose(x.reshape(B, 1, H, HD), (0, 2, 1, 3))  # (b,h,1,hd)
+    kc = lax.dynamic_update_slice(kc, k, (0, 0, cur, 0))
+    vc = lax.dynamic_update_slice(vc, k, (0, 0, cur, 0))
+    q8 = jnp.broadcast_to(x.reshape(B, 1, H, HD), (B, Q8, H, HD))
+    out = attn_bh(q8, kc, vc, cur, seq_minor=False)[:, :1]
+    return (kc, vc, out.reshape(B, H * HD), cur + 1), ()
+
+
+def body_c(carry, _):
+    kc, vc, x, cur = carry
+    k = jnp.transpose(x.reshape(B, 1, H, HD), (0, 2, 3, 1))  # (b,h,hd,1)
+    kc = lax.dynamic_update_slice(kc, k, (0, 0, 0, cur))
+    vc = lax.dynamic_update_slice(vc, k, (0, 0, 0, cur))
+    q8 = jnp.broadcast_to(x.reshape(B, 1, H, HD), (B, Q8, H, HD))
+    out = attn_bh(q8, kc, vc, cur, seq_minor=True)[:, :1]
+    return (kc, vc, out.reshape(B, H * HD), cur + 1), ()
+
+
+def run_case(name, body, cache_shape):
+    @jax.jit
+    def loop(x):
+        kc = jnp.zeros(cache_shape, jnp.bfloat16)
+        vc = jnp.zeros(cache_shape, jnp.bfloat16)
+        carry, _ = lax.scan(body, (kc, vc, x, jnp.int32(0)), None,
+                            length=STEPS)
+        return jnp.float32(carry[2].astype(jnp.float32).sum())
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (B, H * HD), jnp.bfloat16)
+    float(loop(x))
+    tmp = tempfile.mkdtemp(prefix="xp_lay_")
+    with jax.profiler.trace(tmp):
+        float(loop(x))
+    s = op_summary(tmp)
+    shutil.rmtree(tmp, ignore_errors=True)
+    total = s["total_ps"] / 1e9 / STEPS
+    dus = s["categories"].get("dynamic-update-slice", {"ps": 0})["ps"] / 1e9 / STEPS
+    print(f"{name}: {total*1e3:7.1f} us/step total, DUS {dus*1e3:6.1f} us "
+          f"({100*dus/max(total,1e-9):.0f}%)")
+
+
+if __name__ == "__main__":
+    run_case("A_blhd (current)", body_a, (B, L, H, HD))
+    run_case("B_bhld          ", body_b, (B, H, L, HD))
+    run_case("C_bhdl seq-minor", body_c, (B, H, HD, L))

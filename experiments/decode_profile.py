@@ -1,0 +1,47 @@
+"""Profile the batched decode step (bs=8, lm_base) — per-op device time.
+Identifies the KV-cache update copy the round-3 profile measured at ~47%
+of the step (VERDICT item 2)."""
+import shutil
+import sys
+import tempfile
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, "/root/repo")
+
+
+def main(batch=8, new_tokens=64):
+    from ddp_practice_tpu.config import PrecisionPolicy
+    from ddp_practice_tpu.inference import (
+        cast_params_for_streaming, make_generate_fn)
+    from ddp_practice_tpu.models import create_model
+    from ddp_practice_tpu.utils.xprof import print_summary
+
+    policy = PrecisionPolicy.from_name("bf16")
+    model = create_model("lm_base", policy=policy, vocab_size=32768,
+                         max_len=1024, pos_emb="rope")
+    prompt = jnp.ones((batch, 128), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+    params = cast_params_for_streaming(params)
+    gen = jax.jit(make_generate_fn(model, max_new_tokens=new_tokens,
+                                   temperature=1.0))
+    key = jax.random.PRNGKey(1)
+    toks = gen(params, prompt, key)
+    toks.block_until_ready()
+    _ = int(toks[0, -1])
+    tmp = tempfile.mkdtemp(prefix="xp_dec_")
+    with jax.profiler.trace(tmp):
+        toks = gen(params, prompt, key)
+        _ = int(toks[0, -1])
+    print_summary(tmp, steps=new_tokens, top=18)
+    shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    import argparse
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--new", type=int, default=64)
+    a = p.parse_args()
+    main(a.batch, a.new)

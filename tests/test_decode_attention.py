@@ -1,0 +1,107 @@
+"""Packed decode-attention kernel (ops/decode_attention.py): numerics
+pinned to the masked XLA reference on the CPU backend (interpret mode),
+covering the single-block fast path, the multi-block online-softmax
+path, prefix masking, and left-padded (attn_start) prompts."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ddp_practice_tpu.ops.attention import attention_with_mask
+from ddp_practice_tpu.ops.decode_attention import decode_attention_packed
+
+B, H, HD = 3, 4, 64
+
+
+def _setup(L, cur, seed=0):
+    rng = np.random.default_rng(seed)
+    q = jnp.asarray(rng.normal(size=(B, 1, H * HD)), jnp.float32)
+    kc = jnp.asarray(rng.normal(size=(B, L, H * HD)), jnp.float32)
+    vc = jnp.asarray(rng.normal(size=(B, L, H * HD)), jnp.float32)
+    return q, kc, vc, jnp.int32(cur)
+
+
+def _reference(q, kc, vc, cur, attn_start=None):
+    L = kc.shape[1]
+    mask = jnp.arange(L)[None, :] <= cur[..., None]
+    if attn_start is not None:
+        mask = mask[None] & (
+            jnp.arange(L)[None, None, :] >= attn_start[:, None, None]
+        )
+        mask = mask[:, None]
+    q4 = q.reshape(B, 1, H, HD)
+    k4 = kc.reshape(B, -1, H, HD)
+    v4 = vc.reshape(B, -1, H, HD)
+    return attention_with_mask(q4, k4, v4, mask).reshape(B, 1, H * HD)
+
+
+@pytest.mark.parametrize("L,cur", [(256, 0), (256, 100), (256, 255)])
+def test_single_block_matches_reference(L, cur):
+    q, kc, vc, c = _setup(L, cur)
+    got = decode_attention_packed(q, kc, vc, c, n_heads=H)
+    want = _reference(q, kc, vc, jnp.asarray(cur))
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5
+    )
+
+
+@pytest.mark.parametrize("cur", [3, 700, 1500])
+def test_multi_block_matches_reference(cur):
+    """L > single_block_max exercises the online-softmax sweep with
+    blocks past `cur` skipped (their DMA pinned to block 0)."""
+    L = 2048
+    q, kc, vc, c = _setup(L, cur, seed=1)
+    got = decode_attention_packed(q, kc, vc, c, n_heads=H,
+                                  block_l=512, single_block_max=1024)
+    want = _reference(q, kc, vc, jnp.asarray(cur))
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5
+    )
+
+
+@pytest.mark.parametrize("L", [256, 2048])
+def test_attn_start_left_padding(L):
+    """Per-sequence first-valid-key masking (left-padded prompts)."""
+    cur = min(L - 1, 900)
+    q, kc, vc, c = _setup(L, cur, seed=2)
+    start = jnp.asarray([0, 5, min(cur, 60)], jnp.int32)
+    got = decode_attention_packed(q, kc, vc, c, start, n_heads=H,
+                                  single_block_max=1024)
+    want = _reference(q, kc, vc, jnp.asarray(cur), attn_start=start)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_rejects_multi_row_queries():
+    q, kc, vc, c = _setup(128, 4)
+    q2 = jnp.concatenate([q, q], axis=1)
+    with pytest.raises(ValueError, match="single-token"):
+        decode_attention_packed(q2, kc, vc, c, n_heads=H)
+
+
+def test_rejects_unpackable_heads():
+    rng = np.random.default_rng(3)
+    q = jnp.asarray(rng.normal(size=(1, 1, 3 * 64)), jnp.float32)
+    kc = jnp.asarray(rng.normal(size=(1, 64, 3 * 64)), jnp.float32)
+    with pytest.raises(ValueError, match="pack"):
+        decode_attention_packed(q, kc, kc, jnp.int32(0), n_heads=3)
+
+
+def test_q8_broadcast_matches_plain():
+    """The q8 MXU-broadcast branch of attention_with_mask (live on TPU
+    for unpackable head shapes) must equal the plain 1-row path — pinned
+    here directly since the backend gate keeps it off the CPU suite."""
+    from ddp_practice_tpu.ops.attention import _attention, _q8_attention
+
+    rng = np.random.default_rng(9)
+    q = jnp.asarray(rng.normal(size=(2, 1, 3, 32)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(2, 40, 3, 32)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(2, 40, 3, 32)), jnp.float32)
+    mask = (jnp.arange(40)[None, :] <= 17)[None, None]
+    want = _attention(q, k, v, causal=False, mask=mask)
+    got = _q8_attention(q, k, v, mask)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5
+    )
