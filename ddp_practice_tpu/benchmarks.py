@@ -211,6 +211,16 @@ def bench_lm_train(
     learning_rate: float = 3e-4,
     model_kwargs: Optional[dict] = None,
     seed: int = 0,
+    # "random": uniform randint tokens drawn on device (pure compute-rate
+    # measurement). "corpus": device-resident windows of the synthetic
+    # Markov byte corpus (data/lm_corpus.py) — vocab_size follows the
+    # corpus. The MoE entry benches on the corpus: router balance is a
+    # property of TRAINED routing, and uniform-random tokens leave
+    # embeddings untrained (each of 32k ids seen ~0.5x per batch), so the
+    # router chases drifting inputs and the recorded health is
+    # meaningless (measured: drop oscillates 0.10-0.45 on random tokens
+    # vs <2% warm on the corpus at identical model dims).
+    data: str = "random",
 ) -> dict:
     """Steady-state LM training throughput at long sequence length:
     tokens/sec/chip + MFU. Same fenced-timing methodology as bench_train;
@@ -239,6 +249,15 @@ def bench_lm_train(
     mesh = build_mesh(MeshConfig(data=-1))
     set_current_mesh(mesh)
     try:
+        corpus_windows = None
+        if data == "corpus":
+            from ddp_practice_tpu.data.lm_corpus import synthetic_token_corpus
+
+            c = synthetic_token_corpus(n_tokens=1 << 20, seed=seed + 7)
+            vocab_size = c.vocab_size
+            corpus_windows = jnp.asarray(c.windows(seq_len))
+        elif data != "random":
+            raise ValueError(f"unknown data source {data!r}")
         policy = PrecisionPolicy.from_name(precision)
         kwargs = dict(
             vocab_size=vocab_size, max_len=seq_len, attn_impl=attn_impl
@@ -270,10 +289,17 @@ def bench_lm_train(
 
         def chunk(state):
             def body(st, key):
-                tokens = jax.random.randint(
-                    key, (batch_size, seq_len + 1), 0, vocab_size,
-                    dtype=jnp.int32,
-                )
+                if corpus_windows is not None:
+                    idx = jax.random.randint(
+                        key, (batch_size,), 0, corpus_windows.shape[0],
+                        dtype=jnp.int32,
+                    )
+                    tokens = corpus_windows[idx]
+                else:
+                    tokens = jax.random.randint(
+                        key, (batch_size, seq_len + 1), 0, vocab_size,
+                        dtype=jnp.int32,
+                    )
                 batch = {"tokens": lax.with_sharding_constraint(tokens, bsh)}
                 return step_fn(st, batch)
 

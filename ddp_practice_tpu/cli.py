@@ -35,7 +35,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--model", default="convnet",
                    choices=["convnet", "resnet18", "resnet50", "vit_tiny",
                             "vit_base", "vit_tiny_moe", "vit_tiny_pipe",
-                            "lm_tiny", "lm_base", "lm_pipe"])
+                            "lm_tiny", "lm_base", "lm_moe", "lm_pipe"])
     p.add_argument("--num_heads", type=int, default=0,
                    help="override attention head count (transformer models; "
                         "0 = model default — note tensor parallelism needs "

@@ -44,6 +44,17 @@ class TokenCorpus:
     def __len__(self) -> int:
         return len(self.tokens)
 
+    def windows(self, seq_len: int) -> np.ndarray:
+        """Non-overlapping (n_windows, seq_len + 1) int32 training windows
+        (inputs + shifted targets). The device-resident random-draw
+        convention shared by the MoE bench, the balance test, and the
+        experiments (one source so the windowing can never drift)."""
+        window = seq_len + 1
+        n_win = len(self.tokens) // window
+        return np.asarray(
+            self.tokens[: n_win * window], np.int32
+        ).reshape(n_win, window)
+
 
 def load_text_corpus(path: str, name: Optional[str] = None) -> TokenCorpus:
     """Byte-level corpus from one file or every regular file in a

@@ -102,12 +102,15 @@ def load_lm(args) -> tuple:
         # inference needs no fp32 masters: stream bf16 params (half the
         # HBM traffic per decode step; bit-identical under this policy)
         params = cast_params_for_streaming(params)
-    return model, jax.device_put(params), int(extra.get("step", -1))
+    # non-param state (lm_moe router selection bias) rides along so
+    # generation routes like training did (inference.make_generate_fn)
+    return (model, jax.device_put(params), state.batch_stats,
+            int(extra.get("step", -1)))
 
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
-    model, params, step = load_lm(args)
+    model, params, batch_stats, step = load_lm(args)
     prompt = jnp.asarray(encode_bytes(args.prompt))
     gen = jax.jit(
         make_generate_fn(
@@ -117,6 +120,7 @@ def main(argv=None) -> int:
             top_k=args.top_k,
             top_p=args.top_p,
             eos_id=args.eos_id,
+            batch_stats=batch_stats,
         )
     )
     key = jax.random.PRNGKey(args.seed)

@@ -121,6 +121,7 @@ def make_generate_fn(
     top_p: float = 0.0,
     eos_id: Optional[int] = None,
     pad_id: int = 0,
+    batch_stats: Any = None,
 ) -> Callable[[Any, jnp.ndarray, Optional[jax.Array]], jnp.ndarray]:
     """Build `gen(params, prompt, key) -> tokens` for a decode-capable model.
 
@@ -129,6 +130,11 @@ def make_generate_fn(
     (b, prompt_len + max_new_tokens) with the prompt copied through. Wrap
     the returned function in `jax.jit` (the generate CLI and tests do); all
     sampling parameters are closed over as compile-time constants.
+
+    `batch_stats`: the checkpoint's non-param state, REQUIRED for MoE
+    models to route like they trained — the router's aux-free selection
+    bias lives there (ops/moe.py); without it selection falls back to the
+    raw gates. The tiny (E,)-sized leaves close over as jit constants.
     """
 
     def gen(params, prompt, key=None, prompt_lens=None):
@@ -159,8 +165,11 @@ def make_generate_fn(
             )
             attn_start = (prompt_len - lens).astype(jnp.int32)
         cache = make_cache(model, b, total)
+        variables = {"params": params, "cache": cache}
+        if batch_stats is not None:
+            variables["batch_stats"] = batch_stats
         logits, mut = model.apply(
-            {"params": params, "cache": cache},
+            variables,
             prompt,
             decode=True,
             mutable=["cache"],
@@ -179,8 +188,11 @@ def make_generate_fn(
             tok = jnp.where(done, jnp.asarray(pad_id, jnp.int32), tok)
             if eos_id is not None:
                 done = done | (tok == eos_id)
+            step_vars = {"params": params, "cache": cache}
+            if batch_stats is not None:
+                step_vars["batch_stats"] = batch_stats
             logits, mut = model.apply(
-                {"params": params, "cache": cache},
+                step_vars,
                 tok[:, None],
                 decode=True,
                 mutable=["cache"],

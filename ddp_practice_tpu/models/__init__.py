@@ -160,6 +160,12 @@ def _lm_moe(*, num_classes, policy, axis_name, **kw):
     # layout); dims default to lm_tiny's — the bench sizes it up via
     # model_kwargs
     kw.setdefault("moe_every", 2)
+    # top-2 capacity headroom 2.0 (the GShard convention): per-GROUP
+    # routing correlation (tokens of one sequence share context, so they
+    # crowd the same experts) sets a drop floor that no global balancing
+    # signal can remove — measured ~10% at cf 1.25 vs <2% at 2.0 with a
+    # warm router (BENCHMARKS.md round-4 MoE section)
+    kw.setdefault("capacity_factor", 2.0)
     return LMTiny(
         dtype=policy.compute_dtype,
         param_dtype=policy.param_dtype,

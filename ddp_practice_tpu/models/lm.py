@@ -72,6 +72,13 @@ class TransformerLM(nn.Module):
     num_experts: int = 8
     moe_top_k: int = 2
     capacity_factor: float = 1.25
+    # load-balance aux-loss weight: 0.01 (Switch/GShard convention) keeps
+    # the warm router's drop rate ~10% on unstructured data; the bench
+    # and balance test use the same knob (ops/moe.py top_k_gating)
+    moe_aux_weight: float = 0.01
+    # online selection-bias update rate (ops/moe.py MoEMlp
+    # bias_update_rate); 0 disables the aux-free balancer
+    moe_bias_rate: float = 0.02
     axis_name: Optional[str] = None  # registry uniformity (no BN anywhere)
 
     @nn.compact
@@ -180,6 +187,8 @@ class TransformerLM(nn.Module):
                 num_experts=self.num_experts,
                 moe_top_k=self.moe_top_k,
                 capacity_factor=self.capacity_factor,
+                moe_aux_weight=self.moe_aux_weight,
+                moe_bias_rate=self.moe_bias_rate,
                 name=f"block{i}",
             )
             # positional (decode, train): nn.remat's static_argnums are

@@ -258,6 +258,8 @@ class EncoderBlock(nn.Module):
     num_experts: int = 8
     moe_top_k: int = 2
     capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
+    moe_bias_rate: float = 0.02
     # run the whole layer as ONE Pallas kernel per direction
     # (ops/fused_encoder.py): the HBM-bound small-d regime's fix
     # (BENCHMARKS.md ViT-Tiny analysis). Short-sequence bidirectional
@@ -317,6 +319,8 @@ class EncoderBlock(nn.Module):
                 num_experts=self.num_experts,
                 top_k=self.moe_top_k,
                 capacity_factor=self.capacity_factor,
+                aux_loss_weight=self.moe_aux_weight,
+                bias_update_rate=self.moe_bias_rate,
                 mlp_dim=self.mlp_dim,
                 dtype=self.dtype,
                 param_dtype=self.param_dtype,

@@ -51,23 +51,42 @@ def top_k_gating(
     *,
     k: int,
     capacity: int,
+    routing_bias: Optional[jnp.ndarray] = None,  # (E,) selection-only
 ):
-    """Return (dispatch (G,T,E,C) bool-ish, combine (G,T,E,C), aux_loss).
+    """Return (dispatch (G,T,E,C), combine (G,T,E,C), aux_loss, demand).
 
     Iterative top-k: pick the best expert per token, compute each token's
     position within that expert's buffer by a cumsum over the token dim,
     drop tokens past `capacity`, mask the chosen expert out, repeat. All
     dense ops — compiles to static-shape TPU code.
+
+    `routing_bias` biases SELECTION only (which experts a token goes to),
+    never the combine weights — the aux-free online balancing signal
+    (MoEMlp maintains it; the DeepSeek-V3 scheme). `demand` is the (E,)
+    pre-drop share of the k*T assignment slots each expert attracted —
+    the overload signal the bias update consumes.
     """
     g, t, e = router_logits.shape
     gates = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+    if routing_bias is not None:
+        sel = jax.nn.softmax(
+            router_logits.astype(jnp.float32)
+            + routing_bias.astype(jnp.float32), axis=-1
+        )
+    else:
+        sel = gates
 
-    remaining = gates
+    remaining = sel
     fill = jnp.zeros((g, e), jnp.float32)  # tokens already claimed per expert
     dispatch = jnp.zeros((g, t, e, capacity), jnp.float32)
+    first_choice = None
+    demand = jnp.zeros((e,), jnp.float32)
     for _ in range(k):
         choice = jnp.argmax(remaining, axis=-1)              # (G, T)
         onehot = jax.nn.one_hot(choice, e, dtype=jnp.float32)  # (G, T, E)
+        demand = demand + jnp.mean(onehot, axis=(0, 1)) / k
+        if first_choice is None:
+            first_choice = onehot
         pos = (
             jnp.cumsum(onehot, axis=1) - onehot + fill[:, None, :]
         )  # (G, T, E): position within expert buffer
@@ -90,11 +109,17 @@ def top_k_gating(
     gsel = gsel / jnp.maximum(jnp.sum(gsel, axis=-1, keepdims=True), 1e-9)
     combine = dispatch * gsel[..., None]
 
-    # Switch-style load-balance loss: E * sum_e fraction_e * prob_e
-    frac = jnp.mean(dispatched_expert, axis=(0, 1))          # (E,) usage
+    # Switch-style load-balance loss: E * sum_e fraction_e * prob_e, with
+    # frac from the PRE-DROP first-choice assignments (Switch eq. 4). An
+    # earlier version used the post-drop dispatched counts — self-
+    # defeating: an over-capacity expert's fraction saturates at
+    # capacity, so the loss could not see (or penalize) overload beyond
+    # it, and raising the aux weight made balance WORSE (measured,
+    # BENCHMARKS.md round-4 MoE section).
+    frac = jnp.mean(first_choice, axis=(0, 1))               # (E,) demand
     prob = jnp.mean(gates, axis=(0, 1))                      # (E,) router mass
     aux = e * jnp.sum(frac * prob)
-    return dispatch, combine, aux
+    return dispatch, combine, aux, demand
 
 
 class MoEMlp(nn.Module):
@@ -105,6 +130,16 @@ class MoEMlp(nn.Module):
     capacity_factor: float = 1.25
     mlp_dim: int = 768
     aux_loss_weight: float = 0.01
+    # aux-free online balancing (the DeepSeek-V3 scheme): a NON-LEARNED
+    # per-expert bias nudges SELECTION (never combine weights) against
+    # measured overload each training step: b -= rate * sign(demand -
+    # 1/E). Unlike the gradient aux loss, it acts on the argmax directly,
+    # so it balances even when hidden states share a dominant common-mode
+    # direction (measured: the aux loss alone plateaued at ~10% drops and
+    # OSCILLATED when strengthened — BENCHMARKS.md round-4 MoE section).
+    # Lives in "batch_stats" so it rides the existing non-param state
+    # plumbing (train/steps.py, checkpointing). 0 disables.
+    bias_update_rate: float = 0.02
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
     expert_axis: Optional[str] = MeshConfig.AXIS_EXPERT
@@ -125,9 +160,29 @@ class MoEMlp(nn.Module):
             name="router",
         )
         logits = router(x.astype(jnp.float32))               # (G, T, E)
-        dispatch, combine, aux = top_k_gating(
-            logits, k=self.top_k, capacity=capacity
+        # decode/eval paths may apply without the batch_stats collection
+        # (generate.py builds variables from params + cache only): route
+        # with no bias there — selection then follows the raw gates,
+        # which the aux loss keeps roughly balanced
+        bias = None
+        if self.is_initializing() or self.has_variable(
+            "batch_stats", "router_bias"
+        ):
+            bias = self.variable(
+                "batch_stats", "router_bias",
+                lambda: jnp.zeros((e,), jnp.float32),
+            )
+        dispatch, combine, aux, demand = top_k_gating(
+            logits, k=self.top_k, capacity=capacity,
+            routing_bias=None if bias is None else bias.value,
         )
+        if bias is not None and self.is_mutable_collection(
+            "batch_stats"
+        ) and self.bias_update_rate > 0.0:
+            bias.value = jax.lax.stop_gradient(
+                bias.value - self.bias_update_rate
+                * jnp.sign(demand - 1.0 / e)
+            )
         self.sow("intermediates", "moe_aux_loss", self.aux_loss_weight * aux)
         # router health (diagnostic sows — no "aux_loss" in the name, so
         # they never join the objective; train/steps.py surfaces them as

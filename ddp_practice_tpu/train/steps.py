@@ -248,13 +248,23 @@ def _lm_train_step_fn(model, tx, label_smoothing: float = 0.0, seed: int = 0):
         tokens = batch["tokens"]
         inputs, targets = tokens[:, :-1], tokens[:, 1:]
         weight = batch.get("weight")
+        # lm_moe routers keep their aux-free balancing bias in
+        # batch_stats (ops/moe.py MoEMlp) — threaded through the step
+        # exactly like BatchNorm stats in the image step above
+        has_stats = state.batch_stats is not None
 
         def loss_fn(params):
+            variables = {"params": params}
+            mutable = ["intermediates"]
+            if has_stats:
+                variables["batch_stats"] = state.batch_stats
+                mutable.append("batch_stats")
             logits, updated = model.apply(
-                {"params": params}, inputs, train=True,
-                mutable=["intermediates"],
+                variables, inputs, train=True,
+                mutable=mutable,
                 rngs=_step_rngs(state.step, seed),
             )
+            new_stats = updated["batch_stats"] if has_stats else None
             loss = cross_entropy(
                 logits, targets, weight=weight,
                 label_smoothing=label_smoothing,
@@ -263,7 +273,7 @@ def _lm_train_step_fn(model, tx, label_smoothing: float = 0.0, seed: int = 0):
             # MoE blocks (lm_moe) sow their load-balance loss + router
             # health here, exactly like the image step
             loss = loss + _sown_aux_loss(inter)
-            return loss, (logits, inter)
+            return loss, (logits, new_stats, inter)
 
         if getattr(model, "schedule", None) == "1f1b":
             # memory-bounded pipeline: the model runs its own fwd+bwd
@@ -277,8 +287,15 @@ def _lm_train_step_fn(model, tx, label_smoothing: float = 0.0, seed: int = 0):
             )
             correct, total = counts["correct"], counts["total"]
             inter = {}
+            # pipelined LMs carry no non-param state; a future pipelined
+            # MoE would need its router bias threaded through the
+            # schedule, not silently dropped here
+            assert state.batch_stats is None, (
+                "1F1B schedule does not thread batch_stats"
+            )
+            new_stats = None
         else:
-            (loss, (logits, inter)), grads = jax.value_and_grad(
+            (loss, (logits, new_stats, inter)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True
             )(state.params)
             correct, total = accuracy_counts(logits, targets, weight=weight)
@@ -294,7 +311,7 @@ def _lm_train_step_fn(model, tx, label_smoothing: float = 0.0, seed: int = 0):
         new_state = TrainState(
             step=state.step + 1,
             params=new_params,
-            batch_stats=None,
+            batch_stats=new_stats,
             opt_state=new_opt_state,
         )
         return new_state, metrics
@@ -372,7 +389,11 @@ def make_lm_eval_step(model, *, mesh=None, state_shardings=None,
     def eval_step(state: TrainState, batch):
         tokens = batch["tokens"]
         inputs, targets = tokens[:, :-1], tokens[:, 1:]
-        logits = model.apply({"params": state.params}, inputs, train=False)
+        variables = {"params": state.params}
+        if state.batch_stats is not None:
+            # lm_moe router balancing bias (read-only at eval)
+            variables["batch_stats"] = state.batch_stats
+        logits = model.apply(variables, inputs, train=False)
         correct, total = accuracy_counts(logits, targets)
         nll = cross_entropy(logits, targets) * total
         return correct, total, nll
