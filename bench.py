@@ -79,6 +79,18 @@ _SUITE = {
             "mlp_dim": 3072, "moe_every": 2, "num_experts": 8,
         },
     ),
+    # short-seq decoder LM through the fused Pallas encoder-layer kernels
+    # (round 4: ops/fused_encoder.py grew causal masking) — the d=256
+    # HBM-bound regime's fix applied to the LM family. heads=4 keeps
+    # head_dim 64 (the kernel's 64-aligned column-slice contract);
+    # attn_impl stays xla (the whole layer IS the kernel). Companion
+    # unfused number in BENCHMARKS.md: 1.70x.
+    "lm_tiny_fused": dict(
+        kind="lm", model="lm_tiny", seq_len=256, batch_size=256,
+        steps_per_call=16, calls=4, warmup_calls=2, attn_impl="xla",
+        data="corpus",
+        model_kwargs={"num_heads": 4, "fused": True},
+    ),
     "lm_8k": dict(
         kind="lm", seq_len=8192, batch_size=2, steps_per_call=2, calls=3,
     ),
@@ -109,8 +121,8 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser("bench")
     p.add_argument("--models",
                    default="vit_base,vit_tiny,vit_tiny_fused,convnet,"
-                           "resnet18,resnet50,lm_long,lm_moe,lm_decode,"
-                           "lm_decode_bs1",
+                           "resnet18,resnet50,lm_long,lm_moe,lm_tiny_fused,"
+                           "lm_decode,lm_decode_bs1",
                    help="comma-separated; first successful is the headline")
     p.add_argument("--precision", default="bf16", choices=["fp32", "bf16"])
     p.add_argument("--batch_size", type=int, default=0, help="override")

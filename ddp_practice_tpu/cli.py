@@ -144,8 +144,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "skip compile); auto = ~/.cache/ddp_practice_tpu/xla, "
                         "off = disable")
     p.add_argument("--fused", action="store_true",
-                   help="run ViT encoder layers as fused Pallas kernels "
-                        "(ops/fused_encoder.py — the small-d HBM-bound fix)")
+                   help="run encoder layers as fused Pallas kernels "
+                        "(ops/fused_encoder.py — the small-d HBM-bound "
+                        "fix; vit_tiny, or dense LMs with head_dim >= 64 "
+                        "via --num_heads)")
     p.add_argument("--augment", action="store_true",
                    help="on-device random crop + horizontal flip inside the "
                         "jitted train step (image models; deterministic per "

@@ -79,6 +79,13 @@ class TransformerLM(nn.Module):
     # online selection-bias update rate (ops/moe.py MoEMlp
     # bias_update_rate); 0 disables the aux-free balancer
     moe_bias_rate: float = 0.02
+    # run each block as ONE Pallas kernel per direction with causal
+    # masking (ops/fused_encoder.py, round 4) — the small-d short-seq
+    # HBM-bound fix, now available to decoder LMs. Training-only
+    # execution strategy: params are identical to the unfused model, so
+    # checkpoints generate through the normal (unfused) decode path.
+    # Composes with pos_emb="learned" only (the kernel refuses rope).
+    fused: bool = False
     axis_name: Optional[str] = None  # registry uniformity (no BN anywhere)
 
     @nn.compact
@@ -189,6 +196,7 @@ class TransformerLM(nn.Module):
                 capacity_factor=self.capacity_factor,
                 moe_aux_weight=self.moe_aux_weight,
                 moe_bias_rate=self.moe_bias_rate,
+                fused=self.fused and not decode,
                 name=f"block{i}",
             )
             # positional (decode, train): nn.remat's static_argnums are

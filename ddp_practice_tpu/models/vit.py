@@ -277,15 +277,15 @@ class EncoderBlock(nn.Module):
         # only accepts non-array arguments at static positions. attn_start
         # (an array) is decode-only, where remat never applies.
         if self.fused and not self.is_initializing():
-            if (decode or self.causal or self.rope
+            if (decode or self.rope
                     or self.seq_axis is not None
                     or self.use_moe or self.dropout_rate > 0.0
                     or self.attn_impl != "xla"):
                 raise ValueError(
-                    "fused encoder layer supports the plain bidirectional "
-                    "block only (no decode/causal/rope/seq-parallel/MoE/"
-                    "dropout/attn_impl override) — those paths keep the "
-                    "per-op pipeline"
+                    "fused encoder layer supports plain blocks only — "
+                    "bidirectional or causal (round 4) — with no decode/"
+                    "rope/seq-parallel/MoE/dropout/attn_impl override; "
+                    "those paths keep the per-op pipeline"
                 )
             from ddp_practice_tpu.ops.fused_encoder import (
                 fused_encoder_layer,
@@ -295,6 +295,7 @@ class EncoderBlock(nn.Module):
                 x, self.variables["params"],
                 num_heads=self.num_heads,
                 compute_dtype=self.dtype,
+                causal=self.causal,
             )
         y = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype, name="ln1")(x)
         y = SelfAttention(

@@ -99,7 +99,7 @@ def _bdot(a, b, contract_a, contract_b, cd):
 
 def _fwd_core(xt, imgs, s, ln1_s, ln1_b, wqkv, bqkv, wproj, bproj,
               ln2_s, ln2_b, w_in, b_in, w_out, b_out,
-              *, num_heads, head_dim, compute_dtype):
+              *, num_heads, head_dim, compute_dtype, causal=False):
     """The whole layer on a (t, d) fp32 token tile; returns every
     intermediate the backward needs (the fwd kernel uses `out` only and
     the compiler drops the rest).
@@ -119,6 +119,14 @@ def _fwd_core(xt, imgs, s, ln1_s, ln1_b, wqkv, bqkv, wproj, bproj,
     y1a, y1hat, r1 = _layer_norm(xt, ln1_s, ln1_b)
     qkv = _mm(y1a, wqkv, cd) + bqkv                   # (t, 3*h*hd)
     scale = 1.0 / (hd ** 0.5)
+    # causal (decoder-LM) masking: one (s, s) additive penalty shared by
+    # every image and head; exp(-1e30) -> 0 so the softmax bwd's p-zeros
+    # make the masked positions' gradients vanish without extra masking
+    penalty = None
+    if causal:
+        qpos = jax.lax.broadcasted_iota(jnp.int32, (s, s), 0)
+        kpos = jax.lax.broadcasted_iota(jnp.int32, (s, s), 1)
+        penalty = jnp.where(qpos >= kpos, 0.0, -1e30)[None]
     proj_acc = jnp.zeros((t, d), f32)
     heads = []
     for hi in range(h):
@@ -130,6 +138,8 @@ def _fwd_core(xt, imgs, s, ln1_s, ln1_b, wqkv, bqkv, wproj, bproj,
         k = head_slice(h * hd)
         v = head_slice(2 * h * hd)
         scores = _bdot(q, k, 2, 2, cd) * scale        # (imgs, s, s)
+        if causal:
+            scores = scores + penalty
         scores = scores - jnp.max(scores, axis=-1, keepdims=True)
         p = jnp.exp(scores)
         p = p / jnp.sum(p, axis=-1, keepdims=True)
@@ -164,7 +174,7 @@ def _weights_f32(ln1_s, ln1_b, wqkv, bqkv, wproj, bproj, ln2_s, ln2_b,
 def _fused_kernel(
     x_ref, ln1_s, ln1_b, wqkv, bqkv, wproj, bproj, ln2_s, ln2_b,
     w_in, b_in, w_out, b_out, o_ref,
-    *, num_heads, head_dim, compute_dtype,
+    *, num_heads, head_dim, compute_dtype, causal,
 ):
     """Forward grid cell: the full encoder layer for `img_tile` images."""
     imgs, s, d = x_ref.shape
@@ -174,6 +184,7 @@ def _fused_kernel(
         *_weights_f32(ln1_s, ln1_b, wqkv, bqkv, wproj, bproj, ln2_s,
                       ln2_b, w_in, b_in, w_out, b_out),
         num_heads=num_heads, head_dim=head_dim, compute_dtype=compute_dtype,
+        causal=causal,
     )
     o_ref[:] = core["out"].reshape(imgs, s, d).astype(o_ref.dtype)
 
@@ -183,7 +194,7 @@ def _fused_bwd_kernel(
     w_in, b_in, w_out, b_out,
     dx_ref, dln1_s, dln1_b, dwqkv, dbqkv, dwproj, dbproj, dln2_s, dln2_b,
     dw_in, db_in, dw_out, db_out,
-    *, num_heads, head_dim, compute_dtype,
+    *, num_heads, head_dim, compute_dtype, causal,
 ):
     """Backward grid cell: recompute the tile's forward in VMEM, then the
     hand-derived transposes. Weight-gradient outputs map every cell to
@@ -204,6 +215,7 @@ def _fused_bwd_kernel(
     core = _fwd_core(
         xt, imgs, s, *ws,
         num_heads=num_heads, head_dim=head_dim, compute_dtype=cd,
+        causal=causal,
     )
 
     @pl.when(pl.program_id(0) == 0)
@@ -277,17 +289,24 @@ def _fit_tile(n, tile):
     return max(tile, 1)
 
 
-def _auto_tile(imgs, s, compute_dtype, *, fwd: bool):
+def _auto_tile(imgs, s, compute_dtype, *, fwd: bool, d: int = 192,
+               mlp_dim: int = 768, num_heads: int = 3):
     """Default images-per-cell honoring the 16 MB scoped-VMEM budget.
 
-    Calibrated on v5e at d=192/mlp 768: the forward fits 2048 bf16-compute
-    tokens per cell (tile 32 at s=64 — the bench shape), the backward 256
-    (~3x the live intermediates); fp32 compute doubles the matmul operand
-    copies, so halve the token budget. Sequence length scales the token
-    count per image, hence the division.
-    """
+    Calibrated on v5e at the ViT-Tiny shape (d=192, mlp 768, h=3, s=64):
+    the forward fits 2048 bf16-compute tokens per cell (tile 32 at s=64 —
+    the bench shape), the backward 256 (~3x the live intermediates);
+    fp32 compute doubles the matmul operand copies, so halve the token
+    budget. Other shapes scale the budget by relative live bytes per
+    token: ~11d (residual/LN/qkv/head streams) + 3*mlp (hpre/tanh/hg) +
+    h*s (the per-head (s, s) probability tiles — the term that blows up
+    at LM sequence lengths; round-4 lm_tiny s=256 OOM'd the fixed
+    budget by 3%)."""
     bytes_ = jnp.dtype(compute_dtype).itemsize
+    ref_cost = 11 * 192 + 3 * 768 + 3 * 64
+    cost = 11 * d + 3 * mlp_dim + num_heads * s
     tokens = (2048 if fwd else 256) * 2 // max(bytes_, 2)
+    tokens = tokens * ref_cost // cost
     return max(1, tokens // s)
 
 
@@ -313,6 +332,14 @@ def _prep(x, params, num_heads, img_tile, compute_dtype):
     imgs, s, d = x.shape
     if d % num_heads:
         raise ValueError(f"d={d} % heads={num_heads}")
+    if (d // num_heads) % 64:
+        raise ValueError(
+            f"fused encoder layer needs head_dim a multiple of 64 (got "
+            f"{d // num_heads}): the in-kernel head walk slices qkv "
+            "columns at head_dim offsets and Mosaic only lowers "
+            "64-aligned column slices — pick a head count with "
+            "head_dim >= 64 (e.g. --num_heads 4 for d=256)"
+        )
     tile = _fit_tile(imgs, img_tile)
     cd = compute_dtype
 
@@ -337,7 +364,7 @@ def _prep(x, params, num_heads, img_tile, compute_dtype):
 
 def fused_encoder_forward(
     x, params, *, num_heads: int, compute_dtype=jnp.bfloat16,
-    img_tile: int = 0, interpret=None,
+    img_tile: int = 0, interpret=None, causal: bool = False,
 ):
     """Pallas forward of one encoder layer. x: (imgs, s, d); params: the
     flax EncoderBlock param subtree (ln1/attn/ln2/mlp). img_tile 0 =
@@ -345,14 +372,16 @@ def fused_encoder_forward(
     if interpret is None:
         interpret = _interpret()
     img_tile = img_tile or _auto_tile(
-        x.shape[0], x.shape[1], compute_dtype, fwd=True
+        x.shape[0], x.shape[1], compute_dtype, fwd=True, d=x.shape[2],
+        mlp_dim=jnp.asarray(params["mlp"]["fc_in"]["kernel"]).shape[-1],
+        num_heads=num_heads,
     )
     imgs, s, d, tile, mats, w_specs = _prep(
         x, params, num_heads, img_tile, compute_dtype
     )
     kernel = functools.partial(
         _fused_kernel, num_heads=num_heads, head_dim=d // num_heads,
-        compute_dtype=compute_dtype,
+        compute_dtype=compute_dtype, causal=causal,
     )
     return pl.pallas_call(
         kernel,
@@ -366,7 +395,7 @@ def fused_encoder_forward(
 
 def fused_encoder_backward(
     x, g, params, *, num_heads: int, compute_dtype=jnp.bfloat16,
-    img_tile: int = 0, interpret=None,
+    img_tile: int = 0, interpret=None, causal: bool = False,
 ):
     """Pallas backward: (dx, dparams-tree). Recompute + transpose per grid
     cell; weight grads accumulate across cells in revisited fp32 blocks.
@@ -375,7 +404,9 @@ def fused_encoder_backward(
     if interpret is None:
         interpret = _interpret()
     img_tile = img_tile or _auto_tile(
-        x.shape[0], x.shape[1], compute_dtype, fwd=False
+        x.shape[0], x.shape[1], compute_dtype, fwd=False, d=x.shape[2],
+        mlp_dim=jnp.asarray(params["mlp"]["fc_in"]["kernel"]).shape[-1],
+        num_heads=num_heads,
     )
     imgs, s, d, tile, mats, w_specs = _prep(
         x, params, num_heads, img_tile, compute_dtype
@@ -384,7 +415,7 @@ def fused_encoder_backward(
     f32 = jnp.float32
     kernel = functools.partial(
         _fused_bwd_kernel, num_heads=num_heads, head_dim=d // num_heads,
-        compute_dtype=compute_dtype,
+        compute_dtype=compute_dtype, causal=causal,
     )
     full = lambda shape: pl.BlockSpec(shape, lambda i: (0,) * len(shape))
     dw_shapes = [
@@ -436,7 +467,7 @@ def fused_encoder_backward(
 
 def fused_encoder_layer(x, params, *, num_heads: int, reference_apply=None,
                         compute_dtype=jnp.bfloat16, img_tile: int = 0,
-                        bwd_impl: str = "kernel"):
+                        bwd_impl: str = "kernel", causal: bool = False):
     """Differentiable fused layer: Pallas forward AND backward.
 
     Residuals are just (x, params) — remat semantics. bwd_impl="kernel"
@@ -456,7 +487,7 @@ def fused_encoder_layer(x, params, *, num_heads: int, reference_apply=None,
     def layer(x, p):
         return fused_encoder_forward(
             x, p, num_heads=num_heads, compute_dtype=compute_dtype,
-            img_tile=img_tile,
+            img_tile=img_tile, causal=causal,
         )
 
     def fwd(x, p):
@@ -467,6 +498,7 @@ def fused_encoder_layer(x, params, *, num_heads: int, reference_apply=None,
         if bwd_impl == "kernel":
             return fused_encoder_backward(
                 x, g, p, num_heads=num_heads, compute_dtype=compute_dtype,
+                causal=causal,
             )
         _, vjp = jax.vjp(lambda xx, pp: reference_apply(pp, xx), x, p)
         return vjp(g)

@@ -161,12 +161,15 @@ class Trainer:
             # rather than silently ignoring the requested kernel
             model_kwargs["attn_impl"] = config.attn_impl
         if config.fused_encoder:
-            if config.model != "vit_tiny":
+            if config.model not in ("vit_tiny", "lm_tiny"):
                 raise ValueError(
-                    "--fused is the small-d ViT fused encoder-layer kernel "
-                    "(ops/fused_encoder.py, vit_tiny); wide/LM/conv/"
-                    "pipelined/MoE models keep their own paths — ViT-Base "
-                    "is compute-bound unfused (BENCHMARKS.md)"
+                    "--fused is the small-d fused encoder-layer kernel "
+                    "(ops/fused_encoder.py): vit_tiny, or lm_tiny with "
+                    "--num_heads 4 (causal masking landed in round 4; "
+                    "head_dim must be a multiple of 64). Wide models "
+                    "(vit_base, lm_base) exceed the kernel's VMEM weight-"
+                    "residency budget and are compute-bound unfused "
+                    "(BENCHMARKS.md); conv/pipelined/MoE keep their paths"
                 )
             model_kwargs["fused"] = True
         if config.pipe_schedule != "gpipe":

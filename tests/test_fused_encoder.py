@@ -121,9 +121,96 @@ def test_fused_train_step_moves_params(devices):
 
 def test_fused_gates_unsupported_configs(devices, variables):
     x = _x(b=2)
+    # causal is SUPPORTED since round 4 (test_causal_fused_matches_unfused);
+    # rope and dropout still keep the per-op path
     with pytest.raises(ValueError, match="fused"):
-        EncoderBlock(HEADS, MLP, fused=True, causal=True).apply(variables, x)
+        EncoderBlock(HEADS, MLP, fused=True, rope=True).apply(variables, x)
     with pytest.raises(ValueError, match="fused"):
         EncoderBlock(HEADS, MLP, fused=True, dropout_rate=0.1).apply(
             variables, x, False, True
+        )
+
+
+def test_causal_fused_matches_unfused(devices):
+    """Round 4: the fused kernel's causal path (decoder-LM blocks) —
+    forward AND both grads against the unfused causal block."""
+    from ddp_practice_tpu.ops.fused_encoder import fused_encoder_layer
+
+    block = _block(causal=True)
+    variables = block.init(jax.random.PRNGKey(3), _x(1))
+    x = _x(b=4, seed=4)
+    p = variables["params"]
+
+    want = block.apply(variables, x)
+    got = _block(causal=True, fused=True).apply(variables, x)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5
+    )
+
+    def fused_loss(p, x):
+        y = fused_encoder_layer(
+            x, p, num_heads=HEADS, compute_dtype=jnp.float32, causal=True,
+        )
+        return jnp.sum(y.astype(jnp.float32) ** 2)
+
+    def unfused_loss(p, x):
+        return jnp.sum(block.apply({"params": p}, x).astype(jnp.float32) ** 2)
+
+    gp_w, gx_w = jax.grad(unfused_loss, argnums=(0, 1))(p, x)
+    gp_f, gx_f = jax.grad(fused_loss, argnums=(0, 1))(p, x)
+    flat_w = jax.tree_util.tree_leaves_with_path(gp_w)
+    flat_f = jax.tree.leaves(gp_f)
+    for (path, w), f in zip(flat_w, flat_f):
+        np.testing.assert_allclose(
+            np.asarray(f), np.asarray(w), rtol=2e-4, atol=2e-4,
+            err_msg=jax.tree_util.keystr(path),
+        )
+    np.testing.assert_allclose(
+        np.asarray(gx_f), np.asarray(gx_w), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_causality_of_fused_kernel(devices):
+    """Perturbing a late token must not change earlier outputs."""
+    block = _block(causal=True, fused=True)
+    variables = block.init(jax.random.PRNGKey(5), _x(1))
+    x = _x(b=2, seed=6)
+    y1 = block.apply(variables, x)
+    x2 = x.at[:, -1].add(3.0)
+    y2 = block.apply(variables, x2)
+    np.testing.assert_allclose(
+        np.asarray(y1[:, :-1]), np.asarray(y2[:, :-1]), rtol=1e-5, atol=1e-5
+    )
+    assert float(jnp.max(jnp.abs(y1[:, -1] - y2[:, -1]))) > 1e-3
+
+
+def test_fused_lm_matches_unfused(devices):
+    """TransformerLM(fused=True): same logits and grads as the unfused
+    model (params are identical — fused is an execution strategy)."""
+    kw = dict(vocab_size=64, max_len=32, hidden_dim=128, depth=2,
+              num_heads=2, mlp_dim=256)
+    lm = create_model("lm_tiny", policy=None, **kw)
+    lm_f = create_model("lm_tiny", policy=None, fused=True, **kw)
+    toks = jnp.asarray(
+        np.random.default_rng(7).integers(0, 64, (2, 32)), jnp.int32
+    )
+    variables = lm.init(jax.random.PRNGKey(8), toks)
+    want = lm.apply(variables, toks)
+    got = lm_f.apply(variables, toks)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5
+    )
+
+    def loss(p, model):
+        lg = model.apply({"params": p}, toks).astype(jnp.float32)
+        return jnp.sum(lg ** 2) / lg.size
+
+    gw = jax.grad(lambda p: loss(p, lm))(variables["params"])
+    gf = jax.grad(lambda p: loss(p, lm_f))(variables["params"])
+    for (path, w), f in zip(
+        jax.tree_util.tree_leaves_with_path(gw), jax.tree.leaves(gf)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(f), np.asarray(w), rtol=3e-4, atol=3e-4,
+            err_msg=jax.tree_util.keystr(path),
         )
