@@ -253,7 +253,15 @@ def save_async(
 
     extra, step = _normalize_step(extra, step)
     arrays, names, sharded = _gather(state)
-    assert not sharded, "single-process leaves are always fully addressable"
+    if sharded:
+        # unreachable for process_count()==1 (every array is fully
+        # addressable there), but a bare assert could be compiled out
+        # under python -O and silently write a checkpoint with the
+        # sharded leaves missing — fail loudly instead (advisor, round 3)
+        raise ValueError(
+            f"save_async got {len(sharded)} cross-host-sharded leaves; "
+            "multi-host saves are collective — use save()"
+        )
     final = os.path.join(directory, f"step_{step}")
 
     def _run():
@@ -360,7 +368,13 @@ def restore(directory: str, target: Any, *, shardings: Any = None) -> Any:
     renames across framework versions are rejected loudly, not silently
     misassigned). With `shardings` (a matching pytree of NamedSharding),
     leaves are placed sharded — so a checkpoint written on one mesh
-    restores onto another (e.g. single-chip -> v4-8).
+    restores onto another (e.g. single-chip -> v4-8) — and the restore is
+    STREAMING: shard-file leaves are read region-by-region into exactly
+    the slices this process's devices need (O(local shards) host memory,
+    the mirror of the per-process shard save — round 4), and dense leaves
+    go to device one at a time, so peak host memory is one leaf, not the
+    model. Without `shardings`, everything is assembled full on host (the
+    single-host inspection/full-restore path).
     """
     src = _resolve(directory)
     if src is None:
@@ -368,38 +382,140 @@ def restore(directory: str, target: Any, *, shardings: Any = None) -> Any:
     data = np.load(os.path.join(src, _LEAVES))
     with open(os.path.join(src, _MANIFEST)) as f:
         manifest = json.load(f)
-    assembled = _assemble_shards(src, manifest)
+    sharded_meta = manifest.get("sharded_leaves") or {}
     paths_and_leaves, treedef = tree_flatten_with_path(target)
     if len(paths_and_leaves) != len(manifest["paths"]):
         raise ValueError(
             f"checkpoint has {len(manifest['paths'])} leaves; "
             f"target has {len(paths_and_leaves)}"
         )
+    if shardings is not None:
+        sh_flat, sh_treedef = jax.tree.flatten(shardings)
+        if sh_treedef != treedef:
+            # a same-count, differently-structured tree would otherwise
+            # zip positionally and hand equal-shaped leaves each other's
+            # shardings silently
+            raise ValueError(
+                f"shardings pytree structure {sh_treedef} does not match "
+                f"the target's {treedef}"
+            )
+        shard_files = _open_shard_files(src) if sharded_meta else []
+    else:
+        assembled = _assemble_shards(src, manifest)
+
     leaves = []
     for i, (path, leaf) in enumerate(paths_and_leaves):
         want = keystr(path)
         got = manifest["paths"][i]
         if want != got:
             raise ValueError(f"checkpoint leaf {i} is {got!r}; target wants {want!r}")
-        arr = assembled[i] if i in assembled else data[f"leaf_{i}"]
+        meta = sharded_meta.get(str(i))  # json keys are always strings
+        host = None
+        if meta is not None:
+            ck_shape = tuple(meta["shape"])
+        else:
+            host = data[f"leaf_{i}"]  # read the zip member exactly once
+            ck_shape = tuple(host.shape)
         want_shape = getattr(leaf, "shape", None)
-        if want_shape is not None and tuple(arr.shape) != tuple(want_shape):
+        if want_shape is not None and ck_shape != tuple(want_shape):
             # e.g. generate.py --seq_len different from the training run:
             # fail here with the mismatch named, not deep inside flax
             raise ValueError(
-                f"checkpoint leaf {want!r} has shape {tuple(arr.shape)}; "
+                f"checkpoint leaf {want!r} has shape {ck_shape}; "
                 f"target wants {tuple(want_shape)} — the checkpoint was "
                 "written with a different model configuration"
             )
-        if hasattr(leaf, "dtype") and arr.dtype != leaf.dtype:
-            arr = arr.astype(leaf.dtype)
+        dtype = getattr(leaf, "dtype", None)
+        if shardings is not None:
+            if meta is not None:
+                arr = _restore_leaf_streamed(
+                    i, meta, sh_flat[i], shard_files, dtype
+                )
+            else:
+                if dtype is not None and host.dtype != dtype:
+                    host = host.astype(dtype)
+                arr = jax.device_put(host, sh_flat[i])
+                host = None  # one dense leaf on host at a time
+        else:
+            arr = assembled[i] if meta is not None else host
+            if dtype is not None and arr.dtype != dtype:
+                arr = arr.astype(dtype)
         leaves.append(arr)
-    restored = tree_unflatten(treedef, leaves)
-    if shardings is not None:
-        restored = jax.tree.map(
-            lambda x, s: jax.device_put(x, s), restored, shardings
-        )
-    return restored
+    return tree_unflatten(treedef, leaves)
+
+
+def _open_shard_files(src: str):
+    """[(index entries, lazy npz)] for every shards.<p> pair under src."""
+    out = []
+    for name in sorted(os.listdir(src)):
+        if not (name.startswith("shards.") and name.endswith(".json")):
+            continue
+        with open(os.path.join(src, name)) as f:
+            index = json.load(f)
+        out.append((index, np.load(os.path.join(src, name[:-len("json")] + "npz"))))
+    return out
+
+
+def _restore_leaf_streamed(i, meta, sharding, shard_files, dtype):
+    """Build one sharded jax.Array reading ONLY the regions this process's
+    devices need: for each addressable device, a buffer of its shard shape
+    is filled from the intersecting shard-file regions and placed
+    immediately — no full-leaf host materialization (the save path's
+    O(local shards) property, mirrored). Coverage of every device buffer
+    is verified element-exactly, so a missing writer file fails loudly."""
+    shape = tuple(meta["shape"])
+    dtype = dtype or np.dtype(meta["dtype"])
+    dev_map = sharding.addressable_devices_indices_map(shape)
+    # pre-filter this leaf's entries and memoize decompressed members:
+    # NpzFile re-reads the zip member on every access, and replicated or
+    # re-meshed restores visit the same region from several devices
+    entries = [
+        (entry, shards)
+        for index, shards in shard_files
+        for entry in index
+        if int(entry["leaf"]) == i
+    ]
+    pieces: dict = {}
+    bufs = []
+    for dev, idx in dev_map.items():
+        # normalize the device's index into concrete [start, stop) bounds
+        bounds = []
+        for dim, sl in zip(shape, idx):
+            start = 0 if sl.start is None else sl.start
+            stop = dim if sl.stop is None else sl.stop
+            bounds.append((start, stop))
+        region = np.zeros([b - a for a, b in bounds], dtype)
+        filled = 0
+        for entry, shards in entries:
+            inter = [
+                (max(a, ea), min(b, eb))
+                for (a, b), (ea, eb) in zip(bounds, entry["index"])
+            ]
+            if any(a >= b for a, b in inter):
+                continue
+            dst = tuple(
+                slice(a - ra, b - ra)
+                for (a, b), (ra, _) in zip(inter, bounds)
+            )
+            src_sl = tuple(
+                slice(a - ea, b - ea)
+                for (a, b), (ea, _) in zip(inter, entry["index"])
+            )
+            cache_key = (id(shards), entry["key"])
+            if cache_key not in pieces:
+                pieces[cache_key] = shards[entry["key"]]
+            region[dst] = pieces[cache_key][src_sl].astype(dtype)
+            filled += int(np.prod([b - a for a, b in inter]))
+        want = int(np.prod(region.shape))
+        if filled != want:
+            raise ValueError(
+                f"sharded leaf {i}: device {dev} needs {want} elements but "
+                f"only {filled} are covered by shard files — shard files "
+                "from some writer process are missing (incomplete or "
+                "non-shared storage?)"
+            )
+        bufs.append(jax.device_put(region, dev))
+    return jax.make_array_from_single_device_arrays(shape, sharding, bufs)
 
 
 def _assemble_shards(src: str, manifest: dict) -> dict:

@@ -180,6 +180,35 @@ def main() -> int:
             _json.dump({"param_leaf_index": big_idx}, f)
     print(f"[{pid}] fsdp sharded save/restore ok (no full-leaf gather)")
 
+    # --- round 4: STREAMED sharded restore (O(local shards) host memory).
+    # With `shardings` the restore must read only the regions this
+    # process's devices need — the full-host assembly path must never
+    # run. Enforced by stubbing it out, then every local shard is value-
+    # checked against the live state.
+    orig_assemble = ckpt._assemble_shards
+
+    def _no_full_assembly(*a, **k):
+        raise AssertionError(
+            "restore(shardings=...) must stream shards, not assemble "
+            "full leaves on host"
+        )
+
+    ckpt._assemble_shards = _no_full_assembly
+    try:
+        streamed = ckpt.restore(ck2, abstract, shardings=shardings)
+    finally:
+        ckpt._assemble_shards = orig_assemble
+    for got_leaf, want_leaf in zip(
+        jax.tree_util.tree_leaves(streamed.params), leaves
+    ):
+        for a, b in zip(
+            got_leaf.addressable_shards, want_leaf.addressable_shards
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a.data), np.asarray(b.data)
+            )
+    print(f"[{pid}] fsdp STREAMED restore ok (no full-leaf host assembly)")
+
     # --- LM task multi-process: token shards, grad sync, perplexity ---
     cfg_lm = TrainConfig(
         model="lm_tiny",
