@@ -478,12 +478,8 @@ def _restore_leaf_streamed(i, meta, sharding, shard_files, dtype):
     pieces: dict = {}
     bufs = []
     for dev, idx in dev_map.items():
-        # normalize the device's index into concrete [start, stop) bounds
-        bounds = []
-        for dim, sl in zip(shape, idx):
-            start = 0 if sl.start is None else sl.start
-            stop = dim if sl.stop is None else sl.stop
-            bounds.append((start, stop))
+        # the same [start, stop) serialization the save path uses
+        bounds = _slices_to_index(idx, shape)
         region = np.zeros([b - a for a, b in bounds], dtype)
         filled = 0
         for entry, shards in entries:
@@ -533,12 +529,7 @@ def _assemble_shards(src: str, manifest: dict) -> dict:
         for i, m in meta.items()
     }
     filled = {int(i): 0 for i in meta}
-    for name in sorted(os.listdir(src)):
-        if not (name.startswith("shards.") and name.endswith(".json")):
-            continue
-        with open(os.path.join(src, name)) as f:
-            index = json.load(f)
-        shards = np.load(os.path.join(src, name[:-len("json")] + "npz"))
+    for index, shards in _open_shard_files(src):
         for entry in index:
             i = int(entry["leaf"])
             sl = tuple(slice(a, b) for a, b in entry["index"])

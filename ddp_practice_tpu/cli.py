@@ -149,14 +149,24 @@ def build_parser() -> argparse.ArgumentParser:
                         "fix; vit_tiny, or dense LMs with head_dim >= 64 "
                         "via --num_heads)")
     p.add_argument("--augment", action="store_true",
-                   help="on-device random crop + horizontal flip inside the "
-                        "jitted train step (image models; deterministic per "
-                        "seed/step — ops/augment.py)")
+                   help="on-device augmentation inside the jitted train "
+                        "step (image models; deterministic per seed/step — "
+                        "ops/augment.py)")
+    p.add_argument("--augment_kind", default="crop_flip",
+                   choices=["crop_flip", "rrc"],
+                   help="crop_flip: pad-crop + flip (CIFAR/MNIST rung); "
+                        "rrc: random resized crop (the ImageNet rung)")
     p.add_argument("--json", action="store_true", help="print summary as JSON")
     return p
 
 
 def config_from_args(args) -> TrainConfig:
+    if args.augment_kind != "crop_flip" and not args.augment:
+        raise SystemExit(
+            "--augment_kind has no effect without --augment — pass both "
+            "(the run would otherwise train UNAUGMENTED while its flags "
+            "suggest otherwise)"
+        )
     return TrainConfig(
         model=args.model,
         dataset=args.dataset,
@@ -189,6 +199,7 @@ def config_from_args(args) -> TrainConfig:
         num_microbatches=args.microbatches,
         pipe_schedule=args.pipe_schedule,
         augment=args.augment,
+        augment_kind=args.augment_kind,
         fused_encoder=args.fused,
         num_experts=args.num_experts,
         num_heads=args.num_heads,

@@ -199,6 +199,11 @@ class TrainConfig:
     # on-device input augmentation (random crop + horizontal flip inside
     # the jitted train step, ops/augment.py); image models only
     augment: bool = False
+    # which augmentation when --augment is set: "crop_flip" (pad-crop +
+    # flip, the CIFAR/MNIST rung) or "rrc" (random resized crop, the
+    # ImageNet rung — ResNet-50/224)
+    augment_kind: str = "crop_flip"
+
     # ViT encoder layers as fused Pallas kernels (ops/fused_encoder.py)
     fused_encoder: bool = False
     # MoE expert count when mesh.expert > 1 (0 = auto: 8 rounded up to a

@@ -25,13 +25,18 @@ SPMD form mirrors _pipeline_local: ONE jitted program, partial-manual
 shard_map over {'pipe', 'data'} (tensor/seq axes stay GSPMD-automatic
 inside the stage body, so TP/SP compose exactly as in GPipe), activations
 and cotangents hop via paired forward/backward `lax.ppermute`s every
-tick. Work is masked, not branched: every device executes the F and the
-B compute each tick and gates the results by schedule validity — ticks a
-stage spends in the bubble cost compute anyway (same lockstep property
-as the GPipe scan; collectives would deadlock under divergent control
-flow, so masking is the safe SPMD idiom). The price is a longer schedule
-than GPipe wall-clock-wise at equal M; the purchase is O(P) activation
-memory. BENCHMARKS.md records both sides of that trade, measured.
+tick. Within a tick, work is masked, not branched: every device executes
+the same compute and gates results by schedule validity (collectives
+would deadlock under divergent control flow, so masking is the safe SPMD
+idiom). ACROSS ticks, validity is static — so the schedule is three
+scans, not one (round 4): fill (first P-1 ticks, F-only — no stage has
+a valid backward yet), steady (M-1 ticks, F+B), drain (last P ticks,
+B-only — all forwards are done). Bubble ticks no longer pay the other
+sub-phase's compute: fill skips the vjp re-run + head entirely, drain
+skips the forward and its hop. The remaining (inherent) masking cost is
+per-STAGE idle work inside valid ticks. The price vs GPipe at equal M is
+the longer combined schedule; the purchase is O(P) activation memory.
+BENCHMARKS.md records both sides of that trade, measured.
 
 Boundary values (hops, stash, psums) stay fp32 — same JAX 0.9
 partial-manual sub-fp32 psum CHECK-failure workaround as pipeline.py;
@@ -135,108 +140,136 @@ def _1f1b_local(stage_params, head_params, xs, targets, weights, *,
     def fwd(sp_, x_):
         return block_fn(sp_, x_.astype(compute_dtype)).astype(f32)
 
-    def tick(carry, t):
-        (stash, y_in, dy_in, dsp_acc, dhp_acc, loss_acc, aux_acc,
-         dxs_buf) = carry
+    def make_tick(do_f: bool, do_b: bool):
+        """One schedule tick, specialized to its phase. Tick validity is
+        STATIC per phase (round 4 fill/steady/drain split): fill ticks
+        carry no valid B anywhere, drain ticks no valid F — so the
+        specialized bodies simply omit that sub-phase's compute and hop
+        instead of running it masked. Within a phase every device still
+        executes the same program (collectives stay lockstep)."""
 
-        # ---- F sub-phase: stage i forwards microbatch t - i
-        fm = t - idx
-        f_valid = (fm >= 0) & (fm < M) & (idx < n_stages - 1)
-        fm_c = jnp.clip(fm, 0, M - 1)
-        x_f = jnp.where(
-            idx == 0, lax.dynamic_index_in_dim(xs, fm_c, 0, False), y_in
-        )
-        y_f = fwd(sp, x_f)
-        stash = jnp.where(
-            f_valid,
-            lax.dynamic_update_index_in_dim(stash, x_f, fm_c % W, 0),
-            stash,
-        )
+        def tick(carry, t):
+            (stash, y_in, dy_in, dsp_acc, dhp_acc, loss_acc, aux_acc,
+             dxs_buf) = carry
 
-        # ---- B sub-phase: stage i backwards microbatch t - (2(P-1) - i).
-        # Blocks re-run under jax.vjp on every stage (that is the work);
-        # the vocab-wide head + loss runs under lax.cond on the LAST
-        # stage only — `is_last` is uniform across the 'tensor'/'seq'
-        # shards of a stage, so GSPMD collectives inside the branch are
-        # taken (or skipped) by every member of their group together.
-        # Elsewhere the cotangent flows in from the next stage's B of the
-        # previous tick.
-        bm = t - (2 * (n_stages - 1) - idx)
-        b_valid = (bm >= 0) & (bm < M)
-        bm_c = jnp.clip(bm, 0, M - 1)
-        is_last = idx == n_stages - 1
-        # last stage consumes straight from its inbox (it never forwards);
-        # a single-stage pipeline (last AND first) reads the source batch
-        x_b = jnp.where(
-            is_last,
-            jnp.where(
-                idx == 0, lax.dynamic_index_in_dim(xs, bm_c, 0, False), y_in
-            ),
-            lax.dynamic_index_in_dim(stash, bm_c % W, 0, False),
-        )
-        tgt = lax.dynamic_index_in_dim(targets, bm_c, 0, False)
-        wgt = lax.dynamic_index_in_dim(weights, bm_c, 0, False)
+            if do_f:
+                # ---- F sub-phase: stage i forwards microbatch t - i
+                fm = t - idx
+                f_valid = (fm >= 0) & (fm < M) & (idx < n_stages - 1)
+                fm_c = jnp.clip(fm, 0, M - 1)
+                x_f = jnp.where(
+                    idx == 0,
+                    lax.dynamic_index_in_dim(xs, fm_c, 0, False), y_in,
+                )
+                y_f = fwd(sp, x_f)
+                stash = jnp.where(
+                    f_valid,
+                    lax.dynamic_update_index_in_dim(stash, x_f, fm_c % W, 0),
+                    stash,
+                )
+                # activations hop forward; invalid slots carry garbage —
+                # every consumer gates by its own schedule
+                y_next = lax.ppermute(y_f, axis_name, fwd_perm)
+            else:
+                # drain: all forwards are done; the inbox must PERSIST —
+                # the last stage consumes its final activation on the
+                # first drain tick
+                y_next = y_in
 
-        y_b, blocks_vjp = jax.vjp(fwd, sp, x_b)
+            if not do_b:
+                # fill: no stage has a valid backward yet
+                return (stash, y_next, dy_in, dsp_acc, dhp_acc, loss_acc,
+                        aux_acc, dxs_buf), None
 
-        def do_head(operands):
-            hp_, y_ = operands
-            loss_sum, h_vjp, aux = jax.vjp(
-                lambda h, yy: head_loss_fn(h, yy, tgt, wgt),
-                hp_, y_, has_aux=True,
+            # ---- B sub-phase: stage i backwards microbatch
+            # t - (2(P-1) - i). Blocks re-run under jax.vjp on every
+            # stage (that is the work); the vocab-wide head + loss runs
+            # under lax.cond on the LAST stage only — `is_last` is
+            # uniform across the 'tensor'/'seq' shards of a stage, so
+            # GSPMD collectives inside the branch are taken (or skipped)
+            # by every member of their group together. Elsewhere the
+            # cotangent flows in from the next stage's B of the previous
+            # tick.
+            bm = t - (2 * (n_stages - 1) - idx)
+            b_valid = (bm >= 0) & (bm < M)
+            bm_c = jnp.clip(bm, 0, M - 1)
+            is_last = idx == n_stages - 1
+            # last stage consumes straight from its inbox (it never
+            # forwards); a single-stage pipeline (last AND first) reads
+            # the source batch
+            x_b = jnp.where(
+                is_last,
+                jnp.where(
+                    idx == 0,
+                    lax.dynamic_index_in_dim(xs, bm_c, 0, False), y_in,
+                ),
+                lax.dynamic_index_in_dim(stash, bm_c % W, 0, False),
             )
-            dhp, dy = h_vjp(jnp.ones((), loss_sum.dtype))
-            return loss_sum, aux, dhp, dy.astype(f32)
+            tgt = lax.dynamic_index_in_dim(targets, bm_c, 0, False)
+            wgt = lax.dynamic_index_in_dim(weights, bm_c, 0, False)
 
-        def skip_head(operands):
-            hp_, y_ = operands
-            return (
-                jnp.zeros((), f32),
-                jax.tree.map(lambda a: jnp.zeros((), f32), aux_shape),
-                jax.tree.map(lambda p: jnp.zeros(p.shape, p.dtype), hp_),
-                jnp.zeros_like(y_),
+            y_b, blocks_vjp = jax.vjp(fwd, sp, x_b)
+
+            def do_head(operands):
+                hp_, y_ = operands
+                loss_sum, h_vjp, aux = jax.vjp(
+                    lambda h, yy: head_loss_fn(h, yy, tgt, wgt),
+                    hp_, y_, has_aux=True,
+                )
+                dhp, dy = h_vjp(jnp.ones((), loss_sum.dtype))
+                return loss_sum, aux, dhp, dy.astype(f32)
+
+            def skip_head(operands):
+                hp_, y_ = operands
+                return (
+                    jnp.zeros((), f32),
+                    jax.tree.map(lambda a: jnp.zeros((), f32), aux_shape),
+                    jax.tree.map(
+                        lambda p: jnp.zeros(p.shape, p.dtype), hp_
+                    ),
+                    jnp.zeros_like(y_),
+                )
+
+            loss_m, aux_m, dhp_m, dy_head = lax.cond(
+                is_last, do_head, skip_head, (head_params, y_b)
+            )
+            zero_f = jnp.asarray(0.0, f32)
+            dy_ct = jnp.where(is_last, dy_head, dy_in)
+            dsp_m, dx_m = blocks_vjp(dy_ct)
+
+            bmask = b_valid.astype(f32)
+            dsp_acc = jax.tree.map(
+                lambda a, gr: a + gr.astype(f32) * bmask, dsp_acc, dsp_m
+            )
+            dhp_acc = jax.tree.map(
+                lambda a, gr: a + gr.astype(f32) * bmask, dhp_acc, dhp_m
+            )
+            emit = b_valid & is_last
+            loss_acc = loss_acc + jnp.where(emit, loss_m, zero_f)
+            aux_acc = jax.tree.map(
+                lambda a, v: a + jnp.where(emit, v.astype(f32), zero_f),
+                aux_acc, aux_m,
+            )
+            dxs_buf = jnp.where(
+                b_valid & (idx == 0),
+                lax.dynamic_update_index_in_dim(
+                    dxs_buf, dx_m.astype(f32), bm_c, 0
+                ),
+                dxs_buf,
             )
 
-        loss_m, aux_m, dhp_m, dy_head = lax.cond(
-            is_last, do_head, skip_head, (head_params, y_b)
-        )
-        zero_f = jnp.asarray(0.0, f32)
-        dy_ct = jnp.where(is_last, dy_head, dy_in)
-        dsp_m, dx_m = blocks_vjp(dy_ct)
+            # cotangents hop backward
+            dy_next = lax.ppermute(dx_m.astype(f32), axis_name, bwd_perm)
+            return (stash, y_next, dy_next, dsp_acc, dhp_acc, loss_acc,
+                    aux_acc, dxs_buf), None
 
-        bmask = b_valid.astype(f32)
-        dsp_acc = jax.tree.map(
-            lambda a, gr: a + gr.astype(f32) * bmask, dsp_acc, dsp_m
-        )
-        dhp_acc = jax.tree.map(
-            lambda a, gr: a + gr.astype(f32) * bmask, dhp_acc, dhp_m
-        )
-        emit = b_valid & is_last
-        loss_acc = loss_acc + jnp.where(emit, loss_m, zero_f)
-        aux_acc = jax.tree.map(
-            lambda a, v: a + jnp.where(emit, v.astype(f32), zero_f),
-            aux_acc, aux_m,
-        )
-        dxs_buf = jnp.where(
-            b_valid & (idx == 0),
-            lax.dynamic_update_index_in_dim(
-                dxs_buf, dx_m.astype(f32), bm_c, 0
-            ),
-            dxs_buf,
-        )
-
-        # ---- hops: activations forward, cotangents backward. Invalid
-        # slots carry garbage; every consumer gates by its own schedule.
-        y_next = lax.ppermute(y_f, axis_name, fwd_perm)
-        dy_next = lax.ppermute(dx_m.astype(f32), axis_name, bwd_perm)
-        return (stash, y_next, dy_next, dsp_acc, dhp_acc, loss_acc,
-                aux_acc, dxs_buf), None
+        return tick
 
     aux_shape = jax.eval_shape(
         lambda hp, y, t, w: head_loss_fn(hp, y, t, w)[1],
         head_params, jnp.zeros(mb_shape, f32), targets[0], weights[0],
     )
-    carry0 = (
+    carry = (
         jnp.zeros((W,) + mb_shape, f32),            # stash
         jnp.zeros(mb_shape, f32),                   # y inbox
         jnp.zeros(mb_shape, f32),                   # dy inbox
@@ -246,8 +279,21 @@ def _1f1b_local(stage_params, head_params, xs, targets, weights, *,
         jax.tree.map(lambda a: jnp.zeros((), f32), aux_shape),
         jnp.zeros((M,) + mb_shape, f32),            # dxs
     )
-    (_, _, _, dsp_acc, dhp_acc, loss_acc, aux_acc,
-     dxs_buf), _ = lax.scan(tick, carry0, jnp.arange(T))
+    # phase boundaries (static): the last valid F anywhere is stage P-2's
+    # microbatch M-1 at tick M+P-3; the first valid B anywhere is the
+    # last stage's microbatch 0 at tick P-1. fill = [0, P-2] F-only,
+    # steady = [P-1, M+P-3] F+B, drain = [M+P-2, T-1] B-only. Lengths
+    # (P-1) + (M-1) + P = T. Empty phases (P=1, M=1) scan zero ticks.
+    P_ = n_stages
+    fill_end = P_ - 1
+    steady_end = M + P_ - 2
+    carry, _ = lax.scan(make_tick(True, False), carry,
+                        jnp.arange(0, fill_end))
+    carry, _ = lax.scan(make_tick(True, True), carry,
+                        jnp.arange(fill_end, steady_end))
+    carry, _ = lax.scan(make_tick(False, True), carry,
+                        jnp.arange(steady_end, T))
+    (_, _, _, dsp_acc, dhp_acc, loss_acc, aux_acc, dxs_buf) = carry
 
     data = MeshConfig.AXIS_DATA
     # reductions: grads/loss sum over 'data'; last-stage-only values

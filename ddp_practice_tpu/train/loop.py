@@ -322,7 +322,7 @@ class Trainer:
                     "flip, ops/augment.py); it does not apply to token "
                     "streams"
                 )
-            step_kwargs["augment"] = True
+            step_kwargs["augment"] = config.augment_kind
         self.train_step = train_factory(
             self.model, self.tx, **step_kwargs, **common,
         )
@@ -412,7 +412,8 @@ class Trainer:
                     self.tx,
                     label_smoothing=config.label_smoothing,
                     seed=config.seed,
-                    augment=config.augment,
+                    augment=(config.augment_kind if config.augment
+                             else False),
                     mesh=self.mesh,
                     state_shardings=self.state_shardings,
                 )

@@ -99,13 +99,14 @@ def _train_step_fn(model, tx, label_smoothing: float, seed: int = 0,
         if augment:
             # inside the jitted step, after the (resident) gather +
             # normalize; keyed on the global step so every driver variant
-            # sees the same crops at the same step (ops/augment.py)
-            from ddp_practice_tpu.ops.augment import (
-                augment_rng,
-                random_crop_flip,
-            )
+            # sees the same crops at the same step. `augment` is a kind:
+            # True/"crop_flip" = pad-crop+flip, "rrc" = random resized
+            # crop, the ImageNet rung (ops/augment.py)
+            from ddp_practice_tpu.ops.augment import apply_augment, augment_rng
 
-            images = random_crop_flip(images, augment_rng(seed, state.step))
+            images = apply_augment(
+                images, augment_rng(seed, state.step), augment
+            )
 
         def loss_fn(params):
             variables = {"params": params}

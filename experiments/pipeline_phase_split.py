@@ -1,0 +1,94 @@
+"""Controlled A/B of the round-4 1F1B phase split (fill/steady/drain
+scans vs one masked scan): same process, same 8-virtual-CPU mesh, same
+model and inputs, many fenced reps, median wall-clock per step.
+
+The full bench_pipeline comparison on this CPU mesh is +/-20%+ noisy
+across runs (BENCHMARKS.md); importing the round-3 module side by side
+removes every variable except the schedule structure."""
+import importlib.util
+import statistics
+import sys
+import time
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, "/root/repo")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+
+def load_old(path="/tmp/old_1f1b.py"):
+    spec = importlib.util.spec_from_file_location("old_1f1b", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main(mbs=(4, 8), reps=7):
+    from ddp_practice_tpu.config import MeshConfig, PrecisionPolicy, TrainConfig
+    from ddp_practice_tpu.models import create_model
+    import ddp_practice_tpu.models.pipeline_lm as plm
+    import ddp_practice_tpu.parallel.pipeline_1f1b as new_mod
+    from ddp_practice_tpu.parallel.mesh import batch_sharding, build_mesh, shard_state
+    from ddp_practice_tpu.parallel.ring import set_current_mesh
+    from ddp_practice_tpu.parallel.sharding_rules import param_sharding_rules
+    from ddp_practice_tpu.train.state import create_state, make_optimizer
+    from ddp_practice_tpu.train.steps import make_lm_train_step
+
+    old_mod = load_old()
+    P_, dp = 4, 2
+    seq, vocab = 128, 256
+    for M in mbs:
+        mesh = build_mesh(MeshConfig(data=dp, pipe=P_))
+        set_current_mesh(mesh)
+        policy = PrecisionPolicy.from_name("bf16")
+        model = create_model(
+            "lm_pipe", policy=policy, vocab_size=vocab, max_len=seq,
+            hidden_dim=256, depth=4, num_heads=8, mlp_dim=1024,
+            num_stages=P_, num_microbatches=M, schedule="1f1b",
+        )
+        tx = make_optimizer(TrainConfig(optimizer="adamw", learning_rate=1e-3))
+        b = M * 4 * dp
+        sample = jnp.zeros((b, seq), jnp.int32)
+        init_fn = lambda r: create_state(model, tx, rng=r, sample_input=sample)
+        abstract = jax.eval_shape(init_fn, jax.random.PRNGKey(0))
+        sh = shard_state(abstract, mesh, param_sharding_rules("lm_pipe"))
+        jinit = jax.jit(init_fn, out_shardings=sh)
+        batch = {"tokens": jnp.asarray(
+            np.random.default_rng(0).integers(0, vocab, (b, seq + 1)),
+            jnp.int32)}
+
+        results = {}
+        for name, mod in (("old", old_mod), ("new", new_mod)):
+            plm.__dict__.pop("pipeline_1f1b_loss_and_grad", None)
+            # the model imports the fn inside its method; patch the module
+            # the import resolves to
+            sys.modules["ddp_practice_tpu.parallel.pipeline_1f1b"] = mod
+            step = make_lm_train_step(
+                model, tx, mesh=mesh, state_shardings=sh,
+                batch_shardings=batch_sharding(mesh),
+            )
+            state = jinit(jax.random.PRNGKey(0))  # fresh buffers: the
+            # step donates its state, so variants must not share arrays
+            state, m = step(state, batch)  # compile
+            _ = float(m["loss"])
+            ts = []
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                state, m = step(state, batch)
+                _ = float(m["loss"])
+                ts.append(time.perf_counter() - t0)
+            results[name] = (statistics.median(ts), float(m["loss"]))
+        sys.modules["ddp_practice_tpu.parallel.pipeline_1f1b"] = new_mod
+        o, n = results["old"], results["new"]
+        print(f"M={M}: old {o[0]*1e3:8.1f} ms/step  new {n[0]*1e3:8.1f} "
+              f"ms/step  speedup {o[0]/n[0]:.2f}x  "
+              f"loss old/new {o[1]:.6f}/{n[1]:.6f}")
+        set_current_mesh(None)
+
+
+if __name__ == "__main__":
+    main()

@@ -9,6 +9,7 @@ shape-preserving, actually stochastic across steps, and OFF by default
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from ddp_practice_tpu.ops.augment import augment_rng, random_crop_flip
 
@@ -100,3 +101,67 @@ def test_augmented_step_trains_and_default_is_off(devices):
     assert float(m_plain["loss"]) == float(m_off["loss"])  # bit-identical
     assert float(m_aug["loss"]) != float(m_plain["loss"])
     assert np.isfinite(float(m_aug["loss"]))
+
+
+class TestRandomResizedCrop:
+    """Round 4: the ImageNet-rung augmentation (RRC)."""
+
+    def _img(self, b=4, h=32, w=32, c=3, seed=0):
+        import numpy as np
+        return jnp.asarray(
+            np.random.default_rng(seed).random((b, h, w, c)), jnp.float32
+        )
+
+    def test_deterministic_per_key(self):
+        from ddp_practice_tpu.ops.augment import random_resized_crop
+
+        x = self._img()
+        k = jax.random.PRNGKey(7)
+        a = random_resized_crop(x, k)
+        b = random_resized_crop(x, k)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        c = random_resized_crop(x, jax.random.PRNGKey(8))
+        assert float(jnp.max(jnp.abs(a - c))) > 1e-3
+
+    def test_identity_at_full_scale_unit_ratio(self):
+        """scale=(1,1), ratio=(1,1), no flip: the crop is the whole image
+        and the resample is the identity map."""
+        from ddp_practice_tpu.ops.augment import random_resized_crop
+
+        x = self._img(seed=1)
+        y = random_resized_crop(
+            x, jax.random.PRNGKey(0), scale=(1.0, 1.0),
+            ratio=(1.0, 1.0), flip=False,
+        )
+        np.testing.assert_allclose(
+            np.asarray(y), np.asarray(x), rtol=1e-5, atol=1e-5
+        )
+
+    def test_static_shapes_and_values_bounded(self):
+        from ddp_practice_tpu.ops.augment import random_resized_crop
+
+        x = self._img(b=8, seed=2)
+        y = jax.jit(random_resized_crop)(x, jax.random.PRNGKey(3))
+        assert y.shape == x.shape
+        # linear interpolation of values in [0,1] stays in [0,1]
+        assert float(y.min()) >= -1e-5 and float(y.max()) <= 1.0 + 1e-5
+
+    def test_apply_augment_dispatch(self):
+        from ddp_practice_tpu.ops.augment import (
+            apply_augment, random_crop_flip, random_resized_crop)
+
+        x = self._img(seed=3)
+        k = jax.random.PRNGKey(4)
+        np.testing.assert_array_equal(
+            np.asarray(apply_augment(x, k, False)), np.asarray(x))
+        np.testing.assert_array_equal(
+            np.asarray(apply_augment(x, k, True)),
+            np.asarray(random_crop_flip(x, k)))
+        np.testing.assert_array_equal(
+            np.asarray(apply_augment(x, k, "crop_flip")),
+            np.asarray(random_crop_flip(x, k)))
+        np.testing.assert_array_equal(
+            np.asarray(apply_augment(x, k, "rrc")),
+            np.asarray(random_resized_crop(x, k)))
+        with pytest.raises(ValueError, match="augment kind"):
+            apply_augment(x, k, "cutmix")
