@@ -214,10 +214,17 @@ class TransformerLM(nn.Module):
         if self.tied_embeddings:
             logits = embed.attend(x)  # x @ tok_embed.T, no lm_head param
         else:
+            # bias-free, the GPT-2 convention — and not only cosmetics:
+            # the bias GRADIENT is a full rowsum pass over the
+            # (tokens, V) dlogits tensor, 1.4 ms/step of pure HBM reads
+            # at lm_base/32k-vocab (round-4 profile), for a learned
+            # per-class log-prior offset that GPT-family models train
+            # fine without
             logits = nn.Dense(
                 self.vocab_size,
                 dtype=self.dtype,
                 param_dtype=self.param_dtype,
+                use_bias=False,
                 name="lm_head",
             )(x)
         # logits stay in the policy compute dtype: at LM vocab sizes an

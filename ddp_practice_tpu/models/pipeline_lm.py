@@ -89,6 +89,7 @@ class _LMHead(nn.Module):
             self.vocab_size,
             dtype=self.dtype,
             param_dtype=self.param_dtype,
+            use_bias=False,  # GPT-2 convention, matching TransformerLM
             name="lm_head",
         )(x)
         return logits.astype(jnp.float32)

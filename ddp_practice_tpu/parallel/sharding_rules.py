@@ -103,9 +103,9 @@ def _lm_rule(path, leaf) -> Optional[P]:
     if "tok_embed" in name:
         return P(T, None) if "embedding" in name else None
     if "lm_head" in name:
-        if "kernel" in name:
-            return P(None, T)
-        return P(T)  # bias (vocab,)
+        # bias-free by construction (GPT-2 convention, models/lm.py) —
+        # only the (d, vocab) kernel exists
+        return P(None, T)
     if "pos_embed" in name:
         return None
     return _vit_rule(path, leaf)

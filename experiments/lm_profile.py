@@ -65,7 +65,21 @@ def main():
     with jax.profiler.trace(tmp):
         state, m = jchunk(state)
         _ = float(m["loss"])
-    print_summary(tmp, steps=K, top=22)
+    import os
+    s = op_summary(tmp)  # ONE protoc parse; both views derive from it
+    total = s["total_ps"] / 1e9 / K
+    print(f"device op time: {total:.2f} ms/step ({K} steps)")
+    cats = sorted(s["categories"].items(), key=lambda kv: -kv[1]["ps"])
+    for cat, v in cats[:8]:
+        print(f"  {v['ps']/1e9/K:7.2f} ms/step  {cat}")
+    for (cat, nm), ps in sorted(s["ops"].items(), key=lambda kv: -kv[1])[:22]:
+        print(f"  {ps/1e9/K:7.3f} ms/step  [{cat}] {nm[:70]}")
+    if os.environ.get("LM_PROFILE_DETAIL"):
+        rows = sorted(s["ops"].items(), key=lambda kv: -kv[1])
+        for (cat, nm), ps in rows:
+            if cat in ("data formatting", "copy-done", "copy",
+                       "loop fusion") and ps / 1e9 / K > 0.1:
+                print(f"{ps/1e9/K:7.3f} ms/step [{cat}] {nm[:80]}")
     shutil.rmtree(tmp, ignore_errors=True)
 
 

@@ -103,7 +103,7 @@ def test_lm_tied_embeddings(devices):
     uv = untied.init(jax.random.PRNGKey(0), tokens)
     n_tied = sum(x.size for x in jax.tree.leaves(variables["params"]))
     n_untied = sum(x.size for x in jax.tree.leaves(uv["params"]))
-    assert n_untied - n_tied == 64 * 32 + 32  # lm_head kernel + bias
+    assert n_untied - n_tied == 64 * 32  # the bias-free lm_head kernel
 
     g = jax.grad(
         lambda p: jnp.sum(model.apply({"params": p}, tokens) ** 2)
