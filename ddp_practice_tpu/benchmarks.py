@@ -304,7 +304,9 @@ def bench_lm_train(
 
         rep = replicated(mesh)
         bsh = batch_sharding(mesh)
-        step_fn = _lm_train_step_fn(model, tx)
+        # loss-only metrics: the per-step accuracy argmax is a full
+        # extra logits pass the reference's train loop never does
+        step_fn = _lm_train_step_fn(model, tx, with_accuracy=False)
         base_key = jax.random.PRNGKey(seed + 1)
         k_steps = steps_per_call
 

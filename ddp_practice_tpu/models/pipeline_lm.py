@@ -193,7 +193,8 @@ class PipelinedLM:
         return out
 
     def loss_and_grad(self, params, inputs, targets, *, weight=None,
-                      label_smoothing: float = 0.0):
+                      label_smoothing: float = 0.0,
+                      with_accuracy: bool = True):
         """((loss, counts), grads) via the 1F1B schedule — the train-step
         entry point when schedule='1f1b' (train/steps.py dispatches here
         instead of jax.value_and_grad; apply() stays on the GPipe forward
@@ -252,10 +253,14 @@ class PipelinedLM:
             loss_sum, wsum = cross_entropy_sum(
                 logits, tgt, weight=wgt, label_smoothing=label_smoothing
             )
-            correct, total = accuracy_counts(logits, tgt, weight=wgt)
-            return loss_sum, {
-                "weight": wsum, "correct": correct, "total": total,
-            }
+            aux = {"weight": wsum}
+            if with_accuracy:
+                # the argmax is a full extra pass over the microbatch
+                # logits; with_accuracy=False (the bench) drops it, same
+                # contract as _lm_train_step_fn
+                correct, total = accuracy_counts(logits, tgt, weight=wgt)
+                aux.update(correct=correct, total=total)
+            return loss_sum, aux
 
         stages = stack_stages(params["blocks"], self.num_stages)
         loss_sum, aux, stage_grads, head_grads, dxs = (
@@ -294,7 +299,10 @@ class PipelinedLM:
                 head_grads, params["head"],
             ),
         }
-        counts = {"correct": aux["correct"], "total": aux["total"]}
+        counts = (
+            {"correct": aux["correct"], "total": aux["total"]}
+            if with_accuracy else None
+        )
         return (loss, counts), grads
 
     def run_blocks(self, block_params, x):

@@ -117,6 +117,28 @@ class SelfAttention(nn.Module):
             param_dtype=self.param_dtype,
             name="qkv",
         )(x)
+        if (
+            self.attn_impl == "flash"
+            and not decode
+            and not self.rope
+            and self.seq_axis is None
+        ):
+            # hand the raw projection output to the packed kernels: the
+            # (3, h, hd) feature flatten IS the [q|k|v] column layout they
+            # window at offsets, so q/k/v never materialize as slices
+            # (~4 ms/step of layout traffic at lm_base — round-4 profile).
+            # rope rotates q/k in 4D before the kernel and keeps the
+            # sliced path; flash_attention_qkv itself falls back for
+            # unpackable head shapes. Falls through to the shared output
+            # projection below.
+            from ddp_practice_tpu.ops.flash_attention import (
+                flash_attention_qkv,
+            )
+
+            out = flash_attention_qkv(
+                qkv.reshape(b, s, 3 * d), self.num_heads, causal=self.causal
+            )
+            return self._out_proj(out)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         if self.rope and not decode:
             # global positions: under GSPMD jit the sequence dim is sharded
@@ -225,14 +247,20 @@ class SelfAttention(nn.Module):
                 q, k, v, causal=self.causal, seq_axis=self.seq_axis,
                 sp_impl=self.sp_impl, impl=self.attn_impl,
             )
-        out = nn.DenseGeneral(
+        return self._out_proj(out)
+
+    def _out_proj(self, out):
+        """Shared output projection over (b, s, h, hd) attention output —
+        one definition for the fused-QKV and sliced/decode paths (they
+        share the 'out' parameters)."""
+        d = out.shape[-2] * out.shape[-1]
+        return nn.DenseGeneral(
             d,
             axis=(-2, -1),
             dtype=self.dtype,
             param_dtype=self.param_dtype,
             name="out",
         )(out)
-        return out
 
 
 class EncoderBlock(nn.Module):

@@ -530,24 +530,38 @@ def _fwd_kernel_packed(
 
 
 def _flash_fwd_packed(qf, kf, vf, *, n_heads, causal, block_q, block_k,
-                      interpret):
+                      interpret, fused_qkv=False):
     """qf/kf/vf: flat (b, s, h*d). Returns (out_flat, lse_packed) where
-    lse_packed is (b, n_packs, seq_q, hpc) fp32."""
+    lse_packed is (b, n_packs, seq_q, hpc) fp32.
+
+    fused_qkv=True: qf/kf/vf are all the SAME (b, s, 3*h*d) array — the
+    raw QKV-projection output, columns [q heads | k heads | v heads].
+    The three in_specs window it at column-block offsets (0, n_packs,
+    2*n_packs), so no slice/relayout ever materializes q, k, v (the
+    sliced path cost ~4 ms/step of pure data formatting at lm_base
+    shapes — round-4 profile)."""
     from jax.experimental.pallas import tpu as pltpu
 
     b, seq_q, hd = qf.shape
+    if fused_qkv:
+        hd //= 3
     seq_k = kf.shape[1]
     d = hd // n_heads
     hpc = _heads_per_pack(n_heads, d)
     w = hpc * d
     n_packs = n_heads // hpc
+    koff = n_packs if fused_qkv else 0
+    voff = 2 * n_packs if fused_qkv else 0
     block_q, block_k = _check_blocks(seq_q, seq_k, block_q, block_k, causal)
     sm_scale = 1.0 / (d ** 0.5)
     offset = seq_k - seq_q if causal else 0
     vis = _block_visible(block_q, block_k, offset)
 
-    def kv_map(b_, g, i, j):
-        return (b_, _redirect(causal, vis, i, j, j), g)
+    def k_map(b_, g, i, j):
+        return (b_, _redirect(causal, vis, i, j, j), g + koff)
+
+    def v_map(b_, g, i, j):
+        return (b_, _redirect(causal, vis, i, j, j), g + voff)
 
     kernel = functools.partial(
         _fwd_kernel_packed, sm_scale=sm_scale, block_q=block_q,
@@ -559,8 +573,8 @@ def _flash_fwd_packed(qf, kf, vf, *, n_heads, causal, block_q, block_k,
         grid=(b, n_packs, seq_q // block_q, seq_k // block_k),
         in_specs=[
             pl.BlockSpec((None, block_q, w), lambda b_, g, i, j: (b_, i, g)),
-            pl.BlockSpec((None, block_k, w), kv_map),
-            pl.BlockSpec((None, block_k, w), kv_map),
+            pl.BlockSpec((None, block_k, w), k_map),
+            pl.BlockSpec((None, block_k, w), v_map),
         ],
         out_specs=[
             pl.BlockSpec((None, block_q, w), lambda b_, g, i, j: (b_, i, g)),
@@ -568,7 +582,7 @@ def _flash_fwd_packed(qf, kf, vf, *, n_heads, causal, block_q, block_k,
                          lambda b_, g, i, j: (b_, g, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct(qf.shape, qf.dtype),
+            jax.ShapeDtypeStruct((b, seq_q, hd), qf.dtype),
             jax.ShapeDtypeStruct((b, n_packs, seq_q, hpc), jnp.float32),
         ],
         scratch_shapes=[
@@ -586,7 +600,7 @@ def _flash_fwd_packed(qf, kf, vf, *, n_heads, causal, block_q, block_k,
 
 
 def _dkdv_kernel_packed(
-    q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref, dk_ref, dv_ref,
+    q_ref, do_ref, out_ref, lse_ref, k_ref, v_ref, dk_ref, dv_ref,
     dk_scr, dv_scr,
     *, sm_scale, block_q, block_k, causal, seq_q, seq_k, hpc, d,
 ):
@@ -624,8 +638,16 @@ def _dkdv_kernel_packed(
             p_lo = p.astype(do.dtype)
             dv_scr[:, lo:hi] = dv_scr[:, lo:hi] + _dot_ta(p_lo, do)
             dp = _dot_tb(do, v_ref[:, lo:hi])
-            delta128 = jnp.broadcast_to(delta_ref[:, hh:hh + 1],
-                                        (block_q, _LANES))
+            # delta = rowsum(do * o) for this head, recomputed in-register
+            # (a VPU mult+rowsum, noise next to the dots) — a separate
+            # XLA/Pallas delta pass costs more in relayouts/grid overhead
+            # than it saves (measured round 4)
+            delta = jnp.sum(
+                do.astype(jnp.float32) * out_ref[:, lo:hi].astype(
+                    jnp.float32),
+                axis=-1, keepdims=True,
+            )
+            delta128 = jnp.broadcast_to(delta, (block_q, _LANES))
             ds = p * (dp - _widen(delta128, block_k))
             dk_scr[:, lo:hi] = dk_scr[:, lo:hi] + _dot_ta(
                 ds.astype(qs.dtype), qs
@@ -638,7 +660,8 @@ def _dkdv_kernel_packed(
 
 
 def _dq_kernel_packed(
-    q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref, dq_ref, dq_scr,
+    q_ref, do_ref, out_ref, lse_ref, k_ref, v_ref, dq_ref, dq_scr,
+    delta_scr,
     *, sm_scale, block_q, block_k, causal, seq_q, seq_k, hpc, d,
 ):
     qi = pl.program_id(2)
@@ -649,6 +672,16 @@ def _dq_kernel_packed(
     @pl.when(kj == 0)
     def _init():
         dq_scr[:] = jnp.zeros(dq_scr.shape, jnp.float32)
+        # per-head delta = rowsum(do * o), computed once per q block (the
+        # do/out blocks are constant across the kj sweep, so their DMAs
+        # amortize) instead of in a separate pass whose narrow output
+        # needed a strided relayout per layer
+        prod = do_ref[:].astype(jnp.float32) * out_ref[:].astype(
+            jnp.float32)
+        for hh in range(hpc):
+            delta_scr[:, hh:hh + 1] = jnp.sum(
+                prod[:, hh * d:(hh + 1) * d], axis=-1, keepdims=True
+            )
 
     visible = (
         (qi * block_q + block_q - 1 + offset) >= (kj * block_k)
@@ -672,7 +705,7 @@ def _dq_kernel_packed(
                                       (block_q, _LANES))
             p = jnp.exp(s - _widen(lse128, block_k))
             dp = _dot_tb(do, v_ref[:, lo:hi])
-            delta128 = jnp.broadcast_to(delta_ref[:, hh:hh + 1],
+            delta128 = jnp.broadcast_to(delta_scr[:, hh:hh + 1],
                                         (block_q, _LANES))
             ds = (p * (dp - _widen(delta128, block_k))).astype(q_ref.dtype)
             dq_scr[:, lo:hi] = dq_scr[:, lo:hi] + lax.dot_general(
@@ -685,17 +718,26 @@ def _dq_kernel_packed(
         dq_ref[:] = (dq_scr[:] * sm_scale).astype(dq_ref.dtype)
 
 
-def _flash_bwd_packed(qf, kf, vf, do, lse_pk, delta_pk, *, n_heads, causal,
-                      block_q, block_k, interpret):
-    """Packed grads. lse_pk/delta_pk: (b, n_packs, seq_q, hpc) fp32."""
+def _flash_bwd_packed(qf, kf, vf, do, out, lse_pk, *, n_heads, causal,
+                      block_q, block_k, interpret, fused_qkv=False):
+    """Packed grads. lse_pk: (b, n_packs, seq_q, hpc) fp32; out is the
+    saved forward output — delta (rowsum(do*o) per head) is computed
+    inside the kernels from do/out tiles whose DMAs ride the existing
+    block schedule. fused_qkv: as in _flash_fwd_packed (dq/dk/dv still
+    come back as three (b, s, h*d) arrays; the caller concatenates once
+    for the projection backward)."""
     from jax.experimental.pallas import tpu as pltpu
 
     b, seq_q, hd = qf.shape
+    if fused_qkv:
+        hd //= 3
     seq_k = kf.shape[1]
     d = hd // n_heads
     hpc = _heads_per_pack(n_heads, d)
     w = hpc * d
     n_packs = n_heads // hpc
+    koff = n_packs if fused_qkv else 0
+    voff = 2 * n_packs if fused_qkv else 0
     block_q, block_k = _check_blocks(seq_q, seq_k, block_q, block_k, causal)
     sm_scale = 1.0 / (d ** 0.5)
     offset = seq_k - seq_q if causal else 0
@@ -718,18 +760,20 @@ def _flash_bwd_packed(qf, kf, vf, do, lse_pk, delta_pk, *, n_heads, causal,
         in_specs=[
             pl.BlockSpec((None, block_q, w), qo_map),
             pl.BlockSpec((None, block_q, w), qo_map),
+            pl.BlockSpec((None, block_q, w), qo_map),
             pl.BlockSpec((None, None, block_q, hpc), stat_map_dkdv),
-            pl.BlockSpec((None, None, block_q, hpc), stat_map_dkdv),
-            pl.BlockSpec((None, block_k, w), lambda b_, g, j, i: (b_, j, g)),
-            pl.BlockSpec((None, block_k, w), lambda b_, g, j, i: (b_, j, g)),
+            pl.BlockSpec((None, block_k, w),
+                         lambda b_, g, j, i: (b_, j, g + koff)),
+            pl.BlockSpec((None, block_k, w),
+                         lambda b_, g, j, i: (b_, j, g + voff)),
         ],
         out_specs=[
             pl.BlockSpec((None, block_k, w), lambda b_, g, j, i: (b_, j, g)),
             pl.BlockSpec((None, block_k, w), lambda b_, g, j, i: (b_, j, g)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct(kf.shape, kf.dtype),
-            jax.ShapeDtypeStruct(vf.shape, vf.dtype),
+            jax.ShapeDtypeStruct((b, seq_k, hd), kf.dtype),
+            jax.ShapeDtypeStruct((b, seq_k, hd), vf.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, w), jnp.float32),
@@ -740,10 +784,13 @@ def _flash_bwd_packed(qf, kf, vf, do, lse_pk, delta_pk, *, n_heads, causal,
                                  "arbitrary")
         ),
         interpret=interpret,
-    )(qf, do, lse_pk, delta_pk, kf, vf)
+    )(qf, do, out, lse_pk, kf, vf)
 
-    def kv_map(b_, g, i, j):
-        return (b_, _redirect(causal, vis, i, j, j), g)
+    def k_map(b_, g, i, j):
+        return (b_, _redirect(causal, vis, i, j, j), g + koff)
+
+    def v_map(b_, g, i, j):
+        return (b_, _redirect(causal, vis, i, j, j), g + voff)
 
     dqk = functools.partial(
         _dq_kernel_packed, sm_scale=sm_scale, block_q=block_q,
@@ -756,23 +803,25 @@ def _flash_bwd_packed(qf, kf, vf, do, lse_pk, delta_pk, *, n_heads, causal,
         in_specs=[
             pl.BlockSpec((None, block_q, w), lambda b_, g, i, j: (b_, i, g)),
             pl.BlockSpec((None, block_q, w), lambda b_, g, i, j: (b_, i, g)),
+            pl.BlockSpec((None, block_q, w), lambda b_, g, i, j: (b_, i, g)),
             pl.BlockSpec((None, None, block_q, hpc),
                          lambda b_, g, i, j: (b_, g, i, 0)),
-            pl.BlockSpec((None, None, block_q, hpc),
-                         lambda b_, g, i, j: (b_, g, i, 0)),
-            pl.BlockSpec((None, block_k, w), kv_map),
-            pl.BlockSpec((None, block_k, w), kv_map),
+            pl.BlockSpec((None, block_k, w), k_map),
+            pl.BlockSpec((None, block_k, w), v_map),
         ],
         out_specs=pl.BlockSpec((None, block_q, w),
                                lambda b_, g, i, j: (b_, i, g)),
-        out_shape=jax.ShapeDtypeStruct(qf.shape, qf.dtype),
-        scratch_shapes=[pltpu.VMEM((block_q, w), jnp.float32)],
+        out_shape=jax.ShapeDtypeStruct((b, seq_q, hd), qf.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, w), jnp.float32),
+            pltpu.VMEM((block_q, hpc), jnp.float32),
+        ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")
         ),
         interpret=interpret,
-    )(qf, do, lse_pk, delta_pk, kf, vf)
+    )(qf, do, out, lse_pk, kf, vf)
     return dq, dk, dv
 
 
@@ -796,22 +845,87 @@ def _flash_packed_vjp_fwd(qf, kf, vf, n_heads, causal, block_q, block_k):
 def _flash_packed_vjp_bwd(n_heads, causal, block_q, block_k, res, g_out):
     qf, kf, vf, out, lse_pk = res
     g_out = g_out.astype(qf.dtype)
-    b, seq_q, hd = qf.shape
-    d = hd // n_heads
-    hpc = _heads_per_pack(n_heads, d)
-    n_packs = n_heads // hpc
-    # delta = rowsum(do * o) per head, laid out to match lse_pk
-    prod = g_out.astype(jnp.float32) * out.astype(jnp.float32)
-    delta = prod.reshape(b, seq_q, n_packs, hpc, d).sum(-1)
-    delta_pk = jnp.transpose(delta, (0, 2, 1, 3))  # (b, packs, seq, hpc)
     dq, dk, dv = _flash_bwd_packed(
-        qf, kf, vf, g_out, lse_pk, delta_pk, n_heads=n_heads, causal=causal,
+        qf, kf, vf, g_out, out, lse_pk, n_heads=n_heads, causal=causal,
         block_q=block_q, block_k=block_k, interpret=_interpret(),
     )
     return dq, dk, dv
 
 
 _flash_packed.defvjp(_flash_packed_vjp_fwd, _flash_packed_vjp_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4))
+def _flash_packed_qkv(qkvf, n_heads, causal, block_q, block_k):
+    out, _ = _flash_fwd_packed(
+        qkvf, qkvf, qkvf, n_heads=n_heads, causal=causal, block_q=block_q,
+        block_k=block_k, interpret=_interpret(), fused_qkv=True,
+    )
+    return out
+
+
+def _flash_packed_qkv_vjp_fwd(qkvf, n_heads, causal, block_q, block_k):
+    out, lse_pk = _flash_fwd_packed(
+        qkvf, qkvf, qkvf, n_heads=n_heads, causal=causal, block_q=block_q,
+        block_k=block_k, interpret=_interpret(), fused_qkv=True,
+    )
+    return out, (qkvf, out, lse_pk)
+
+
+def _flash_packed_qkv_vjp_bwd(n_heads, causal, block_q, block_k, res, g_out):
+    qkvf, out, lse_pk = res
+    g_out = g_out.astype(qkvf.dtype)
+    dq, dk, dv = _flash_bwd_packed(
+        qkvf, qkvf, qkvf, g_out, out, lse_pk, n_heads=n_heads,
+        causal=causal, block_q=block_q, block_k=block_k,
+        interpret=_interpret(), fused_qkv=True,
+    )
+    # one concatenate back to the projection layout — the only
+    # materialized boundary op on the fused path (vs 3 slice fusions +
+    # 6 relayout copies per layer on the sliced path)
+    return (jnp.concatenate([dq, dk, dv], axis=-1),)
+
+
+_flash_packed_qkv.defvjp(_flash_packed_qkv_vjp_fwd, _flash_packed_qkv_vjp_bwd)
+
+
+def flash_attention_qkv(
+    qkv: jnp.ndarray,  # (batch, seq, 3 * heads * head_dim)
+    n_heads: int,
+    *,
+    causal: bool = False,
+    block_q: int = 512,
+    block_k: int = 1024,
+) -> jnp.ndarray:
+    """Fused self-attention straight off the QKV projection output.
+
+    `qkv` is the flat (b, s, 3*h*d) activation the projection produces
+    (column order [q heads | k heads | v heads] — exactly the row-major
+    flatten of DenseGeneral's (3, h, d) features). The packed kernels
+    window it at column offsets, so q/k/v are never sliced out: at
+    lm_base shapes the sliced path paid ~4 ms/step in slice fusions and
+    layout copies around the kernel boundary (round-4 profile), all of
+    which this entry removes. Returns (b, s, h, d) like flash_attention.
+
+    Requires packable head shapes (_heads_per_pack) and seq_q == seq_k
+    (it IS self-attention); callers fall back to flash_attention with
+    explicit slices otherwise."""
+    b, s, three_hd = qkv.shape
+    if three_hd % 3:
+        raise ValueError(f"qkv last dim {three_hd} is not 3*h*d")
+    hd = three_hd // 3
+    d = hd // n_heads
+    if _heads_per_pack(n_heads, d) is None:
+        q, k, v = (
+            qkv[..., :hd], qkv[..., hd:2 * hd], qkv[..., 2 * hd:]
+        )
+        rs = lambda x: x.reshape(b, s, n_heads, d)
+        return flash_attention(
+            rs(q), rs(k), rs(v), causal=causal, block_q=block_q,
+            block_k=block_k,
+        )
+    out = _flash_packed_qkv(qkv, n_heads, causal, block_q, block_k)
+    return out.reshape(b, s, n_heads, d)
 
 
 # --------------------------------------------------------------------- #

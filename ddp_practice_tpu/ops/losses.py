@@ -8,9 +8,12 @@ fp32 loss policy; here it's explicit).
 
 from __future__ import annotations
 
+import functools
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 def cross_entropy(
@@ -48,6 +51,13 @@ def cross_entropy_sum(
     end, parallel/pipeline_1f1b.py): sum(parts) / sum(weights) equals the
     global weighted mean exactly.
     """
+    nll = _nll(logits, labels, float(label_smoothing))
+    if weight is None:
+        return jnp.sum(nll), jnp.asarray(nll.size, jnp.float32)
+    return jnp.sum(nll * weight), jnp.sum(weight)
+
+
+def _nll_forward(logits, labels, label_smoothing):
     # Never materialize a (..., V) logprobs tensor: at LM vocab sizes it
     # is gigabytes of HBM per step. Instead nll = lse - logits[target]
     # where lse is a fused max + exp-sum reduction (reads the logits in
@@ -72,9 +82,47 @@ def cross_entropy_sum(
         nll = (1.0 - label_smoothing) * nll + label_smoothing * (
             lse - mean_logits
         )
-    if weight is None:
-        return jnp.sum(nll), jnp.asarray(nll.size, jnp.float32)
-    return jnp.sum(nll * weight), jnp.sum(weight)
+    return nll, lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _nll(logits, labels, label_smoothing):
+    """Per-position nll with a hand-written backward.
+
+    Autodiff of the max/gather form above works but pays an extra
+    bookkeeping pass over the full logits (the max-VJP's argmax scatter
+    and the gather-VJP — ~1.4 ms/step at lm_base/32k vocab, round-4
+    profile). The closed form needs no third pass:
+        d nll / d logits = softmax(logits) - y_smooth,
+    y_smooth = (1-ls)*onehot + ls/V, with softmax recomputed from the
+    saved lse — an elementwise expression XLA duplicates into the
+    consuming matmul fusions, so the gradient tensor never hits HBM."""
+    nll, _ = _nll_forward(logits, labels, label_smoothing)
+    return nll
+
+
+def _nll_vjp_fwd(logits, labels, label_smoothing):
+    nll, lse = _nll_forward(logits, labels, label_smoothing)
+    return nll, (logits, labels, lse)
+
+
+def _nll_vjp_bwd(label_smoothing, res, g):
+    logits, labels, lse = res
+    p = jnp.exp(logits.astype(jnp.float32) - lse[..., None])
+    vocab = logits.shape[-1]
+    onehot = labels[..., None] == jnp.arange(vocab, dtype=labels.dtype)
+    if label_smoothing > 0.0:
+        y = (
+            (1.0 - label_smoothing) * onehot.astype(jnp.float32)
+            + label_smoothing / vocab
+        )
+    else:
+        y = onehot.astype(jnp.float32)
+    dlogits = (g[..., None] * (p - y)).astype(logits.dtype)
+    return dlogits, np.zeros(labels.shape, dtype=jax.dtypes.float0)
+
+
+_nll.defvjp(_nll_vjp_fwd, _nll_vjp_bwd)
 
 
 def accuracy_counts(

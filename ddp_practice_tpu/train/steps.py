@@ -236,14 +236,22 @@ def make_chunked_train_step(
     return jax.jit(chunk_step, donate_argnums=0)
 
 
-def _lm_train_step_fn(model, tx, label_smoothing: float = 0.0, seed: int = 0):
+def _lm_train_step_fn(model, tx, label_smoothing: float = 0.0, seed: int = 0,
+                      with_accuracy: bool = True):
     """(state, batch) -> (state, metrics) for next-token language modeling.
 
     batch["tokens"] is (batch, seq+1) int32; position t predicts t+1 (the
     standard shifted objective). Optional batch["weight"] (batch, seq)
     masks padded positions out of the mean loss. Metrics report loss,
     perplexity (exp loss), next-token accuracy, and grad_norm — the LM
-    equivalents of the image metrics in _train_step_fn."""
+    equivalents of the image metrics in _train_step_fn.
+
+    with_accuracy=False drops the per-step next-token accuracy from the
+    metrics: its argmax is a full extra pass over the (tokens, vocab)
+    logits (~1.7 ms/step at lm_base/32k vocab — round-4 profile), and the
+    reference's own train loop computes loss only (train() at
+    ddp_main.py:83-93; accuracy is the EVAL contract, ddp_main.py:96-112,
+    which eval_step keeps exact). The bench uses the loss-only form."""
 
     def train_step(state: TrainState, batch):
         tokens = batch["tokens"]
@@ -285,8 +293,12 @@ def _lm_train_step_fn(model, tx, label_smoothing: float = 0.0, seed: int = 0):
             (loss, counts), grads = model.loss_and_grad(
                 state.params, inputs, targets, weight=weight,
                 label_smoothing=label_smoothing,
+                with_accuracy=with_accuracy,
             )
-            correct, total = counts["correct"], counts["total"]
+            if counts is not None:
+                correct, total = counts["correct"], counts["total"]
+            else:
+                correct, total = None, None
             inter = {}
             # pipelined LMs carry no non-param state; a future pipelined
             # MoE would need its router bias threaded through the
@@ -299,16 +311,22 @@ def _lm_train_step_fn(model, tx, label_smoothing: float = 0.0, seed: int = 0):
             (loss, (logits, new_stats, inter)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True
             )(state.params)
-            correct, total = accuracy_counts(logits, targets, weight=weight)
+            if with_accuracy:
+                correct, total = accuracy_counts(
+                    logits, targets, weight=weight
+                )
+            else:
+                correct, total = None, None
         updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         metrics = {
             "loss": loss,
             "perplexity": jnp.exp(loss),
-            "accuracy": correct / jnp.maximum(total, 1.0),
             "grad_norm": optax.global_norm(grads),
             **_moe_metrics(inter),
         }
+        if correct is not None:
+            metrics["accuracy"] = correct / jnp.maximum(total, 1.0)
         new_state = TrainState(
             step=state.step + 1,
             params=new_params,

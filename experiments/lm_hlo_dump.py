@@ -1,8 +1,8 @@
-"""Profile the lm_base train step composition (per-op device time) with
-the current kernels, via utils/xprof — the round-3 BENCHMARKS.md method."""
-import shutil
+"""Dump the compiled HLO of the lm_base train step and print the
+definitions of the profiler's hot non-matmul ops, so each ms in the
+profile maps to a source construct."""
+import re
 import sys
-import tempfile
 
 import jax
 import jax.numpy as jnp
@@ -20,7 +20,6 @@ def main():
     from ddp_practice_tpu.parallel.sharding_rules import param_sharding_rules
     from ddp_practice_tpu.train.state import create_state, make_optimizer
     from ddp_practice_tpu.train.steps import _lm_train_step_fn
-    from ddp_practice_tpu.utils.xprof import op_summary, print_summary
 
     seq_len, vocab, bsz, K = 2048, 32768, 8, 8
     mesh = build_mesh(MeshConfig(data=-1))
@@ -35,9 +34,6 @@ def main():
         lambda r: create_state(model, tx, rng=r, sample_input=sample),
         jax.random.PRNGKey(0))
     shardings = shard_state(abstract, mesh, param_sharding_rules("lm_base"))
-    state = jax.jit(
-        lambda r: create_state(model, tx, rng=r, sample_input=sample),
-        out_shardings=shardings)(jax.random.PRNGKey(0))
 
     step_fn = _lm_train_step_fn(model, tx, with_accuracy=False)
     bsh = batch_sharding(mesh)
@@ -56,31 +52,26 @@ def main():
 
     jchunk = jax.jit(chunk, donate_argnums=0, in_shardings=(shardings,),
                      out_shardings=(shardings, rep))
-    state, m = jchunk(state)
-    _ = float(m["loss"])
-    state, m = jchunk(state)
-    _ = float(m["loss"])
+    compiled = jchunk.lower(abstract).compile()
+    txt = compiled.as_text()
+    with open("/tmp/lm_hlo.txt", "w") as f:
+        f.write(txt)
+    print(f"HLO dumped: {len(txt)} chars -> /tmp/lm_hlo.txt")
 
-    tmp = tempfile.mkdtemp(prefix="xp_lm_")
-    with jax.profiler.trace(tmp):
-        state, m = jchunk(state)
-        _ = float(m["loss"])
-    import os
-    s = op_summary(tmp)  # ONE protoc parse; both views derive from it
-    total = s["total_ps"] / 1e9 / K
-    print(f"device op time: {total:.2f} ms/step ({K} steps)")
-    cats = sorted(s["categories"].items(), key=lambda kv: -kv[1]["ps"])
-    for cat, v in cats[:8]:
-        print(f"  {v['ps']/1e9/K:7.2f} ms/step  {cat}")
-    for (cat, nm), ps in sorted(s["ops"].items(), key=lambda kv: -kv[1])[:22]:
-        print(f"  {ps/1e9/K:7.3f} ms/step  [{cat}] {nm[:70]}")
-    if os.environ.get("LM_PROFILE_DETAIL"):
-        rows = sorted(s["ops"].items(), key=lambda kv: -kv[1])
-        for (cat, nm), ps in rows:
-            if cat in ("data formatting", "copy-done", "copy",
-                       "loop fusion") and ps / 1e9 / K > 0.1:
-                print(f"{ps/1e9/K:7.3f} ms/step [{cat}] {nm[:80]}")
-    shutil.rmtree(tmp, ignore_errors=True)
+    targets = sys.argv[1:] or [
+        "iota_reduce_fusion.2 ", "fusion.2355 ", "fusion.2352 ",
+        "multiply_add_fusion.658 ", "fusion.2345 ", "copy.1428 ",
+        "multiply_add_fusion.654 ", "multiply_reduce_fusion.125 ",
+        "fusion.2351 ",
+    ]
+    for t in targets:
+        pat = "%" + t.strip() + " "
+        for line in txt.splitlines():
+            if pat in line and "= " in line.split(pat)[0][-3:] or \
+               line.strip().startswith(pat.strip() + " ="):
+                print("----", t)
+                print(line.strip()[:600])
+                break
 
 
 if __name__ == "__main__":

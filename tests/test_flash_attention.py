@@ -184,3 +184,63 @@ def test_packed_single_head_per_pack(d):
     np.testing.assert_allclose(
         np.asarray(gp), np.asarray(gd), rtol=2e-4, atol=2e-4
     )
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_fused_qkv_matches_sliced(causal):
+    """flash_attention_qkv (round 4: the kernels window the raw (b, s,
+    3*h*d) QKV-projection output at column offsets — q/k/v never
+    materialize as slices) must match slicing q/k/v out and calling
+    flash_attention: forward and the full dqkv gradient."""
+    from ddp_practice_tpu.ops.flash_attention import flash_attention_qkv
+
+    b, s, h, d = 2, 256, 4, 64
+    rng = np.random.default_rng(23)
+    qkv = jnp.asarray(rng.standard_normal((b, s, 3 * h * d)), jnp.float32)
+
+    def sliced(qkv):
+        hd = h * d
+        rs = lambda x: x.reshape(b, s, h, d)
+        return flash_attention(
+            rs(qkv[..., :hd]), rs(qkv[..., hd:2 * hd]),
+            rs(qkv[..., 2 * hd:]), causal=causal,
+        )
+
+    got = flash_attention_qkv(qkv, h, causal=causal)
+    np.testing.assert_allclose(
+        np.asarray(got.reshape(b, s, h * d)),
+        np.asarray(sliced(qkv).reshape(b, s, h * d)),
+        rtol=2e-5, atol=2e-5,
+    )
+
+    # weighted-sum loss so dq/dk/dv all flow through one qkv cotangent
+    w = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+    g_fused = jax.grad(
+        lambda t: (flash_attention_qkv(t, h, causal=causal) * w).sum()
+    )(qkv)
+    g_sliced = jax.grad(lambda t: (sliced(t) * w).sum())(qkv)
+    np.testing.assert_allclose(
+        np.asarray(g_fused), np.asarray(g_sliced), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_fused_qkv_unpackable_falls_back():
+    """h*d shapes that cannot pack must still work through the fallback
+    slice path inside flash_attention_qkv."""
+    from ddp_practice_tpu.ops.flash_attention import (
+        _heads_per_pack, flash_attention_qkv)
+
+    b, s, h, d = 2, 128, 3, 64
+    assert _heads_per_pack(h, d) is None
+    rng = np.random.default_rng(29)
+    qkv = jnp.asarray(rng.standard_normal((b, s, 3 * h * d)), jnp.float32)
+    hd = h * d
+    rs = lambda x: x.reshape(b, s, h, d)
+    want = _attention(
+        rs(qkv[..., :hd]), rs(qkv[..., hd:2 * hd]), rs(qkv[..., 2 * hd:]),
+        causal=True,
+    )
+    got = flash_attention_qkv(qkv, h, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5
+    )
