@@ -99,7 +99,8 @@ def _bdot(a, b, contract_a, contract_b, cd):
 
 def _fwd_core(xt, imgs, s, ln1_s, ln1_b, wqkv, bqkv, wproj, bproj,
               ln2_s, ln2_b, w_in, b_in, w_out, b_out,
-              *, num_heads, head_dim, compute_dtype, causal=False):
+              *, num_heads, head_dim, compute_dtype, causal=False,
+              seq_merge=1):
     """The whole layer on a (t, d) fp32 token tile; returns every
     intermediate the backward needs (the fwd kernel uses `out` only and
     the compiler drops the rest).
@@ -107,56 +108,85 @@ def _fwd_core(xt, imgs, s, ln1_s, ln1_b, wqkv, bqkv, wproj, bproj,
     Attention runs per head in a Python loop (heads are few at small d)
     with images as the dot_general batch dim: Mosaic has no 4D head
     transpose, but 64-aligned column slices + major-dim reshapes lower
-    cleanly. Head outputs accumulate straight into the projection so no
-    concat materializes. Matmuls take compute-dtype (bf16) operands with
-    fp32 accumulation — the MXU contract, matching the unfused policy;
-    LN/softmax/residual math runs in fp32.
+    cleanly. Head outputs lane-concat into o_all for a single K=d
+    projection dot (three K=64 dots measured ~21% MXU efficiency).
+    Matmuls take compute-dtype (bf16) operands with fp32 accumulation —
+    the MXU contract, matching the unfused policy; LN/softmax/residual
+    math runs in fp32, while bulky intermediates whose only consumers
+    are cd-casting dots (qkv, hg) are stored in the compute dtype
+    (bit-identical results, half the backward tile's VMEM).
     """
     cd = compute_dtype
     f32 = jnp.float32
     t, d = xt.shape
     h, hd = num_heads, head_dim
     y1a, y1hat, r1 = _layer_norm(xt, ln1_s, ln1_b)
-    qkv = _mm(y1a, wqkv, cd) + bqkv                   # (t, 3*h*hd)
+    # qkv is stored in the compute dtype: its only consumers are the
+    # per-head slices, whose dots cast to cd anyway (bit-identical), and
+    # an f32 (t, 3d) buffer was ~1.2 MB of the backward tile's VMEM
+    qkv = (_mm(y1a, wqkv, cd) + bqkv).astype(cd)      # (t, 3*h*hd)
     scale = 1.0 / (hd ** 0.5)
-    # causal (decoder-LM) masking: one (s, s) additive penalty shared by
-    # every image and head; exp(-1e30) -> 0 so the softmax bwd's p-zeros
-    # make the masked positions' gradients vanish without extra masking
+    # seq_merge m > 1 folds m images into ONE attention sequence of m*s
+    # positions under a static block-diagonal additive mask: exp(-1e30)
+    # zeroes every cross-image probability, so softmax rows, o, and all
+    # five backward dots are EXACT per image while the MXU sees (m*s)-
+    # sized operands instead of latency-dominated (s, hd) tiles (at
+    # s=64/hd=64 each dot is ~16 cycles of useful work against ~10x that
+    # in pipeline latency — the round-4 ablation measured the per-head
+    # dots at 12% efficiency, 17% of the forward kernel). The executed
+    # attention FLOPs grow m-fold; the measured win at the ViT shape
+    # (m=2..4) is what picks the default in _pick_seq_merge.
+    m = seq_merge
+    im, sm = imgs // m, s * m
+    # one (sm, sm) additive penalty shared by every merged row and head:
+    # same-image blocks pass (with the causal triangle inside each block
+    # when asked — within a diagonal block qpos >= kpos IS intra-image
+    # causality), everything else is -1e30
     penalty = None
-    if causal:
-        qpos = jax.lax.broadcasted_iota(jnp.int32, (s, s), 0)
-        kpos = jax.lax.broadcasted_iota(jnp.int32, (s, s), 1)
-        penalty = jnp.where(qpos >= kpos, 0.0, -1e30)[None]
-    proj_acc = jnp.zeros((t, d), f32)
+    if causal or m > 1:
+        qpos = jax.lax.broadcasted_iota(jnp.int32, (sm, sm), 0)
+        kpos = jax.lax.broadcasted_iota(jnp.int32, (sm, sm), 1)
+        ok = (qpos // s) == (kpos // s)
+        if causal:
+            ok = ok & (qpos >= kpos)
+        penalty = jnp.where(ok, 0.0, -1e30)[None]
     heads = []
+    outs = []
     for hi in range(h):
         def head_slice(base):
             col = base + hi * hd
-            return qkv[:, col: col + hd].reshape(imgs, s, hd)
+            return qkv[:, col: col + hd].reshape(im, sm, hd)
 
         q = head_slice(0)
         k = head_slice(h * hd)
         v = head_slice(2 * h * hd)
-        scores = _bdot(q, k, 2, 2, cd) * scale        # (imgs, s, s)
-        if causal:
+        scores = _bdot(q, k, 2, 2, cd) * scale        # (im, sm, sm)
+        if penalty is not None:
             scores = scores + penalty
         scores = scores - jnp.max(scores, axis=-1, keepdims=True)
         p = jnp.exp(scores)
         p = p / jnp.sum(p, axis=-1, keepdims=True)
-        o = _bdot(p, v, 2, 1, cd)                     # (imgs, s, hd)
-        proj_acc = proj_acc + _mm(
-            o.reshape(t, hd), wproj[hi * hd: (hi + 1) * hd, :], cd
-        )
-        heads.append((q, k, v, p, o))
-    x2 = xt + proj_acc + bproj
+        o = _bdot(p, v, 2, 1, cd)                     # (im, sm, hd)
+        outs.append(o.reshape(t, hd))
+        heads.append((q, k, v, p))
+    # concatenated head outputs -> ONE (t, d) @ (d, d) projection: three
+    # K=64 per-head dots ran at ~21% MXU efficiency (round-4 standalone
+    # shape probe); the lane-concat is a VPU copy, the K=192 dot ~3x
+    # denser
+    o_all = jnp.concatenate(outs, axis=1)             # (t, h*hd)
+    x2 = xt + _mm(o_all, wproj, cd) + bproj
     y2a, y2hat, r2 = _layer_norm(x2, ln2_s, ln2_b)
     hpre = _mm(y2a, w_in, cd) + b_in                  # (t, mlp)
     tanh = jnp.tanh(_GELU_C * (hpre + _GELU_A * hpre * hpre * hpre))
-    hg = 0.5 * hpre * (1.0 + tanh)
+    # hg in compute dtype: both consumers (the fc_out matmul here and
+    # dw_out in the backward) cast to cd — identical results, half the
+    # (t, mlp) buffer
+    hg = (0.5 * hpre * (1.0 + tanh)).astype(cd)
     out = x2 + _mm(hg, w_out, cd) + b_out
     return dict(
-        y1a=y1a, y1hat=y1hat, r1=r1, qkv=qkv, heads=heads, x2=x2,
-        y2a=y2a, y2hat=y2hat, r2=r2, hpre=hpre, tanh=tanh, hg=hg, out=out,
+        y1a=y1a, y1hat=y1hat, r1=r1, qkv=qkv, heads=heads, o_all=o_all,
+        x2=x2, y2a=y2a, y2hat=y2hat, r2=r2, hpre=hpre, tanh=tanh, hg=hg,
+        out=out,
     )
 
 
@@ -174,7 +204,7 @@ def _weights_f32(ln1_s, ln1_b, wqkv, bqkv, wproj, bproj, ln2_s, ln2_b,
 def _fused_kernel(
     x_ref, ln1_s, ln1_b, wqkv, bqkv, wproj, bproj, ln2_s, ln2_b,
     w_in, b_in, w_out, b_out, o_ref,
-    *, num_heads, head_dim, compute_dtype, causal,
+    *, num_heads, head_dim, compute_dtype, causal, seq_merge,
 ):
     """Forward grid cell: the full encoder layer for `img_tile` images."""
     imgs, s, d = x_ref.shape
@@ -184,7 +214,7 @@ def _fused_kernel(
         *_weights_f32(ln1_s, ln1_b, wqkv, bqkv, wproj, bproj, ln2_s,
                       ln2_b, w_in, b_in, w_out, b_out),
         num_heads=num_heads, head_dim=head_dim, compute_dtype=compute_dtype,
-        causal=causal,
+        causal=causal, seq_merge=seq_merge,
     )
     o_ref[:] = core["out"].reshape(imgs, s, d).astype(o_ref.dtype)
 
@@ -194,7 +224,7 @@ def _fused_bwd_kernel(
     w_in, b_in, w_out, b_out,
     dx_ref, dln1_s, dln1_b, dwqkv, dbqkv, dwproj, dbproj, dln2_s, dln2_b,
     dw_in, db_in, dw_out, db_out,
-    *, num_heads, head_dim, compute_dtype, causal,
+    *, num_heads, head_dim, compute_dtype, causal, seq_merge,
 ):
     """Backward grid cell: recompute the tile's forward in VMEM, then the
     hand-derived transposes. Weight-gradient outputs map every cell to
@@ -215,7 +245,7 @@ def _fused_bwd_kernel(
     core = _fwd_core(
         xt, imgs, s, *ws,
         num_heads=num_heads, head_dim=head_dim, compute_dtype=cd,
-        causal=causal,
+        causal=causal, seq_merge=seq_merge,
     )
 
     @pl.when(pl.program_id(0) == 0)
@@ -251,35 +281,84 @@ def _fused_bwd_kernel(
     dln2_b[:] += db2
     dx2 = g + dx2_ln
 
-    # ---- attention branch (x2 = xt + sum_h o_h @ Wproj_h + Bproj)
+    # ---- attention branch (x2 = xt + o_all @ Wproj + Bproj)
     dbproj[:] += jnp.sum(dx2, axis=0, keepdims=True)
+    dwproj[:] += mmT_left(core["o_all"], dx2)
+    do_all = mmT_right(dx2, Wproj)                    # (t, h*hd)
     scale = 1.0 / (hd ** 0.5)
     dqkv_cols = []
-    for hi, (q, k, v, p, o) in enumerate(core["heads"]):
-        Wp_h = Wproj[hi * hd: (hi + 1) * hd, :]
-        dwproj[hi * hd: (hi + 1) * hd, :] += mmT_left(o.reshape(t, hd), dx2)
-        do = mmT_right(dx2, Wp_h).reshape(imgs, s, hd)
-        dp = _bdot(do, v, 2, 2, cd)                   # (imgs, s, s)
-        dv = _bdot(p, do, 1, 1, cd)                   # (imgs, s, hd)
+    for hi, (q, k, v, p) in enumerate(core["heads"]):
+        # heads live in the seq_merge layout (imgs/m, m*s, hd); the five
+        # grad dots below are exact there — every cross-image term rides
+        # a zero of p (see _fwd_core)
+        im, sm = q.shape[0], q.shape[1]
+        do = do_all[:, hi * hd: (hi + 1) * hd].reshape(im, sm, hd)
+        dp = _bdot(do, v, 2, 2, cd)                   # (im, sm, sm)
+        dv = _bdot(p, do, 1, 1, cd)                   # (im, sm, hd)
         dsc = p * (dp - jnp.sum(dp * p, axis=-1, keepdims=True))
         dsc = dsc * scale
-        dq = _bdot(dsc, k, 2, 1, cd)                  # (imgs, s, hd)
-        dk = _bdot(dsc, q, 1, 1, cd)                  # (imgs, s, hd)
+        dq = _bdot(dsc, k, 2, 1, cd)                  # (im, sm, hd)
+        dk = _bdot(dsc, q, 1, 1, cd)                  # (im, sm, hd)
         dqkv_cols.append((dq.reshape(t, hd), dk.reshape(t, hd),
                           dv.reshape(t, hd)))
-    # columns in qkv order: all q heads, all k heads, all v heads
-    dqkv = jnp.concatenate(
+    # columns in qkv order: all q heads, all k heads, all v heads. The
+    # bias grad sums the f32 pieces FIRST; the concatenated dqkv is then
+    # stored in the compute dtype — both its consumers are dots that cast
+    # to cd anyway (bit-identical grads), and an f32 (t, 3*h*hd) buffer
+    # was ~1.7 MB of the tile's VMEM stack
+    cols = (
         [c[0] for c in dqkv_cols] + [c[1] for c in dqkv_cols]
-        + [c[2] for c in dqkv_cols], axis=1,
+        + [c[2] for c in dqkv_cols]
+    )
+    dbqkv[:] += jnp.concatenate(
+        [jnp.sum(c, axis=0, keepdims=True) for c in cols], axis=1
+    )
+    dqkv = jnp.concatenate(
+        [c.astype(cd) for c in cols], axis=1,
     )                                                  # (t, 3*h*hd)
     dwqkv[:] += mmT_left(core["y1a"], dqkv)
-    dbqkv[:] += jnp.sum(dqkv, axis=0, keepdims=True)
     dy1a = mmT_right(dqkv, Wqkv)
     dx1_ln, ds1, db1 = _layer_norm_bwd(dy1a, core["y1hat"], core["r1"], l1s)
     dln1_s[:] += ds1
     dln1_b[:] += db1
     dx = dx2 + dx1_ln
     dx_ref[:] = dx.reshape(imgs, s, d).astype(dx_ref.dtype)
+
+
+def _pick_seq_merge(s, tile, target: int = 128):
+    """Images per merged attention sequence: the largest power of two m
+    dividing the tile with m*s <= target. 128 merged positions is the
+    measured sweet spot at the ViT shape (s=64: m=2, -2% fwd / -1.5% bwd
+    vs unmerged) — bigger merges pay more masked-out FLOPs than they
+    save; sequences already >= target (the causal LM shapes) keep m=1."""
+    m = 1
+    while (
+        m * 2 * s <= target and tile % (m * 2) == 0
+    ):
+        m *= 2
+    return m
+
+
+def _vmem_params(interpret):
+    """Explicit 17 MB scoped-VMEM declaration for the fused kernels.
+
+    Under the DEFAULT declaration XLA checks each kernel against a flat
+    16 MB scoped budget, and inside a real train step the backward cell
+    at its measured-best tile (8 images — 12% faster than 4) plus XLA's
+    own S(1) buffers around the call (next-layer weight prefetches, the
+    dW result tuple) lands at 16.06 MB — a 66 KB overflow that fails the
+    e2e compile even though the standalone kernel fits. An explicit
+    vmem_limit_bytes switches XLA to its program-wide scoped-vmem
+    accounting against the physical budget (~128 MB on v5e), where the
+    whole step needs ~127.9 MB and passes with the declaration at 17 MB
+    (measured: 15/14 MB declarations FAIL that program-wide check —
+    the limit scales with the declaration — and the default fails the
+    flat check; 17 MB is the empirical window on v5e)."""
+    if interpret:
+        return None
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pltpu.CompilerParams(vmem_limit_bytes=17 * 1024 * 1024)
 
 
 def _fit_tile(n, tile):
@@ -295,18 +374,35 @@ def _auto_tile(imgs, s, compute_dtype, *, fwd: bool, d: int = 192,
 
     Calibrated on v5e at the ViT-Tiny shape (d=192, mlp 768, h=3, s=64):
     the forward fits 2048 bf16-compute tokens per cell (tile 32 at s=64 —
-    the bench shape), the backward 256 (~3x the live intermediates);
-    fp32 compute doubles the matmul operand copies, so halve the token
-    budget. Other shapes scale the budget by relative live bytes per
-    token: ~11d (residual/LN/qkv/head streams) + 3*mlp (hpre/tanh/hg) +
-    h*s (the per-head (s, s) probability tiles — the term that blows up
-    at LM sequence lengths; round-4 lm_tiny s=256 OOM'd the fixed
-    budget by 3%)."""
+    the bench shape), the backward 512 (more live intermediates; tile 8
+    measured 12% faster than 4 at the bench shape, 16 OOMs — paid for
+    by compute-dtype stores of qkv/hg/dqkv, which is also why fp32
+    compute keeps its original smaller calibrated budget rather than a
+    halved one). Other shapes scale the budget by relative live bytes
+    per token: ~11d (residual/LN/qkv/head streams) + 3*mlp
+    (hpre/tanh/hg) + h*s*seq_merge (the per-head probability tiles,
+    (m*s, m*s) under merging — the term that blows up at LM sequence
+    lengths; round-4 lm_tiny s=256 OOM'd the fixed budget by 3%)."""
     bytes_ = jnp.dtype(compute_dtype).itemsize
-    ref_cost = 11 * 192 + 3 * 768 + 3 * 64
-    cost = 11 * d + 3 * mlp_dim + num_heads * s
-    tokens = (2048 if fwd else 256) * 2 // max(bytes_, 2)
-    tokens = tokens * ref_cost // cost
+    # prospective seq_merge at this s (m*s <= 128, like _pick_seq_merge
+    # before the tile-divisibility cut): merged per-head probability
+    # tiles are (m*s, m*s) — m x the per-token bytes
+    def m_est(seq):
+        m = 1
+        while m * 2 * seq <= 128:
+            m *= 2
+        return m
+
+    ref_cost = 11 * 192 + 3 * 768 + 3 * 64 * m_est(64)
+    cost = 11 * d + 3 * mlp_dim + num_heads * s * m_est(s)
+    if bytes_ <= 2:
+        base = 2048 if fwd else 512
+    else:
+        # fp32 compute: the compute-dtype stores (qkv/hg/dqkv) that pay
+        # for the doubled bf16 backward tile free nothing here, so keep
+        # the original calibrated fp32 budget
+        base = 1024 if fwd else 128
+    tokens = base * ref_cost // cost
     return max(1, tokens // s)
 
 
@@ -382,6 +478,7 @@ def fused_encoder_forward(
     kernel = functools.partial(
         _fused_kernel, num_heads=num_heads, head_dim=d // num_heads,
         compute_dtype=compute_dtype, causal=causal,
+        seq_merge=_pick_seq_merge(s, tile),
     )
     return pl.pallas_call(
         kernel,
@@ -389,6 +486,7 @@ def fused_encoder_forward(
         in_specs=[pl.BlockSpec((tile, s, d), lambda i: (i, 0, 0))] + w_specs,
         out_specs=pl.BlockSpec((tile, s, d), lambda i: (i, 0, 0)),
         out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        compiler_params=_vmem_params(interpret),
         interpret=interpret,
     )(x, *mats)
 
@@ -416,6 +514,7 @@ def fused_encoder_backward(
     kernel = functools.partial(
         _fused_bwd_kernel, num_heads=num_heads, head_dim=d // num_heads,
         compute_dtype=compute_dtype, causal=causal,
+        seq_merge=_pick_seq_merge(s, tile),
     )
     full = lambda shape: pl.BlockSpec(shape, lambda i: (0,) * len(shape))
     dw_shapes = [
@@ -430,6 +529,7 @@ def fused_encoder_backward(
         out_specs=[x_spec] + [full(sh) for sh in dw_shapes],
         out_shape=[jax.ShapeDtypeStruct(x.shape, x.dtype)]
         + [jax.ShapeDtypeStruct(sh, f32) for sh in dw_shapes],
+        compiler_params=_vmem_params(interpret),
         interpret=interpret,
     )(x, g.astype(x.dtype), *mats)
     dx = outs[0]

@@ -1,0 +1,169 @@
+"""Ablate the fused encoder-layer FORWARD kernel's components on the chip
+to locate the gap between its 44.3% per-layer MFU and the ~73% its MXU
+shape-efficiency model predicts (BENCHMARKS.md fused section).
+
+Each variant monkeypatches one nonlinearity out of _fwd_core (identity /
+cheap substitute) and times the forward kernel alone with xprof device
+time; the delta against the full kernel is that component's serial cost.
+Numerics are wrong in ablated variants — this is a timing probe only.
+"""
+import functools
+import shutil
+import sys
+import tempfile
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, "/root/repo")
+
+from ddp_practice_tpu.ops import fused_encoder as fe
+from ddp_practice_tpu.utils.xprof import op_summary
+
+
+def device_ms(fn, *args, reps=8):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    out = fn(*args)
+    jax.block_until_ready(out)
+    tmp = tempfile.mkdtemp(prefix="xp_fa_")
+    with jax.profiler.trace(tmp):
+        for _ in range(reps):
+            out = fn(*args)
+        jax.block_until_ready(out)
+    s = op_summary(tmp)
+    shutil.rmtree(tmp, ignore_errors=True)
+    return s["total_ps"] / 1e9 / reps
+
+
+def make_params(key, d, mlp, h):
+    ks = jax.random.split(key, 8)
+    n = jax.nn.initializers.normal(0.02)
+    return {
+        "ln1": {"scale": jnp.ones((d,)), "bias": jnp.zeros((d,))},
+        "attn": {
+            "qkv": {"kernel": n(ks[0], (d, 3, h, d // h)),
+                    "bias": jnp.zeros((3, h, d // h))},
+            "out": {"kernel": n(ks[1], (h, d // h, d)),
+                    "bias": jnp.zeros((d,))},
+        },
+        "ln2": {"scale": jnp.ones((d,)), "bias": jnp.zeros((d,))},
+        "mlp": {
+            "fc_in": {"kernel": n(ks[2], (d, mlp)), "bias": jnp.zeros((mlp,))},
+            "fc_out": {"kernel": n(ks[3], (mlp, d)), "bias": jnp.zeros((d,))},
+        },
+    }
+
+
+def main():
+    b, s, d, h, mlp = 1024, 64, 192, 3, 768
+    key = jax.random.PRNGKey(0)
+    x = jax.random.normal(jax.random.fold_in(key, 1), (b, s, d), jnp.bfloat16)
+    params = make_params(key, d, mlp, h)
+    fwd = jax.jit(functools.partial(
+        fe.fused_encoder_forward, num_heads=h, compute_dtype=jnp.bfloat16))
+
+    flops_fwd = b * s * (
+        2 * d * 3 * d + 2 * 2 * s * (d // h) * h + 2 * d * d
+        + 2 * 2 * d * mlp)
+
+    base = device_ms(fwd, x, params)
+    print(f"full fwd kernel: {base:.3f} ms  "
+          f"({flops_fwd / (base * 1e-3) / 1e12:.1f} TF/s, "
+          f"{flops_fwd / (base * 1e-3) / 197e12 * 100:.1f}% MFU)")
+
+    orig_core = fe._fwd_core
+
+    def run_variant(name, patch):
+        src = patch()
+        try:
+            t = device_ms(jax.jit(functools.partial(
+                fe.fused_encoder_forward, num_heads=h,
+                compute_dtype=jnp.bfloat16)), x, params)
+            print(f"{name:28s} {t:.3f} ms   delta {base - t:+.3f}")
+        finally:
+            fe._fwd_core = orig_core
+        return t
+
+    # 1. gelu -> identity (keeps both matmuls)
+    def no_gelu():
+        def core(*a, **k):
+            import types
+            return _core_patched(*a, gelu="id", **k)
+        fe._fwd_core = core
+    # 2. softmax -> scale only
+    def no_softmax():
+        def core(*a, **k):
+            return _core_patched(*a, softmax="id", **k)
+        fe._fwd_core = core
+    # 3. LN -> affine only (no mean/var/rsqrt)
+    def no_ln():
+        def core(*a, **k):
+            return _core_patched(*a, ln="id", **k)
+        fe._fwd_core = core
+    # 4. all three off: the pure-matmul skeleton
+    def matmul_only():
+        def core(*a, **k):
+            return _core_patched(*a, gelu="id", softmax="id", ln="id", **k)
+        fe._fwd_core = core
+
+    def _core_patched(xt, imgs, s_, ln1_s, ln1_b, wqkv, bqkv, wproj, bproj,
+                      ln2_s, ln2_b, w_in, b_in, w_out, b_out,
+                      *, num_heads, head_dim, compute_dtype, causal=False,
+                      seq_merge=1, gelu="full", softmax="full", ln="full"):
+        cd = compute_dtype
+        f32 = jnp.float32
+        t, dd = xt.shape
+        hh, hd = num_heads, head_dim
+
+        def LN(v, sc, bi):
+            if ln == "id":
+                return v * sc + bi, v, jnp.ones((t, 1), f32)
+            return fe._layer_norm(v, sc, bi)
+
+        y1a, y1hat, r1 = LN(xt, ln1_s, ln1_b)
+        qkv = fe._mm(y1a, wqkv, cd) + bqkv
+        sc_ = 1.0 / (hd ** 0.5)
+        proj_acc = jnp.zeros((t, dd), f32)
+        heads = []
+        for hi in range(hh):
+            def head_slice(base):
+                col = base + hi * hd
+                return qkv[:, col: col + hd].reshape(imgs, s_, hd)
+            q = head_slice(0)
+            k = head_slice(hh * hd)
+            v = head_slice(2 * hh * hd)
+            scores = fe._bdot(q, k, 2, 2, cd) * sc_
+            if softmax == "id":
+                p = scores
+            else:
+                scores = scores - jnp.max(scores, axis=-1, keepdims=True)
+                p = jnp.exp(scores)
+                p = p / jnp.sum(p, axis=-1, keepdims=True)
+            o = fe._bdot(p, v, 2, 1, cd)
+            proj_acc = proj_acc + fe._mm(
+                o.reshape(t, hd), wproj[hi * hd: (hi + 1) * hd, :], cd)
+            heads.append((q, k, v, p, o))
+        x2 = xt + proj_acc + bproj
+        y2a, y2hat, r2 = LN(x2, ln2_s, ln2_b)
+        hpre = fe._mm(y2a, w_in, cd) + b_in
+        if gelu == "id":
+            tanh = hpre
+            hg = hpre
+        else:
+            tanh = jnp.tanh(fe._GELU_C * (
+                hpre + fe._GELU_A * hpre * hpre * hpre))
+            hg = 0.5 * hpre * (1.0 + tanh)
+        out = x2 + fe._mm(hg, w_out, cd) + b_out
+        return dict(y1a=y1a, y1hat=y1hat, r1=r1, qkv=qkv, heads=heads,
+                    x2=x2, y2a=y2a, y2hat=y2hat, r2=r2, hpre=hpre,
+                    tanh=tanh, hg=hg, out=out)
+
+    run_variant("gelu -> identity", no_gelu)
+    run_variant("softmax -> identity", no_softmax)
+    run_variant("LN -> affine only", no_ln)
+    run_variant("matmul skeleton only", matmul_only)
+
+
+if __name__ == "__main__":
+    main()
