@@ -80,9 +80,16 @@ _SUITE = {
     "lm_moe": dict(
         kind="lm", model="lm_moe", seq_len=2048, batch_size=8,
         steps_per_call=4, calls=4, warmup_calls=10, data="corpus",
+        # routing groups of 256 strided-interleaved tokens at capacity
+        # 1.5 (round-4 sweep, BENCHMARKS.md): the dispatch/combine
+        # einsums are O(group_size) per token, so 2048 -> 256 cuts them
+        # ~8x, and the interleave decorrelates per-group demand enough
+        # that cf 1.5 drops LESS (1.1%) than whole-sequence cf 2.0 did
+        # (1.4%) — +29% tokens/s at equal-or-better router health
         model_kwargs={
             "hidden_dim": 768, "depth": 12, "num_heads": 12,
             "mlp_dim": 3072, "moe_every": 2, "num_experts": 8,
+            "moe_group_size": 256, "capacity_factor": 1.5,
         },
     ),
     # short-seq decoder LM through the fused Pallas encoder-layer kernels

@@ -79,6 +79,11 @@ class TransformerLM(nn.Module):
     # online selection-bias update rate (ops/moe.py MoEMlp
     # bias_update_rate); 0 disables the aux-free balancer
     moe_bias_rate: float = 0.02
+    # tokens per routing group (0 = whole sequence); smaller groups cut
+    # the dispatch einsum cost ~linearly at a measured capacity tradeoff
+    # (ops/moe.py group_size)
+    moe_group_size: int = 0
+    moe_group_stride: bool = True
     # run each block as ONE Pallas kernel per direction with causal
     # masking (ops/fused_encoder.py, round 4) — the small-d short-seq
     # HBM-bound fix, now available to decoder LMs. Training-only
@@ -196,6 +201,8 @@ class TransformerLM(nn.Module):
                 capacity_factor=self.capacity_factor,
                 moe_aux_weight=self.moe_aux_weight,
                 moe_bias_rate=self.moe_bias_rate,
+                moe_group_size=self.moe_group_size,
+                moe_group_stride=self.moe_group_stride,
                 fused=self.fused and not decode,
                 name=f"block{i}",
             )

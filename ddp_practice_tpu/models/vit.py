@@ -288,6 +288,9 @@ class EncoderBlock(nn.Module):
     capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01
     moe_bias_rate: float = 0.02
+    # tokens per routing group (0 = whole sequence); see ops/moe.py
+    moe_group_size: int = 0
+    moe_group_stride: bool = True
     # run the whole layer as ONE Pallas kernel per direction
     # (ops/fused_encoder.py): the HBM-bound small-d regime's fix
     # (BENCHMARKS.md ViT-Tiny analysis). Short-sequence bidirectional
@@ -350,6 +353,8 @@ class EncoderBlock(nn.Module):
                 capacity_factor=self.capacity_factor,
                 aux_loss_weight=self.moe_aux_weight,
                 bias_update_rate=self.moe_bias_rate,
+                group_size=self.moe_group_size,
+                group_stride=self.moe_group_stride,
                 mlp_dim=self.mlp_dim,
                 dtype=self.dtype,
                 param_dtype=self.param_dtype,

@@ -325,7 +325,12 @@ def _fused_bwd_kernel(
     dx_ref[:] = dx.reshape(imgs, s, d).astype(dx_ref.dtype)
 
 
-def _pick_seq_merge(s, tile, target: int = 128):
+# merged attention positions ceiling shared by _pick_seq_merge and
+# _auto_tile's budget estimate — retune in ONE place
+_MERGE_TARGET = 128
+
+
+def _pick_seq_merge(s, tile, target: int = _MERGE_TARGET):
     """Images per merged attention sequence: the largest power of two m
     dividing the tile with m*s <= target. 128 merged positions is the
     measured sweet spot at the ViT shape (s=64: m=2, -2% fwd / -1.5% bwd
@@ -384,12 +389,12 @@ def _auto_tile(imgs, s, compute_dtype, *, fwd: bool, d: int = 192,
     (m*s, m*s) under merging — the term that blows up at LM sequence
     lengths; round-4 lm_tiny s=256 OOM'd the fixed budget by 3%)."""
     bytes_ = jnp.dtype(compute_dtype).itemsize
-    # prospective seq_merge at this s (m*s <= 128, like _pick_seq_merge
-    # before the tile-divisibility cut): merged per-head probability
-    # tiles are (m*s, m*s) — m x the per-token bytes
+    # prospective seq_merge at this s (like _pick_seq_merge before the
+    # tile-divisibility cut): merged per-head probability tiles are
+    # (m*s, m*s) — m x the per-token bytes
     def m_est(seq):
         m = 1
-        while m * 2 * seq <= 128:
+        while m * 2 * seq <= _MERGE_TARGET:
             m *= 2
         return m
 

@@ -140,12 +140,46 @@ class MoEMlp(nn.Module):
     # Lives in "batch_stats" so it rides the existing non-param state
     # plumbing (train/steps.py, checkpointing). 0 disables.
     bias_update_rate: float = 0.02
+    # tokens per routing group. 0 = one group per leading-dim row (the
+    # whole sequence — the GShard default). Smaller groups cut the
+    # dispatch/combine einsum cost, which is O(group_size) PER TOKEN
+    # (the one-hot contracts t x (E*C) with C ∝ group_size): at lm_moe
+    # shape, group 2048 -> 256 is ~8x less dispatch matmul. The price is
+    # capacity granularity: per-group demand varies more, so pair small
+    # groups with the strided interleave below and a measured capacity
+    # factor (BENCHMARKS.md round-4 MoE section).
+    group_size: int = 0
+    # interleave-stride the sequence into groups (with n_sub = seq /
+    # group_size groups per sequence, group j takes tokens {j, j+n_sub,
+    # j+2*n_sub, ...}): adjacent tokens — which share local context
+    # and crowd the same experts — land in DIFFERENT groups, so
+    # per-group demand concentrates less than contiguous chunks at the
+    # same size. Shard-safe: the transpose is within one sequence
+    # (leading dim untouched), so dp sharding never moves.
+    group_stride: bool = True
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
     expert_axis: Optional[str] = MeshConfig.AXIS_EXPERT
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:  # (G, T, D)
+        g0, t0, d = x.shape
+        n_sub = 1
+        if 0 < self.group_size < t0:
+            if t0 % self.group_size:
+                raise ValueError(
+                    f"moe group_size {self.group_size} must divide the "
+                    f"sequence length {t0}"
+                )
+            n_sub = t0 // self.group_size
+            if self.group_stride:
+                # (g0, t0, d) -> (g0 * n_sub, group_size, d), group j of
+                # a sequence = tokens {j, j + n_sub, ...}
+                x = x.reshape(g0, self.group_size, n_sub, d)
+                x = jnp.swapaxes(x, 1, 2)
+                x = x.reshape(g0 * n_sub, self.group_size, d)
+            else:
+                x = x.reshape(g0 * n_sub, self.group_size, d)
         g, t, d = x.shape
         e, f = self.num_experts, self.mlp_dim
         capacity = max(
@@ -229,4 +263,9 @@ class MoEMlp(nn.Module):
         out = out + b_out.astype(cdtype)[:, None, None, :]
         out = _constrain(out, (ax, MeshConfig.AXIS_DATA, None, None))
         y = jnp.einsum("gtec,egcd->gtd", combine.astype(cdtype), out)
+        if n_sub > 1:
+            if self.group_stride:
+                y = y.reshape(g0, n_sub, self.group_size, d)
+                y = jnp.swapaxes(y, 1, 2)
+            y = y.reshape(g0, t0, d)
         return y.astype(x.dtype)

@@ -1,11 +1,12 @@
 """Ablate the fused encoder-layer FORWARD kernel's components on the chip
-to locate the gap between its 44.3% per-layer MFU and the ~73% its MXU
-shape-efficiency model predicts (BENCHMARKS.md fused section).
+(the probe behind the BENCHMARKS.md fused-kernel cost attribution).
 
 Each variant monkeypatches one nonlinearity out of _fwd_core (identity /
 cheap substitute) and times the forward kernel alone with xprof device
 time; the delta against the full kernel is that component's serial cost.
-Numerics are wrong in ablated variants — this is a timing probe only.
+_core_patched mirrors the CURRENT production core (concat projection,
+seq_merge honored) so deltas isolate exactly one component. Numerics are
+wrong in ablated variants — this is a timing probe only.
 """
 import functools
 import shutil
@@ -75,7 +76,7 @@ def main():
     orig_core = fe._fwd_core
 
     def run_variant(name, patch):
-        src = patch()
+        patch()
         try:
             t = device_ms(jax.jit(functools.partial(
                 fe.fused_encoder_forward, num_heads=h,
@@ -88,7 +89,6 @@ def main():
     # 1. gelu -> identity (keeps both matmuls)
     def no_gelu():
         def core(*a, **k):
-            import types
             return _core_patched(*a, gelu="id", **k)
         fe._fwd_core = core
     # 2. softmax -> scale only
@@ -122,18 +122,28 @@ def main():
             return fe._layer_norm(v, sc, bi)
 
         y1a, y1hat, r1 = LN(xt, ln1_s, ln1_b)
-        qkv = fe._mm(y1a, wqkv, cd) + bqkv
+        qkv = (fe._mm(y1a, wqkv, cd) + bqkv).astype(cd)
         sc_ = 1.0 / (hd ** 0.5)
-        proj_acc = jnp.zeros((t, dd), f32)
+        m = seq_merge
+        im, sm = imgs // m, s_ * m
+        penalty = None
+        if m > 1:
+            qpos = jax.lax.broadcasted_iota(jnp.int32, (sm, sm), 0)
+            kpos = jax.lax.broadcasted_iota(jnp.int32, (sm, sm), 1)
+            penalty = jnp.where(
+                (qpos // s_) == (kpos // s_), 0.0, -1e30)[None]
         heads = []
+        outs = []
         for hi in range(hh):
             def head_slice(base):
                 col = base + hi * hd
-                return qkv[:, col: col + hd].reshape(imgs, s_, hd)
+                return qkv[:, col: col + hd].reshape(im, sm, hd)
             q = head_slice(0)
             k = head_slice(hh * hd)
             v = head_slice(2 * hh * hd)
             scores = fe._bdot(q, k, 2, 2, cd) * sc_
+            if penalty is not None:
+                scores = scores + penalty
             if softmax == "id":
                 p = scores
             else:
@@ -141,23 +151,23 @@ def main():
                 p = jnp.exp(scores)
                 p = p / jnp.sum(p, axis=-1, keepdims=True)
             o = fe._bdot(p, v, 2, 1, cd)
-            proj_acc = proj_acc + fe._mm(
-                o.reshape(t, hd), wproj[hi * hd: (hi + 1) * hd, :], cd)
-            heads.append((q, k, v, p, o))
-        x2 = xt + proj_acc + bproj
+            outs.append(o.reshape(t, hd))
+            heads.append((q, k, v, p))
+        o_all = jnp.concatenate(outs, axis=1)
+        x2 = xt + fe._mm(o_all, wproj, cd) + bproj
         y2a, y2hat, r2 = LN(x2, ln2_s, ln2_b)
         hpre = fe._mm(y2a, w_in, cd) + b_in
         if gelu == "id":
             tanh = hpre
-            hg = hpre
+            hg = hpre.astype(cd)
         else:
             tanh = jnp.tanh(fe._GELU_C * (
                 hpre + fe._GELU_A * hpre * hpre * hpre))
-            hg = 0.5 * hpre * (1.0 + tanh)
+            hg = (0.5 * hpre * (1.0 + tanh)).astype(cd)
         out = x2 + fe._mm(hg, w_out, cd) + b_out
         return dict(y1a=y1a, y1hat=y1hat, r1=r1, qkv=qkv, heads=heads,
-                    x2=x2, y2a=y2a, y2hat=y2hat, r2=r2, hpre=hpre,
-                    tanh=tanh, hg=hg, out=out)
+                    o_all=o_all, x2=x2, y2a=y2a, y2hat=y2hat, r2=r2,
+                    hpre=hpre, tanh=tanh, hg=hg, out=out)
 
     run_variant("gelu -> identity", no_gelu)
     run_variant("softmax -> identity", no_softmax)

@@ -260,3 +260,50 @@ def test_router_balances_over_training(devices):
     # the selection bias actually moved (the balancer ran)
     bias_leaves = jax.tree.leaves(state.batch_stats)
     assert any(float(jnp.max(jnp.abs(b))) > 0.0 for b in bias_leaves)
+
+
+def test_group_size_permutation_exact():
+    """group_size routing (round 4) must be a pure regrouping: with one
+    expert and ample capacity nothing can drop, gates are 1, and the
+    expert MLP is row-wise — so grouped (strided AND contiguous) outputs
+    must match the ungrouped module EXACTLY. This pins the interleave
+    permutation and its inverse."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ddp_practice_tpu.ops.moe import MoEMlp
+
+    x = jnp.asarray(
+        np.random.default_rng(11).standard_normal((2, 64, 16)), jnp.float32
+    )
+    outs = {}
+    for name, kw in [
+        ("ungrouped", {}),
+        ("strided", {"group_size": 16, "group_stride": True}),
+        ("contig", {"group_size": 16, "group_stride": False}),
+    ]:
+        m = MoEMlp(num_experts=1, top_k=1, capacity_factor=4.0,
+                   mlp_dim=32, expert_axis=None, **kw)
+        params = m.init(jax.random.PRNGKey(0), x)
+        outs[name] = m.apply(params, x)
+    np.testing.assert_array_equal(
+        np.asarray(outs["ungrouped"]), np.asarray(outs["strided"])
+    )
+    np.testing.assert_array_equal(
+        np.asarray(outs["ungrouped"]), np.asarray(outs["contig"])
+    )
+
+
+def test_group_size_must_divide_seq():
+    import jax
+    import jax.numpy as jnp
+    import pytest
+
+    from ddp_practice_tpu.ops.moe import MoEMlp
+
+    m = MoEMlp(num_experts=2, top_k=1, mlp_dim=32, group_size=48,
+               expert_axis=None)
+    x = jnp.zeros((1, 64, 16))
+    with pytest.raises(ValueError, match="must divide"):
+        m.init(jax.random.PRNGKey(0), x)
