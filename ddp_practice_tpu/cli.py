@@ -143,11 +143,16 @@ def build_parser() -> argparse.ArgumentParser:
                    help="persistent XLA compilation cache dir (repeat runs "
                         "skip compile); auto = ~/.cache/ddp_practice_tpu/xla, "
                         "off = disable")
-    p.add_argument("--fused", action="store_true",
-                   help="run encoder layers as fused Pallas kernels "
+    p.add_argument("--fused", nargs="?", const="on", default="auto",
+                   choices=["auto", "on", "off"],
+                   help="fused Pallas encoder-layer kernels "
                         "(ops/fused_encoder.py — the small-d HBM-bound "
-                        "fix; vit_tiny, or dense LMs with head_dim >= 64 "
-                        "via --num_heads)")
+                        "fix). auto (default): selected whenever the "
+                        "model/shape supports them (vit_tiny, dense LMs "
+                        "with head_dim a multiple of 64 via --num_heads), "
+                        "silent per-op fallback otherwise; on (or bare "
+                        "--fused): force, raising on unsupported configs; "
+                        "off: always per-op")
     p.add_argument("--augment", action="store_true",
                    help="on-device augmentation inside the jitted train "
                         "step (image models; deterministic per seed/step — "

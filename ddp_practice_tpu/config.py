@@ -204,8 +204,11 @@ class TrainConfig:
     # ImageNet rung — ResNet-50/224)
     augment_kind: str = "crop_flip"
 
-    # ViT encoder layers as fused Pallas kernels (ops/fused_encoder.py)
-    fused_encoder: bool = False
+    # encoder layers as fused Pallas kernels (ops/fused_encoder.py):
+    # "auto" (default) = the model picks them whenever its constraints
+    # hold (models/vit.py EncoderBlock._auto_fuse); "on"/True = force,
+    # raising on unsupported configs; "off"/False = per-op pipeline
+    fused_encoder: object = "auto"  # "auto" | "on"/True | "off"/False
     # MoE expert count when mesh.expert > 1 (0 = auto: 8 rounded up to a
     # multiple of the expert axis)
     num_experts: int = 0

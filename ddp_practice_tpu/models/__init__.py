@@ -21,13 +21,24 @@ from ddp_practice_tpu.models.vit_moe import ViTMoE
 from ddp_practice_tpu.models.lm import LMBase, LMTiny, TransformerLM
 
 _REGISTRY = {}
+# registry names whose module exposes the tri-state `fused` field
+# (bool | "auto" — models/vit.py EncoderBlock); declared at registration
+# so callers (train/loop.py --fused off) never maintain a parallel list
+_FUSED_CAPABLE = set()
 
 
-def register(name):
+def register(name, *, fused_capable: bool = False):
     def deco(fn):
         _REGISTRY[name] = fn
+        if fused_capable:
+            _FUSED_CAPABLE.add(name)
         return fn
     return deco
+
+
+def accepts_fused(name: str) -> bool:
+    """True when `create_model(name, fused=...)` is a valid call."""
+    return name.lower() in _FUSED_CAPABLE
 
 
 def create_model(
@@ -85,7 +96,7 @@ def _resnet50(*, num_classes, policy, axis_name, **kw):
     )
 
 
-@register("vit_tiny")
+@register("vit_tiny", fused_capable=True)
 def _vit_tiny(*, num_classes, policy, axis_name, **kw):
     return ViTTiny(
         num_classes=num_classes,
@@ -95,7 +106,7 @@ def _vit_tiny(*, num_classes, policy, axis_name, **kw):
     )
 
 
-@register("vit_base")
+@register("vit_base", fused_capable=True)
 def _vit_base(*, num_classes, policy, axis_name, **kw):
     return ViTBase(
         num_classes=num_classes,
@@ -119,7 +130,7 @@ def _vit_tiny_moe(*, num_classes, policy, axis_name, **kw):
     )
 
 
-@register("lm_tiny")
+@register("lm_tiny", fused_capable=True)
 def _lm_tiny(*, num_classes, policy, axis_name, **kw):
     # LMs have a vocab, not classes: num_classes/axis_name are accepted for
     # registry uniformity and ignored (vocab_size is an explicit kwarg)
@@ -130,7 +141,7 @@ def _lm_tiny(*, num_classes, policy, axis_name, **kw):
     )
 
 
-@register("lm_base")
+@register("lm_base", fused_capable=True)
 def _lm_base(*, num_classes, policy, axis_name, **kw):
     return LMBase(
         dtype=policy.compute_dtype,
@@ -154,7 +165,7 @@ def _vit_tiny_pipe(*, num_classes, policy, axis_name, **kw):
     )
 
 
-@register("lm_moe")
+@register("lm_moe", fused_capable=True)
 def _lm_moe(*, num_classes, policy, axis_name, **kw):
     # decoder LM with routed expert MLPs every other block (GShard
     # layout); dims default to lm_tiny's — the bench sizes it up via

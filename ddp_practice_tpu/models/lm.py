@@ -90,7 +90,10 @@ class TransformerLM(nn.Module):
     # execution strategy: params are identical to the unfused model, so
     # checkpoints generate through the normal (unfused) decode path.
     # Composes with pos_emb="learned" only (the kernel refuses rope).
-    fused: bool = False
+    # "auto" (default, round 5) fuses when the EncoderBlock's
+    # constraints hold — e.g. lm_tiny needs num_heads=4 for the 64-
+    # aligned head_dim; the default heads=8 silently keeps per-op.
+    fused: object = "auto"  # bool | "auto"
     axis_name: Optional[str] = None  # registry uniformity (no BN anywhere)
 
     @nn.compact
@@ -180,6 +183,10 @@ class TransformerLM(nn.Module):
             else EncoderBlock
         )
         for i in range(self.depth):
+            block_moe = (
+                self.moe_every > 0
+                and i % self.moe_every == self.moe_every - 1
+            )
             block = block_cls(
                 self.num_heads,
                 self.mlp_dim,
@@ -192,10 +199,7 @@ class TransformerLM(nn.Module):
                 rope=self.pos_emb == "rope",
                 kv_cache_dtype=self.kv_cache_dtype,
                 dropout_rate=self.dropout_rate,
-                use_moe=(
-                    self.moe_every > 0
-                    and i % self.moe_every == self.moe_every - 1
-                ),
+                use_moe=block_moe,
                 num_experts=self.num_experts,
                 moe_top_k=self.moe_top_k,
                 capacity_factor=self.capacity_factor,
@@ -203,7 +207,13 @@ class TransformerLM(nn.Module):
                 moe_bias_rate=self.moe_bias_rate,
                 moe_group_size=self.moe_group_size,
                 moe_group_stride=self.moe_group_stride,
-                fused=self.fused and not decode,
+                # tri-state pass-through ("auto" must survive; `and` would
+                # collapse it to a bool). decode always takes the per-op
+                # KV-cache path; routed blocks can never fuse (the kernel
+                # has no expert dispatch), so a forced fused=True means
+                # "fuse every DENSE block" rather than raising on the
+                # MoE-interleaved layout
+                fused=False if (decode or block_moe) else self.fused,
                 name=f"block{i}",
             )
             # positional (decode, train): nn.remat's static_argnums are

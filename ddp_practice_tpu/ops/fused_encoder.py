@@ -358,12 +358,28 @@ def _vmem_params(interpret):
     whole step needs ~127.9 MB and passes with the declaration at 17 MB
     (measured: 15/14 MB declarations FAIL that program-wide check —
     the limit scales with the declaration — and the default fails the
-    flat check; 17 MB is the empirical window on v5e)."""
+    flat check; 17 MB is the empirical window on v5e).
+
+    The window is v5e-calibrated; other shapes/TPU generations can
+    retune without editing the kernel via DDP_TPU_FUSED_VMEM_MB
+    (advisor round 4)."""
     if interpret:
         return None
+    import os
+
     from jax.experimental.pallas import tpu as pltpu
 
-    return pltpu.CompilerParams(vmem_limit_bytes=17 * 1024 * 1024)
+    raw = os.environ.get("DDP_TPU_FUSED_VMEM_MB", "17")
+    try:
+        mb = int(raw)
+        if mb <= 0:
+            raise ValueError(raw)
+    except ValueError:
+        raise ValueError(
+            f"DDP_TPU_FUSED_VMEM_MB={raw!r}: want a positive integer "
+            "(MB of scoped VMEM to declare for the fused encoder kernels)"
+        ) from None
+    return pltpu.CompilerParams(vmem_limit_bytes=mb * 1024 * 1024)
 
 
 def _fit_tile(n, tile):
@@ -374,7 +390,8 @@ def _fit_tile(n, tile):
 
 
 def _auto_tile(imgs, s, compute_dtype, *, fwd: bool, d: int = 192,
-               mlp_dim: int = 768, num_heads: int = 3):
+               mlp_dim: int = 768, num_heads: int = 3,
+               strict: bool = False):
     """Default images-per-cell honoring the 16 MB scoped-VMEM budget.
 
     Calibrated on v5e at the ViT-Tiny shape (d=192, mlp 768, h=3, s=64):
@@ -408,7 +425,36 @@ def _auto_tile(imgs, s, compute_dtype, *, fwd: bool, d: int = 192,
         # the original calibrated fp32 budget
         base = 1024 if fwd else 128
     tokens = base * ref_cost // cost
+    if strict:
+        # feasibility probe (fused_shape_supported): 0 = the budget does
+        # not admit even one full sequence per cell
+        return tokens // s
     return max(1, tokens // s)
+
+
+def fused_shape_supported(*, seq_len: int, d: int, mlp_dim: int,
+                          num_heads: int, compute_dtype) -> bool:
+    """True when the fused kernels can run this encoder shape at all.
+
+    The auto-selection predicate (EncoderBlock fused="auto"): mirrors the
+    kernel's hard constraints without raising — head_dim 64-aligned
+    column slices (_prep), whole-weight VMEM residency
+    (_check_vmem_residency), and a backward VMEM budget that admits at
+    least one full sequence per grid cell (_auto_tile's token budget;
+    long-sequence models fail here and keep the streaming flash kernels
+    instead). Callers that want loud failures pass fused=True and get
+    the original ValueErrors."""
+    if not _head_dim_ok(d, num_heads):
+        return False
+    try:
+        _check_vmem_residency(d, mlp_dim, compute_dtype)
+    except ValueError:
+        return False
+    # backward (the tighter budget) must fit >= 1 sequence per cell
+    return _auto_tile(
+        seq_len, seq_len, compute_dtype, fwd=False, d=d, mlp_dim=mlp_dim,
+        num_heads=num_heads, strict=True,
+    ) >= 1
 
 
 def _check_vmem_residency(d, mlp_dim, compute_dtype):
@@ -428,12 +474,19 @@ def _check_vmem_residency(d, mlp_dim, compute_dtype):
         )
 
 
+def _head_dim_ok(d: int, num_heads: int) -> bool:
+    """The in-kernel head walk's alignment contract — ONE definition
+    shared by _prep's loud gate and fused_shape_supported's silent
+    auto-selection predicate."""
+    return d % num_heads == 0 and (d // num_heads) % 64 == 0
+
+
 def _prep(x, params, num_heads, img_tile, compute_dtype):
     """(dims, weight mats, weight specs) shared by the fwd/bwd wrappers."""
     imgs, s, d = x.shape
     if d % num_heads:
         raise ValueError(f"d={d} % heads={num_heads}")
-    if (d // num_heads) % 64:
+    if not _head_dim_ok(d, num_heads):
         raise ValueError(
             f"fused encoder layer needs head_dim a multiple of 64 (got "
             f"{d // num_heads}): the in-kernel head walk slices qkv "

@@ -165,6 +165,24 @@ class MoEMlp(nn.Module):
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:  # (G, T, D)
         g0, t0, d = x.shape
         n_sub = 1
+        if (self.group_size > t0 and not self.is_initializing()
+                and self.is_mutable_collection("batch_stats")):
+            # a group larger than the sequence cannot exist; routing falls
+            # back to whole-sequence, whose capacity behavior differs from
+            # what the group-tuned capacity factor was calibrated for
+            # (advisor round 4). Warn, don't raise — and only on the
+            # TRAINING path (mutable batch_stats, like the router-bias
+            # update): short inputs are NORMAL in decode/prefill (t0 =
+            # prompt length or 1 — inference.py drives this module with
+            # the training group_size) and must stay silent.
+            import warnings
+
+            warnings.warn(
+                f"moe group_size {self.group_size} exceeds the sequence "
+                f"length {t0}: routing whole-sequence — pass 0 or a "
+                "divisor of the sequence length",
+                stacklevel=2,
+            )
         if 0 < self.group_size < t0:
             if t0 % self.group_size:
                 raise ValueError(

@@ -160,18 +160,34 @@ class Trainer:
             # only attention models accept this; a conv model raises loudly
             # rather than silently ignoring the requested kernel
             model_kwargs["attn_impl"] = config.attn_impl
-        if config.fused_encoder:
-            if config.model not in ("vit_tiny", "lm_tiny"):
+        fused_req = config.fused_encoder
+        from ddp_practice_tpu.models import accepts_fused
+
+        if fused_req in (True, "on"):
+            if not accepts_fused(config.model):
                 raise ValueError(
-                    "--fused is the small-d fused encoder-layer kernel "
-                    "(ops/fused_encoder.py): vit_tiny, or lm_tiny with "
-                    "--num_heads 4 (causal masking landed in round 4; "
-                    "head_dim must be a multiple of 64). Wide models "
-                    "(vit_base, lm_base) exceed the kernel's VMEM weight-"
-                    "residency budget and are compute-bound unfused "
-                    "(BENCHMARKS.md); conv/pipelined/MoE keep their paths"
+                    "--fused on is the small-d fused encoder-layer kernel "
+                    "(ops/fused_encoder.py) for the dense transformer "
+                    f"families, not {config.model!r} (conv/pipelined/"
+                    "ViT-MoE keep their paths). Note wide models "
+                    "(vit_base, lm_base) will then fail the kernel's VMEM "
+                    "weight-residency check loudly, and lm_tiny needs "
+                    "--num_heads 4 (head_dim must be a multiple of 64)"
                 )
             model_kwargs["fused"] = True
+        elif fused_req in (False, "off"):
+            # the dense transformer families default to fused="auto";
+            # an explicit off must override that, but only models that
+            # take the kwarg can receive it (declared at registration —
+            # models/__init__.py accepts_fused)
+            if accepts_fused(config.model):
+                model_kwargs["fused"] = False
+        elif fused_req != "auto":
+            raise ValueError(
+                f"fused_encoder={fused_req!r} (want 'auto'|'on'|'off')"
+            )
+        # "auto": nothing to pass — the models default to fused="auto"
+        # and resolve per block (models/vit.py EncoderBlock._auto_fuse)
         if config.pipe_schedule != "gpipe":
             # same fail-loudly convention as the other pipeline flags: a
             # schedule request on a pipe-less mesh, or for a model family
