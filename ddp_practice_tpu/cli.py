@@ -151,7 +151,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "model/shape supports them (vit_tiny, dense LMs "
                         "with head_dim a multiple of 64 via --num_heads), "
                         "silent per-op fallback otherwise; on (or bare "
-                        "--fused): force, raising on unsupported configs; "
+                        "--fused): force, raising on unsupported configs "
+                        "(exception: an MoE-interleaved LM fuses its DENSE "
+                        "blocks only — routed blocks have no fused kernel); "
                         "off: always per-op")
     p.add_argument("--augment", action="store_true",
                    help="on-device augmentation inside the jitted train "

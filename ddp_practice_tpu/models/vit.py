@@ -363,22 +363,11 @@ class EncoderBlock(nn.Module):
         partition is not validated on hardware here, so implicit
         selection stays out of that regime; multi-chip users who have
         verified it force fused=True / --fused on."""
-        import jax
-
         if not self._plain_block(decode):
             return False
-        if jax.default_backend() != "tpu":
-            return False
-        # "one chip" means the devices this PROGRAM runs on, not the
-        # host's inventory: a --devices 1 run on a multi-chip host is
-        # exactly the regime auto targets. The framework's current mesh
-        # (set by the trainer/bench) is the authority; without one, fall
-        # back to the global count.
-        from ddp_practice_tpu.parallel.ring import get_current_mesh
+        from ddp_practice_tpu.parallel.ring import single_chip_tpu
 
-        mesh = get_current_mesh()
-        n_dev = mesh.devices.size if mesh is not None else jax.device_count()
-        if n_dev != 1:
+        if not single_chip_tpu():
             return False
         from ddp_practice_tpu.ops.fused_encoder import fused_shape_supported
 

@@ -23,6 +23,7 @@ routing uniform.
 
 from __future__ import annotations
 
+import functools
 from typing import Optional
 
 import flax.linen as nn
@@ -46,25 +47,71 @@ def _constrain(x, spec):
     )
 
 
-def top_k_gating(
+def topk_choices(
+    router_logits: jnp.ndarray,  # (..., E) fp32
+    *,
+    k: int,
+    routing_bias: Optional[jnp.ndarray] = None,  # (E,) selection-only
+):
+    """Top-k expert selection without the capacity machinery.
+
+    Returns (choices (..., k) int32, combine gates (..., k) fp32
+    renormalized over each token's k picks, aux_loss, demand (E,)).
+    The dropless sorted path (below) consumes this directly; the
+    capacity-dropping einsum path keeps `top_k_gating`, whose combine
+    weights renormalize over the KEPT experts instead. `routing_bias`
+    biases selection only, never the combine weights (the DeepSeek-V3
+    aux-free balancing scheme, same contract as top_k_gating)."""
+    e = router_logits.shape[-1]
+    logits = router_logits.astype(jnp.float32)
+    gates = jax.nn.softmax(logits, axis=-1)
+    sel = logits if routing_bias is None else (
+        logits + routing_bias.astype(jnp.float32)
+    )
+    _, choices = jax.lax.top_k(sel, k)                    # (..., k)
+    cgates = jnp.take_along_axis(gates, choices, axis=-1)  # (..., k)
+    cgates = cgates / jnp.maximum(
+        jnp.sum(cgates, axis=-1, keepdims=True), 1e-9
+    )
+    lead = tuple(range(router_logits.ndim - 1))
+    onehot = jax.nn.one_hot(choices, e, dtype=jnp.float32)  # (..., k, E)
+    demand = jnp.mean(jnp.sum(onehot, axis=-2), axis=lead) / k
+    # Switch-style load-balance loss (eq. 4): pre-drop first-choice
+    # fractions x mean router mass — identical to top_k_gating's
+    frac = jnp.mean(onehot[..., 0, :], axis=lead)
+    prob = jnp.mean(gates, axis=lead)
+    aux = e * jnp.sum(frac * prob)
+    return choices, cgates, aux, demand
+
+
+def top_k_routing(
     router_logits: jnp.ndarray,  # (G, T, E) fp32
     *,
     k: int,
     capacity: int,
     routing_bias: Optional[jnp.ndarray] = None,  # (E,) selection-only
 ):
-    """Return (dispatch (G,T,E,C), combine (G,T,E,C), aux_loss, demand).
+    """Capacity-constrained top-k routing as INDEX tensors.
 
-    Iterative top-k: pick the best expert per token, compute each token's
-    position within that expert's buffer by a cumsum over the token dim,
-    drop tokens past `capacity`, mask the chosen expert out, repeat. All
-    dense ops — compiles to static-shape TPU code.
+    Returns (choices (G,T,k) int32, positions (G,T,k) int32 — each
+    token's buffer position within its chosen expert, keeps (G,T,k)
+    fp32 — 0 where the token overflowed capacity, gsel (G,T,E) fp32 —
+    router gates renormalized over each token's kept experts, aux_loss,
+    demand (E,)).
 
-    `routing_bias` biases SELECTION only (which experts a token goes to),
-    never the combine weights — the aux-free online balancing signal
-    (MoEMlp maintains it; the DeepSeek-V3 scheme). `demand` is the (E,)
-    pre-drop share of the k*T assignment slots each expert attracted —
-    the overload signal the bias update consumes.
+    Iterative top-k: pick the best expert per token, compute each
+    token's position within that expert's buffer by a cumsum over the
+    token dim, drop tokens past `capacity`, mask the chosen expert out,
+    repeat. All dense ops — compiles to static-shape TPU code. Both
+    expert-compute layouts derive from these indices: the einsum path
+    expands them to one-hot dispatch/combine tensors (top_k_gating),
+    the gather path consumes them directly.
+
+    `routing_bias` biases SELECTION only (which experts a token goes
+    to), never the combine weights — the aux-free online balancing
+    signal (MoEMlp maintains it; the DeepSeek-V3 scheme). `demand` is
+    the (E,) pre-drop share of the k*T assignment slots each expert
+    attracted — the overload signal the bias update consumes.
     """
     g, t, e = router_logits.shape
     gates = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
@@ -78,9 +125,10 @@ def top_k_gating(
 
     remaining = sel
     fill = jnp.zeros((g, e), jnp.float32)  # tokens already claimed per expert
-    dispatch = jnp.zeros((g, t, e, capacity), jnp.float32)
     first_choice = None
     demand = jnp.zeros((e,), jnp.float32)
+    kept_expert = jnp.zeros((g, t, e), jnp.float32)
+    choices, positions, keeps = [], [], []
     for _ in range(k):
         choice = jnp.argmax(remaining, axis=-1)              # (G, T)
         onehot = jax.nn.one_hot(choice, e, dtype=jnp.float32)  # (G, T, E)
@@ -92,22 +140,18 @@ def top_k_gating(
         )  # (G, T, E): position within expert buffer
         pos_tok = jnp.sum(pos * onehot, axis=-1)             # (G, T)
         keep = (pos_tok < capacity).astype(jnp.float32)      # (G, T)
-        pos_oh = jax.nn.one_hot(
-            pos_tok.astype(jnp.int32), capacity, dtype=jnp.float32
-        )
-        dispatch = dispatch + jnp.einsum(
-            "gte,gtc->gtec", onehot * keep[..., None], pos_oh
-        )
+        choices.append(choice.astype(jnp.int32))
+        positions.append(jnp.minimum(pos_tok, capacity - 1).astype(jnp.int32))
+        keeps.append(keep)
+        kept_expert = kept_expert + onehot * keep[..., None]
         fill = fill + jnp.sum(onehot * keep[..., None], axis=1)
         remaining = remaining * (1.0 - onehot)
 
     # per-slot combine weight: router gates renormalized over each token's
     # kept experts (tokens dropped everywhere get an all-zero combine row —
     # the residual connection carries them through unchanged)
-    dispatched_expert = jnp.sum(dispatch, axis=-1)           # (G, T, E)
-    gsel = gates * dispatched_expert
+    gsel = gates * kept_expert
     gsel = gsel / jnp.maximum(jnp.sum(gsel, axis=-1, keepdims=True), 1e-9)
-    combine = dispatch * gsel[..., None]
 
     # Switch-style load-balance loss: E * sum_e fraction_e * prob_e, with
     # frac from the PRE-DROP first-choice assignments (Switch eq. 4). An
@@ -119,7 +163,362 @@ def top_k_gating(
     frac = jnp.mean(first_choice, axis=(0, 1))               # (E,) demand
     prob = jnp.mean(gates, axis=(0, 1))                      # (E,) router mass
     aux = e * jnp.sum(frac * prob)
+    return (
+        jnp.stack(choices, axis=-1), jnp.stack(positions, axis=-1),
+        jnp.stack(keeps, axis=-1), gsel, aux, demand,
+    )
+
+
+def top_k_gating(
+    router_logits: jnp.ndarray,  # (G, T, E) fp32
+    *,
+    k: int,
+    capacity: int,
+    routing_bias: Optional[jnp.ndarray] = None,  # (E,) selection-only
+):
+    """Return (dispatch (G,T,E,C), combine (G,T,E,C), aux_loss, demand).
+
+    The one-hot expansion of top_k_routing — the GShard layout the
+    einsum path and its expert-sharded all-to-alls contract over."""
+    choices, positions, keeps, gsel, aux, demand = top_k_routing(
+        router_logits, k=k, capacity=capacity, routing_bias=routing_bias,
+    )
+    e = router_logits.shape[-1]
+    dispatch = jnp.zeros(
+        router_logits.shape[:2] + (e, capacity), jnp.float32
+    )
+    for j in range(choices.shape[-1]):
+        onehot = jax.nn.one_hot(choices[..., j], e, dtype=jnp.float32)
+        pos_oh = jax.nn.one_hot(
+            positions[..., j], capacity, dtype=jnp.float32
+        )
+        dispatch = dispatch + jnp.einsum(
+            "gte,gtc->gtec", onehot * keeps[..., j, None], pos_oh
+        )
+    combine = dispatch * gsel[..., None]
     return dispatch, combine, aux, demand
+
+
+def _assignment_permutation(choices_flat: jnp.ndarray, e: int):
+    """Static-shape counting sort of the (N*k,) expert assignments.
+
+    Returns (counts (E,) int32, dest (N*k,) int32, inv (N*k,) int32):
+    assignment a lands at row dest[a] of the expert-sorted buffer, and
+    sorted row r holds assignment inv[r]. Pure cumsum arithmetic — no
+    lax.sort, no scatter with duplicate indices (inv's scatter writes a
+    permutation, which XLA lowers as a gather of the inverse)."""
+    nk = choices_flat.shape[0]
+    onehot = jax.nn.one_hot(choices_flat, e, dtype=jnp.int32)   # (Nk, E)
+    counts = jnp.sum(onehot, axis=0)                            # (E,)
+    offsets = jnp.cumsum(counts) - counts                       # exclusive
+    pos_in_expert = jnp.cumsum(onehot, axis=0) - onehot         # (Nk, E)
+    dest = (
+        jnp.sum(pos_in_expert * onehot, axis=-1) + offsets[choices_flat]
+    ).astype(jnp.int32)
+    # inv from ONE stable sort: counting-sort order IS (expert, arrival)
+    # order, which a stable sort by expert id reproduces exactly
+    _, inv = jax.lax.sort_key_val(
+        choices_flat, jnp.arange(nk, dtype=jnp.int32)
+    )
+    return counts, dest, inv
+
+
+def _slot_tables(choices, positions, keeps, e: int, capacity: int):
+    """Invert the (token -> slot) routing into per-slot lookup tables.
+
+    Returns (slot_token (G, E*C) int32, slot_round (G, E*C) int32,
+    slot_mask (G, E*C) fp32, dest (G, T, k) int32 — each assignment's
+    flat slot, E*C for dropped). Dropped assignments scatter into a
+    spare trailing column so they can never collide with a live slot.
+    The scatters move 3*k*T int32-sized elements per group — index
+    metadata, not rows; the row traffic all rides gathers (the point
+    of this path)."""
+    g, t, k = choices.shape
+    ec = e * capacity
+    dest = choices * capacity + positions                  # (G, T, k)
+    dest = jnp.where(keeps > 0, dest, ec).astype(jnp.int32)
+    gi = jnp.arange(g, dtype=jnp.int32)[:, None, None]
+    ti = jnp.broadcast_to(
+        jnp.arange(t, dtype=jnp.int32)[None, :, None], (g, t, k)
+    )
+    ri = jnp.broadcast_to(
+        jnp.arange(k, dtype=jnp.int32)[None, None, :], (g, t, k)
+    )
+    gi = jnp.broadcast_to(gi, (g, t, k))
+    slot_token = jnp.zeros((g, ec + 1), jnp.int32).at[gi, dest].set(
+        ti, mode="drop"
+    )[:, :ec]
+    slot_round = jnp.zeros((g, ec + 1), jnp.int32).at[gi, dest].set(
+        ri, mode="drop"
+    )[:, :ec]
+    slot_mask = jnp.zeros((g, ec + 1), jnp.float32).at[gi, dest].set(
+        1.0, mode="drop"
+    )[:, :ec]
+    return slot_token, slot_round, slot_mask, dest
+
+
+@jax.custom_vjp
+def _dispatch_gather(x, slot_token, slot_mask, dest):
+    """xin[g, s] = x[g, slot_token[g, s]] * slot_mask[g, s].
+
+    Forward is one batched row gather over the token dim; the custom
+    backward is k row gathers (dx[g, t] = sum_j dxin[g, dest[g, t, j]],
+    with dropped assignments pointing at the masked spare slot) instead
+    of the scatter-add autodiff would emit."""
+    del dest
+    xin = jnp.take_along_axis(x, slot_token[..., None], axis=1)
+    return xin * slot_mask[..., None].astype(xin.dtype)
+
+
+def _dispatch_gather_fwd(x, slot_token, slot_mask, dest):
+    return _dispatch_gather(x, slot_token, slot_mask, dest), dest
+
+
+def _dispatch_gather_bwd(dest, g_out):
+    # pad a zero spare slot so dropped assignments (dest == E*C) read 0
+    gz = jnp.pad(g_out, ((0, 0), (0, 1), (0, 0)))
+    k = dest.shape[-1]
+    dx = jnp.take_along_axis(gz, dest[..., 0, None], axis=1)
+    for j in range(1, k):
+        dx = dx + jnp.take_along_axis(gz, dest[..., j, None], axis=1)
+    return dx, None, None, None
+
+
+_dispatch_gather.defvjp(_dispatch_gather_fwd, _dispatch_gather_bwd)
+
+
+@jax.custom_vjp
+def _combine_gather(out, w, dest, slot_token, slot_round, slot_mask):
+    """y[g, t] = sum_j w[g, t, j] * out[g, dest[g, t, j]].
+
+    Gather-only in both directions: the backward for `out` reads
+    gy rows back through the slot tables (d out[g, s] =
+    gy[g, slot_token[g, s]] * w[g, slot_token, slot_round] * mask) and
+    the backward for `w` is k gathers + row dots."""
+    del slot_token, slot_round, slot_mask
+    k = dest.shape[-1]
+    oz = jnp.pad(out, ((0, 0), (0, 1), (0, 0)))
+    y = jnp.take_along_axis(oz, dest[..., 0, None], axis=1) * (
+        w[..., 0, None].astype(out.dtype)
+    )
+    for j in range(1, k):
+        y = y + jnp.take_along_axis(oz, dest[..., j, None], axis=1) * (
+            w[..., j, None].astype(out.dtype)
+        )
+    return y
+
+
+def _combine_gather_fwd(out, w, dest, slot_token, slot_round, slot_mask):
+    y = _combine_gather(out, w, dest, slot_token, slot_round, slot_mask)
+    return y, (out, w, dest, slot_token, slot_round, slot_mask)
+
+
+def _combine_gather_bwd(res, gy):
+    out, w, dest, slot_token, slot_round, slot_mask = res
+    k = dest.shape[-1]
+    # d out: route each slot back to its token's cotangent row, scaled
+    # by that slot's combine weight (pure indexing of residuals)
+    w_slot = jnp.take_along_axis(
+        w.reshape(w.shape[0], -1),
+        (slot_token * k + slot_round), axis=1,
+    ) * slot_mask                                           # (G, E*C)
+    dout = jnp.take_along_axis(gy, slot_token[..., None], axis=1) * (
+        w_slot[..., None].astype(gy.dtype)
+    )
+    oz = jnp.pad(out, ((0, 0), (0, 1), (0, 0)))
+    dw = jnp.stack(
+        [
+            jnp.sum(
+                gy * jnp.take_along_axis(oz, dest[..., j, None], axis=1),
+                axis=-1,
+            )
+            for j in range(k)
+        ],
+        axis=-1,
+    ).astype(w.dtype)
+    return dout, dw, None, None, None, None
+
+
+_combine_gather.defvjp(_combine_gather_fwd, _combine_gather_bwd)
+
+
+# largest tile <= target that divides dim exactly — megablox rejects
+# non-dividing m tiles, and small test shapes would otherwise reject the
+# tuned production tiles (one definition, shared with the fused encoder)
+from ddp_practice_tpu.ops.fused_encoder import _fit_tile  # noqa: E402
+
+
+def _gmm_tiling(m: int, k: int, n: int):
+    """v5e-tuned megablox tiling for the sorted path's grouped matmuls.
+
+    The megablox default (128, 128, 128) ran the lm_moe shapes at ~11
+    TFLOP/s — each tiny k-tile re-streams operands. Full-contraction k
+    tiles with 512-wide m/n tiles measured 4-6x faster
+    (experiments/gmm_tune.py: (m=32k, k=768, n=3072) 70 TF/s at
+    (512, 768, 512); (m=32k, k=3072, n=768) 42 TF/s at
+    (512, 3072, 768) — both within ~2% of the dense-matmul rate of the
+    same FLOPs). Keyed by each CALL's effective dims, so forward and
+    the two backward directions each get their own shape's optimum."""
+    return (
+        _fit_tile(m, 512), min(k, 3072),
+        _fit_tile(n, n if n <= 768 else 512),
+    )
+
+
+def _mb_gmm(lhs, rhs, gs, *, transpose_rhs: bool, interpret: bool):
+    # from-import of the SUBMODULE path: the package __init__ exports a
+    # custom_vjp FUNCTION named gmm that shadows the gmm submodule, so
+    # `megablox.gmm` attribute access raises — and tgmm is not
+    # re-exported at all
+    from jax.experimental.pallas.ops.tpu.megablox.gmm import gmm as raw_gmm
+
+    k_dim = rhs.shape[2] if transpose_rhs else rhs.shape[1]
+    n_dim = rhs.shape[1] if transpose_rhs else rhs.shape[2]
+    tiling = _gmm_tiling(lhs.shape[0], k_dim, n_dim)
+    return raw_gmm(
+        lhs, rhs, gs, lhs.dtype, tiling, None, None, transpose_rhs,
+        interpret,
+    )
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _grouped_matmul(lhs, rhs, group_sizes, interpret):
+    """Differentiable grouped matmul over expert-sorted rows.
+
+    A thin re-wrap of megablox gmm/tgmm (jax.experimental.pallas)
+    ONLY so each autodiff direction picks its own tuned tiling — the
+    stock jax wrapper threads one tiling through forward, grad-lhs,
+    and tgmm, and no single tuple is good for all three shapes (the
+    measured spread is 4x; _gmm_tiling)."""
+    return _mb_gmm(lhs, rhs, group_sizes, transpose_rhs=False,
+                   interpret=interpret)
+
+
+def _grouped_matmul_fwd(lhs, rhs, group_sizes, interpret):
+    out = _mb_gmm(lhs, rhs, group_sizes, transpose_rhs=False,
+                  interpret=interpret)
+    return out, (lhs, rhs, group_sizes)
+
+
+def _grouped_matmul_bwd(interpret, res, g):
+    from jax.experimental.pallas.ops.tpu.megablox.gmm import tgmm
+
+    lhs, rhs, gs = res
+    dlhs = _mb_gmm(g, rhs, gs, transpose_rhs=True, interpret=interpret)
+    # dW: tgmm((k, m), (m, n)) -> (e, k, n). tgmm's tiling is
+    # (contraction m, k, n). Measured (experiments/gmm_tune.py): small-k
+    # dW (w_in-like) peaks at (512, k, 512) = 51 TF/s and larger
+    # contraction tiles fail to compile there; wide-k dW (w_out-like)
+    # peaks at (2048, 1024, n) = 39 TF/s
+    m_dim = lhs.shape[0]
+    if lhs.shape[1] <= 1024:
+        tiling = (
+            _fit_tile(m_dim, 512), lhs.shape[1],
+            _fit_tile(g.shape[1], 512),
+        )
+    else:
+        tiling = (
+            _fit_tile(m_dim, 2048), 1024, _fit_tile(g.shape[1], 768),
+        )
+    drhs = tgmm(
+        lhs.swapaxes(0, 1), g, gs, rhs.dtype, tiling, None,
+        rhs.shape[0], interpret=interpret,
+    )
+    return dlhs, drhs, None
+
+
+_grouped_matmul.defvjp(_grouped_matmul_fwd, _grouped_matmul_bwd)
+
+
+@jax.custom_vjp
+def _dispatch_rows(xf, tok, dest_nk):
+    """Expert-sort gather: row r of the output is token tok[r]'s vector.
+
+    Custom VJP so NEITHER direction is a TPU scatter: the forward is a
+    row gather, and the cotangent of token n is the sum of its k sorted
+    rows — dest_nk (N, k) holds exactly those row ids, so the backward
+    is k gathers + adds instead of a 2N-way scatter-add."""
+    del dest_nk
+    return xf[tok]
+
+
+def _dispatch_rows_fwd(xf, tok, dest_nk):
+    return xf[tok], (tok, dest_nk)
+
+
+def _dispatch_rows_bwd(res, g):
+    tok, dest_nk = res
+    k = dest_nk.shape[1]
+    dxf = g[dest_nk[:, 0]]
+    for j in range(1, k):
+        dxf = dxf + g[dest_nk[:, j]]
+    return dxf, None, None
+
+
+_dispatch_rows.defvjp(_dispatch_rows_fwd, _dispatch_rows_bwd)
+
+
+@jax.custom_vjp
+def _combine_rows(out, cgates, tok, dest_nk, inv):
+    """Weighted un-sort: y[n] = sum_j cgates[n, j] * out[dest_nk[n, j]].
+
+    Forward is k row gathers + fma. Backward stays gather-only too:
+    d out[r] = gy[tok[r]] * cgates.flat[inv[r]] (row gather x scalar),
+    d cgates[n, j] = <gy[n], out[dest_nk[n, j]]> (gather + rowwise dot).
+    """
+    del tok, inv
+    k = dest_nk.shape[1]
+    y = out[dest_nk[:, 0]] * cgates[:, 0, None]
+    for j in range(1, k):
+        y = y + out[dest_nk[:, j]] * cgates[:, j, None]
+    return y
+
+
+def _combine_rows_fwd(out, cgates, tok, dest_nk, inv):
+    return _combine_rows(out, cgates, tok, dest_nk, inv), (
+        out, cgates, tok, dest_nk, inv,
+    )
+
+
+def _combine_rows_bwd(res, gy):
+    out, cgates, tok, dest_nk, inv = res
+    gate_sorted = cgates.reshape(-1)[inv]                       # (Nk,)
+    dout = gy[tok] * gate_sorted[:, None].astype(gy.dtype)
+    dc = [
+        jnp.sum(gy * out[dest_nk[:, j]], axis=-1)
+        for j in range(dest_nk.shape[1])
+    ]
+    dcgates = jnp.stack(dc, axis=-1).astype(cgates.dtype)
+    return dout, dcgates, None, None, None
+
+
+_combine_rows.defvjp(_combine_rows_fwd, _combine_rows_bwd)
+
+
+@jax.custom_vjp
+def _bias_rows(b, sorted_expert, onehot_sorted):
+    """Per-row expert bias gather b[sorted_expert] with a dense-matmul
+    backward: db = onehot_sorted^T @ g — an (E, rows) x (rows, F) dot on
+    the MXU instead of a rows->E scatter-add."""
+    del onehot_sorted
+    return b[sorted_expert]
+
+
+def _bias_rows_fwd(b, sorted_expert, onehot_sorted):
+    # zero-size dtype token: custom_vjp residuals must be JAX types
+    return b[sorted_expert], (onehot_sorted, jnp.zeros((0,), b.dtype))
+
+
+def _bias_rows_bwd(res, g):
+    onehot_sorted, dtype_token = res
+    db = jax.lax.dot_general(
+        onehot_sorted.astype(jnp.float32), g.astype(jnp.float32),
+        (((0,), (0,)), ((), ())),
+    )
+    return db.astype(dtype_token.dtype), None, None
+
+
+_bias_rows.defvjp(_bias_rows_fwd, _bias_rows_bwd)
 
 
 class MoEMlp(nn.Module):
@@ -160,50 +559,95 @@ class MoEMlp(nn.Module):
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
     expert_axis: Optional[str] = MeshConfig.AXIS_EXPERT
+    # expert-compute implementation:
+    #   "einsum" — the GShard dense one-hot dispatch/combine einsums with
+    #     capacity dropping: shardable over the 'expert' mesh axis (the
+    #     sharding constraints lower to all-to-alls), the multichip path.
+    #   "gather" — same capacity/grouping semantics, but dispatch and
+    #     combine are index GATHERS through per-slot lookup tables
+    #     (custom VJPs keep the backward gather-only too) while the
+    #     expert MLP stays the dense batched einsum. Measured SLOWER
+    #     than einsum at the lm_moe bench shape (31.9% vs 37.7% MFU):
+    #     XLA lowers a TPU row gather at ~0.25-0.5 ms per (32k, 768)
+    #     pass and this path needs ~8 per layer, while the one-hot
+    #     dispatch matmuls it replaces cost ~1 ms/layer once routing
+    #     groups shrink them. Kept for the regime that inverts the
+    #     tradeoff (capacity >> group_size, where one-hot tensors
+    #     explode quadratically but gathers stay linear).
+    #   "sorted" — dropless counting-sort + grouped matmul (megablox gmm
+    #     Pallas kernels, v5e-tuned tilings): no capacity padding at
+    #     all (exactly k*N expert rows). Also measured BELOW einsum —
+    #     XLA's dense batched expert einsum reaches ~103-139 TF/s where
+    #     gmm peaks at ~70/42 (experiments/gmm_tune.py) — but it is the
+    #     only drop-free top-k path, and wins when capacity waste
+    #     dominates (high cf or skewed loads).
+    #   "auto" (default) — einsum everywhere, by measurement: the
+    #     GShard dense-linear-algebra design IS the TPU-native answer
+    #     at production shapes (BENCHMARKS.md round-5 MoE section
+    #     records the full gather/sorted shootout).
+    impl: str = "auto"
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:  # (G, T, D)
-        g0, t0, d = x.shape
-        n_sub = 1
-        if (self.group_size > t0 and not self.is_initializing()
-                and self.is_mutable_collection("batch_stats")):
-            # a group larger than the sequence cannot exist; routing falls
-            # back to whole-sequence, whose capacity behavior differs from
-            # what the group-tuned capacity factor was calibrated for
-            # (advisor round 4). Warn, don't raise — and only on the
-            # TRAINING path (mutable batch_stats, like the router-bias
-            # update): short inputs are NORMAL in decode/prefill (t0 =
-            # prompt length or 1 — inference.py drives this module with
-            # the training group_size) and must stay silent.
-            import warnings
-
-            warnings.warn(
-                f"moe group_size {self.group_size} exceeds the sequence "
-                f"length {t0}: routing whole-sequence — pass 0 or a "
-                "divisor of the sequence length",
-                stacklevel=2,
+        impl = self.impl
+        if impl == "auto":
+            impl = "einsum"
+        elif impl not in ("einsum", "gather", "sorted"):
+            raise ValueError(
+                f"moe impl {impl!r} (want 'auto'|'einsum'|'gather'|"
+                "'sorted')"
             )
-        if 0 < self.group_size < t0:
-            if t0 % self.group_size:
-                raise ValueError(
-                    f"moe group_size {self.group_size} must divide the "
-                    f"sequence length {t0}"
-                )
-            n_sub = t0 // self.group_size
-            if self.group_stride:
-                # (g0, t0, d) -> (g0 * n_sub, group_size, d), group j of
-                # a sequence = tokens {j, j + n_sub, ...}
-                x = x.reshape(g0, self.group_size, n_sub, d)
-                x = jnp.swapaxes(x, 1, 2)
-                x = x.reshape(g0 * n_sub, self.group_size, d)
-            else:
-                x = x.reshape(g0 * n_sub, self.group_size, d)
-        g, t, d = x.shape
-        e, f = self.num_experts, self.mlp_dim
-        capacity = max(
-            1, int(self.capacity_factor * self.top_k * t / e)
-        )
+        if impl == "sorted" and not self.is_initializing():
+            return self._sorted(x)
+        if impl == "gather" and not self.is_initializing():
+            return self._gather(x)
+        return self._einsum(x)
 
+    def _group(self, x):
+        """Apply the routing-group reshape (see group_size/group_stride);
+        returns (grouped x, n_sub)."""
+        g0, t0, d = x.shape
+        if not 0 < self.group_size < t0:
+            return x, 1
+        if t0 % self.group_size:
+            raise ValueError(
+                f"moe group_size {self.group_size} must divide the "
+                f"sequence length {t0}"
+            )
+        n_sub = t0 // self.group_size
+        if self.group_stride:
+            # (g0, t0, d) -> (g0 * n_sub, group_size, d), group j of
+            # a sequence = tokens {j, j + n_sub, ...}
+            x = x.reshape(g0, self.group_size, n_sub, d)
+            x = jnp.swapaxes(x, 1, 2)
+        return x.reshape(g0 * n_sub, self.group_size, d), n_sub
+
+    def _ungroup(self, y, g0, t0, n_sub):
+        if n_sub <= 1:
+            return y
+        d = y.shape[-1]
+        if self.group_stride:
+            y = y.reshape(g0, n_sub, self.group_size, d)
+            y = jnp.swapaxes(y, 1, 2)
+        return y.reshape(g0, t0, d)
+
+    def _gather(self, x: jnp.ndarray) -> jnp.ndarray:
+        """Capacity-layout expert compute with index-gather glue.
+
+        Identical routing semantics to the einsum path (same groups,
+        same capacity drops, same combine weights — pinned by
+        tests/test_moe.py equality tests) but the (G,T,E,C) one-hot
+        dispatch/combine tensors never exist: per-slot lookup tables
+        (_slot_tables) drive row gathers into the (G,E,C,D) buffer and
+        back, with custom VJPs that stay gather-only. The expert MLP
+        keeps the dense batched einsum — measured ~139 TF/s on v5e,
+        2-3x any grouped-matmul kernel at this shape."""
+        g0, t0, d = x.shape
+        self._warn_oversized_group(t0)
+        x, n_sub = self._group(x)
+        g, t, _ = x.shape
+        e, f, k = self.num_experts, self.mlp_dim, self.top_k
+        capacity = max(1, int(self.capacity_factor * k * t / e))
         router = nn.Dense(
             e,
             dtype=jnp.float32,
@@ -212,22 +656,65 @@ class MoEMlp(nn.Module):
             name="router",
         )
         logits = router(x.astype(jnp.float32))               # (G, T, E)
-        # decode/eval paths may apply without the batch_stats collection
-        # (generate.py builds variables from params + cache only): route
-        # with no bias there — selection then follows the raw gates,
-        # which the aux loss keeps roughly balanced
-        bias = None
+        bias = self._router_bias(e)
+        choices, positions, keeps, gsel, aux, demand = top_k_routing(
+            logits, k=k, capacity=capacity,
+            routing_bias=None if bias is None else bias.value,
+        )
+        self._update_bias(bias, demand, e)
+        self.sow("intermediates", "moe_aux_loss", self.aux_loss_weight * aux)
+        routed = jnp.sum(keeps)
+        load = jnp.sum(
+            jax.nn.one_hot(choices, e, dtype=jnp.float32)
+            * keeps[..., None],
+            axis=(0, 1, 2),
+        )
+        self.sow(
+            "intermediates", "moe_load_frac",
+            load / jnp.maximum(routed, 1.0),
+        )
+        self.sow(
+            "intermediates", "moe_drop_rate",
+            1.0 - routed / (k * g * t),
+        )
+
+        slot_token, slot_round, slot_mask, dest = _slot_tables(
+            choices, positions, keeps, e, capacity
+        )
+        w_in, b_in, w_out, b_out = self._expert_params(d, e, f)
+        cd = self.dtype
+        xin = _dispatch_gather(
+            x.astype(cd), slot_token, slot_mask.astype(cd), dest
+        )                                                    # (G, E*C, D)
+        xin = xin.reshape(g, e, capacity, d)
+        h = jnp.einsum("gecd,edf->gecf", xin, w_in.astype(cd))
+        h = nn.gelu(h + b_in.astype(cd)[None, :, None, :])
+        out = jnp.einsum("gecf,efd->gecd", h, w_out.astype(cd))
+        out = out + b_out.astype(cd)[None, :, None, :]
+        w = jnp.take_along_axis(gsel, choices, axis=-1) * keeps  # (G,T,k)
+        y = _combine_gather(
+            out.reshape(g, e * capacity, d), w.astype(cd), dest,
+            slot_token, slot_round, slot_mask,
+        )
+        return self._ungroup(y, g0, t0, n_sub).astype(x.dtype)
+
+    def _router_bias(self, e: int):
+        """The aux-free balancing bias variable, shared by both paths.
+
+        decode/eval paths may apply without the batch_stats collection
+        (generate.py builds variables from params + cache only): route
+        with no bias there — selection then follows the raw gates,
+        which the aux loss keeps roughly balanced."""
         if self.is_initializing() or self.has_variable(
             "batch_stats", "router_bias"
         ):
-            bias = self.variable(
+            return self.variable(
                 "batch_stats", "router_bias",
                 lambda: jnp.zeros((e,), jnp.float32),
             )
-        dispatch, combine, aux, demand = top_k_gating(
-            logits, k=self.top_k, capacity=capacity,
-            routing_bias=None if bias is None else bias.value,
-        )
+        return None
+
+    def _update_bias(self, bias, demand, e: int):
         if bias is not None and self.is_mutable_collection(
             "batch_stats"
         ) and self.bias_update_rate > 0.0:
@@ -235,21 +722,8 @@ class MoEMlp(nn.Module):
                 bias.value - self.bias_update_rate
                 * jnp.sign(demand - 1.0 / e)
             )
-        self.sow("intermediates", "moe_aux_loss", self.aux_loss_weight * aux)
-        # router health (diagnostic sows — no "aux_loss" in the name, so
-        # they never join the objective; train/steps.py surfaces them as
-        # moe_* metrics): per-expert share of ROUTED tokens, and the
-        # fraction of the k*T assignment slots lost to capacity drops
-        routed = jnp.sum(dispatch)
-        self.sow(
-            "intermediates", "moe_load_frac",
-            jnp.sum(dispatch, axis=(0, 1, 3)) / jnp.maximum(routed, 1.0),
-        )
-        self.sow(
-            "intermediates", "moe_drop_rate",
-            1.0 - routed / (self.top_k * g * t),
-        )
 
+    def _expert_params(self, d: int, e: int, f: int):
         w_in = self.param(
             "expert_w_in",
             nn.initializers.lecun_normal(batch_axis=(0,)),
@@ -268,6 +742,134 @@ class MoEMlp(nn.Module):
         b_out = self.param(
             "expert_b_out", nn.initializers.zeros, (e, d), self.param_dtype
         )
+        return w_in, b_in, w_out, b_out
+
+    def _sorted(self, x: jnp.ndarray) -> jnp.ndarray:
+        """Dropless sorted expert compute (single device).
+
+        Tokens flatten to (N, D); the k assignments counting-sort by
+        expert (dest by cumsum arithmetic, inv by one stable
+        lax.sort_key_val — no scatters); the expert MLP runs as TWO
+        grouped matmuls over the ragged (N*k, ·) buffer (megablox gmm —
+        jax.experimental.pallas.ops.tpu.megablox, fp32 accumulation);
+        combine gathers each token's k rows back with renormalized
+        gates. Router health/aux/bias machinery is shared with the
+        einsum path; drop rate is exactly 0 by construction.
+
+        group_size/group_stride are deliberately NOT applied here:
+        routing groups exist to scope CAPACITY competition (which
+        tokens crowd each other out of an expert's buffer), and the
+        dropless path has no capacity — per-token top-k choices, and
+        therefore the output, demand statistics, and balance-bias
+        updates, are identical with or without the group reshape, so
+        applying it would only pay the strided transpose's HBM
+        traffic for nothing."""
+        g0, t0, d = x.shape
+        e, f, k = self.num_experts, self.mlp_dim, self.top_k
+        n = g0 * t0
+        xf = x.reshape(n, d)
+        router = nn.Dense(
+            e,
+            dtype=jnp.float32,
+            param_dtype=self.param_dtype,
+            use_bias=False,
+            name="router",
+        )
+        logits = router(xf.astype(jnp.float32))              # (N, E)
+        bias = self._router_bias(e)
+        choices, cgates, aux, demand = topk_choices(
+            logits, k=k, routing_bias=None if bias is None else bias.value,
+        )
+        self._update_bias(bias, demand, e)
+        self.sow("intermediates", "moe_aux_loss", self.aux_loss_weight * aux)
+
+        cf = choices.reshape(n * k)
+        counts, dest, inv = _assignment_permutation(cf, e)
+        dest_nk = dest.reshape(n, k)
+        tok = inv // k
+        self.sow(
+            "intermediates", "moe_load_frac",
+            counts.astype(jnp.float32) / (k * n),
+        )
+        self.sow(
+            "intermediates", "moe_drop_rate", jnp.zeros((), jnp.float32)
+        )
+
+        w_in, b_in, w_out, b_out = self._expert_params(d, e, f)
+        cd = self.dtype
+        interpret = jax.default_backend() == "cpu"
+        sorted_expert = cf[inv]
+        onehot_sorted = jax.nn.one_hot(sorted_expert, e, dtype=cd)
+        x_sorted = _dispatch_rows(xf.astype(cd), tok, dest_nk)
+        h = _grouped_matmul(x_sorted, w_in.astype(cd), counts, interpret)
+        h = nn.gelu(h + _bias_rows(b_in.astype(cd), sorted_expert,
+                                   onehot_sorted))
+        out = _grouped_matmul(h, w_out.astype(cd), counts, interpret)
+        out = out + _bias_rows(b_out.astype(cd), sorted_expert,
+                               onehot_sorted)
+        y = _combine_rows(out, cgates.astype(cd), tok, dest_nk, inv)
+        return y.reshape(g0, t0, d).astype(x.dtype)
+
+    def _warn_oversized_group(self, t0: int) -> None:
+        """A group larger than the sequence cannot exist; routing falls
+        back to whole-sequence, whose capacity behavior differs from
+        what the group-tuned capacity factor was calibrated for
+        (advisor round 4). Warn, don't raise — and only on the
+        TRAINING path (mutable batch_stats, like the router-bias
+        update): short inputs are NORMAL in decode/prefill (t0 =
+        prompt length or 1 — inference.py drives this module with
+        the training group_size) and must stay silent."""
+        if (self.group_size > t0 and not self.is_initializing()
+                and self.is_mutable_collection("batch_stats")):
+            import warnings
+
+            warnings.warn(
+                f"moe group_size {self.group_size} exceeds the sequence "
+                f"length {t0}: routing whole-sequence — pass 0 or a "
+                "divisor of the sequence length",
+                stacklevel=2,
+            )
+
+    def _einsum(self, x: jnp.ndarray) -> jnp.ndarray:
+        g0, t0, d = x.shape
+        self._warn_oversized_group(t0)
+        x, n_sub = self._group(x)
+        g, t, d = x.shape
+        e, f = self.num_experts, self.mlp_dim
+        capacity = max(
+            1, int(self.capacity_factor * self.top_k * t / e)
+        )
+
+        router = nn.Dense(
+            e,
+            dtype=jnp.float32,
+            param_dtype=self.param_dtype,
+            use_bias=False,
+            name="router",
+        )
+        logits = router(x.astype(jnp.float32))               # (G, T, E)
+        bias = self._router_bias(e)
+        dispatch, combine, aux, demand = top_k_gating(
+            logits, k=self.top_k, capacity=capacity,
+            routing_bias=None if bias is None else bias.value,
+        )
+        self._update_bias(bias, demand, e)
+        self.sow("intermediates", "moe_aux_loss", self.aux_loss_weight * aux)
+        # router health (diagnostic sows — no "aux_loss" in the name, so
+        # they never join the objective; train/steps.py surfaces them as
+        # moe_* metrics): per-expert share of ROUTED tokens, and the
+        # fraction of the k*T assignment slots lost to capacity drops
+        routed = jnp.sum(dispatch)
+        self.sow(
+            "intermediates", "moe_load_frac",
+            jnp.sum(dispatch, axis=(0, 1, 3)) / jnp.maximum(routed, 1.0),
+        )
+        self.sow(
+            "intermediates", "moe_drop_rate",
+            1.0 - routed / (self.top_k * g * t),
+        )
+
+        w_in, b_in, w_out, b_out = self._expert_params(d, e, f)
 
         ax = self.expert_axis
         cdtype = self.dtype
@@ -281,9 +883,4 @@ class MoEMlp(nn.Module):
         out = out + b_out.astype(cdtype)[:, None, None, :]
         out = _constrain(out, (ax, MeshConfig.AXIS_DATA, None, None))
         y = jnp.einsum("gtec,egcd->gtd", combine.astype(cdtype), out)
-        if n_sub > 1:
-            if self.group_stride:
-                y = y.reshape(g0, n_sub, self.group_size, d)
-                y = jnp.swapaxes(y, 1, 2)
-            y = y.reshape(g0, t0, d)
-        return y.astype(x.dtype)
+        return self._ungroup(y, g0, t0, n_sub).astype(x.dtype)

@@ -42,6 +42,28 @@ def get_current_mesh():
     return _CURRENT_MESH
 
 
+def single_chip_tpu() -> bool:
+    """True when the program executes compiled on ONE TPU chip.
+
+    The auto-selection gate for kernel-by-default paths (currently
+    models/vit.py EncoderBlock._auto_fuse; MoE's "auto" resolved to the
+    einsum path everywhere once the gather/sorted shootout measured it
+    fastest, so MoEMlp no longer consults this): Pallas kernels run
+    interpret-mode on CPU (never a win) and are not
+    validated under multi-chip GSPMD partitioning here, so implicit
+    selection stays out of both regimes. "One chip" means the devices
+    this program runs on — the framework's current mesh when set
+    (a --devices 1 run on a multi-chip host qualifies), the host
+    inventory otherwise."""
+    import jax
+
+    if jax.default_backend() != "tpu":
+        return False
+    mesh = get_current_mesh()
+    n_dev = mesh.devices.size if mesh is not None else jax.device_count()
+    return n_dev == 1
+
+
 def _axis_bound(axis_name: str) -> bool:
     try:
         lax.axis_index(axis_name)

@@ -1,0 +1,57 @@
+"""Microbench megablox gmm at the lm_moe sorted-path shape: find a
+tiling/dtype configuration that runs near the dense-matmul roofline, or
+prove the kernel can't and motivate an alternative."""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+
+
+def bench(fn, *args, iters=20):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters * 1e3
+
+
+def main():
+    from jax.experimental.pallas.ops.tpu.megablox.gmm import gmm
+
+    m, k, n, e = 32768, 768, 3072, 8
+    key = jax.random.PRNGKey(0)
+    lhs = jax.random.normal(key, (m, k), jnp.bfloat16)
+    rhs = jax.random.normal(key, (e, k, n), jnp.bfloat16)
+    # balanced groups
+    gs = jnp.full((e,), m // e, jnp.int32)
+    flops = 2 * m * k * n
+
+    # dense reference: one (m,k)x(k,n) matmul of the same total FLOPs
+    dense = jax.jit(lambda a, b: jax.lax.dot(a, b,
+                    preferred_element_type=jnp.float32).astype(jnp.bfloat16))
+    ms = bench(dense, lhs, rhs[0])
+    print(f"dense {ms:7.3f} ms  {flops/ms/1e9:8.1f} GFLOP/s")
+
+    for tiling in [(128, 128, 128), (512, 128, 128), (128, 128, 512),
+                   (512, 768, 512), (256, 256, 256), (512, 512, 512),
+                   (1024, 768, 1024), (2048, 768, 1024)]:
+        for pet in (jnp.bfloat16, jnp.float32):
+            try:
+                f = jax.jit(lambda a, b, g, t=tiling, p=pet: gmm(
+                    a, b, g, preferred_element_type=p, tiling=t))
+                ms = bench(f, lhs, rhs, gs)
+                print(f"gmm tiling={tiling} pet={pet.__name__}: "
+                      f"{ms:7.3f} ms  {flops/ms/1e9:8.1f} GFLOP/s")
+            except Exception as ex:
+                print(f"gmm tiling={tiling} pet={pet.__name__}: FAIL "
+                      f"{type(ex).__name__} {str(ex)[:120]}")
+
+
+if __name__ == "__main__":
+    main()

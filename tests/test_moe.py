@@ -307,3 +307,135 @@ def test_group_size_must_divide_seq():
     x = jnp.zeros((1, 64, 16))
     with pytest.raises(ValueError, match="must divide"):
         m.init(jax.random.PRNGKey(0), x)
+
+
+def test_sorted_impl_matches_dropless_einsum():
+    """The sorted (counting-sort + grouped-matmul) expert path computes
+    the SAME function as the einsum path when the latter has enough
+    capacity to drop nothing — forward, parameter grads, and input
+    grads (ops/moe.py MoEMlp impl)."""
+    G, T, D, E, F, K = 2, 64, 32, 4, 64, 2
+    x = jax.random.normal(jax.random.PRNGKey(1), (G, T, D), jnp.float32)
+    kw = dict(num_experts=E, top_k=K, mlp_dim=F, bias_update_rate=0.0,
+              expert_axis=None)
+    # capacity_factor E/K makes capacity == T: dropless by construction
+    m_e = MoEMlp(impl="einsum", capacity_factor=float(E) / K, **kw)
+    m_s = MoEMlp(impl="sorted", **kw)
+    v = m_e.init(jax.random.PRNGKey(0), x)
+
+    def loss(params, mod, xx):
+        y, _ = mod.apply(
+            {"params": params, "batch_stats": v["batch_stats"]}, xx,
+            mutable=["intermediates", "batch_stats"],
+        )
+        return jnp.sum(y * y)
+
+    ye, _ = m_e.apply(v, x, mutable=["intermediates", "batch_stats"])
+    ys, _ = m_s.apply(v, x, mutable=["intermediates", "batch_stats"])
+    np.testing.assert_allclose(np.asarray(ye), np.asarray(ys),
+                               rtol=2e-5, atol=2e-5)
+    ge, gxe = jax.grad(loss, argnums=(0, 2))(v["params"], m_e, x)
+    gs, gxs = jax.grad(loss, argnums=(0, 2))(v["params"], m_s, x)
+    np.testing.assert_allclose(np.asarray(gxe), np.asarray(gxs),
+                               rtol=5e-4, atol=5e-4)
+    import jax.tree_util as jtu
+
+    for (pe, le), (_, ls) in zip(
+        jtu.tree_leaves_with_path(ge), jtu.tree_leaves_with_path(gs)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(le), np.asarray(ls), rtol=5e-4, atol=5e-4,
+            err_msg=jtu.keystr(pe),
+        )
+
+
+def test_sorted_impl_router_metrics_and_bias_update():
+    """Sorted path keeps the router-health contract: drop rate exactly 0,
+    load fractions sum to 1, and the aux-free bias moves against
+    measured overload just like the einsum path."""
+    G, T, D, E, F, K = 2, 32, 16, 4, 32, 2
+    x = jax.random.normal(jax.random.PRNGKey(2), (G, T, D), jnp.float32)
+    m = MoEMlp(impl="sorted", num_experts=E, top_k=K, mlp_dim=F,
+               bias_update_rate=0.05, expert_axis=None)
+    v = m.init(jax.random.PRNGKey(0), x)
+    _, mut = m.apply(v, x, mutable=["intermediates", "batch_stats"])
+    inter = mut["intermediates"]
+    drop = float(inter["moe_drop_rate"][0])
+    load = np.asarray(inter["moe_load_frac"][0])
+    assert drop == 0.0
+    np.testing.assert_allclose(load.sum(), 1.0, rtol=1e-5)
+    bias = np.asarray(mut["batch_stats"]["router_bias"])
+    assert np.any(bias != 0.0)  # the online balancer moved
+
+
+def test_assignment_permutation_is_counting_sort():
+    """dest/inv from _assignment_permutation are mutually inverse and
+    order assignments by (expert, arrival)."""
+    from ddp_practice_tpu.ops.moe import _assignment_permutation
+
+    rng = np.random.RandomState(0)
+    cf = jnp.asarray(rng.randint(0, 5, size=64), jnp.int32)
+    counts, dest, inv = _assignment_permutation(cf, 5)
+    dest_np, inv_np = np.asarray(dest), np.asarray(inv)
+    assert sorted(dest_np.tolist()) == list(range(64))
+    np.testing.assert_array_equal(dest_np[inv_np], np.arange(64))
+    sorted_experts = np.asarray(cf)[inv_np]
+    assert (np.diff(sorted_experts) >= 0).all()
+    np.testing.assert_array_equal(
+        np.asarray(counts), np.bincount(np.asarray(cf), minlength=5)
+    )
+
+
+@pytest.mark.parametrize("cf,group_kw", [
+    (1.0, {}),
+    (1.25, {"group_size": 16}),
+    (2.0, {"group_size": 16, "group_stride": False}),
+])
+def test_gather_impl_matches_einsum(cf, group_kw):
+    """The gather path (per-slot lookup tables + custom gather-only
+    VJPs, ops/moe.py _gather) computes the SAME function as the einsum
+    path — same drops, same combine weights, same bias updates, same
+    grads — across capacity regimes and routing groups. (The measured
+    shootout left einsum the auto default — BENCHMARKS.md round-5 MoE
+    section — so gather is opt-in; this equality keeps it honest.)"""
+    import jax.tree_util as jtu
+
+    G, T, D, E, F, K = 2, 64, 32, 4, 64, 2
+    x = jax.random.normal(jax.random.PRNGKey(1), (G, T, D), jnp.float32)
+    kw = dict(num_experts=E, top_k=K, mlp_dim=F, bias_update_rate=0.05,
+              expert_axis=None, capacity_factor=cf, **group_kw)
+    m_e = MoEMlp(impl="einsum", **kw)
+    m_g = MoEMlp(impl="gather", **kw)
+    v = m_e.init(jax.random.PRNGKey(0), x)
+
+    ye, me = m_e.apply(v, x, mutable=["intermediates", "batch_stats"])
+    yg, mg = m_g.apply(v, x, mutable=["intermediates", "batch_stats"])
+    np.testing.assert_allclose(np.asarray(ye), np.asarray(yg),
+                               rtol=2e-5, atol=2e-5)
+    assert (
+        float(me["intermediates"]["moe_drop_rate"][0])
+        == float(mg["intermediates"]["moe_drop_rate"][0])
+    )
+    np.testing.assert_allclose(
+        np.asarray(me["batch_stats"]["router_bias"]),
+        np.asarray(mg["batch_stats"]["router_bias"]),
+    )
+
+    def loss(params, mod, xx):
+        y, _ = mod.apply(
+            {"params": params, "batch_stats": v["batch_stats"]}, xx,
+            mutable=["intermediates", "batch_stats"],
+        )
+        return jnp.sum(y * y)
+
+    ge, gxe = jax.grad(loss, argnums=(0, 2))(v["params"], m_e, x)
+    gg, gxg = jax.grad(loss, argnums=(0, 2))(v["params"], m_g, x)
+    np.testing.assert_allclose(np.asarray(gxe), np.asarray(gxg),
+                               rtol=5e-4, atol=5e-4)
+    for (pe, le), (_, lg) in zip(
+        jtu.tree_leaves_with_path(ge), jtu.tree_leaves_with_path(gg)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(le), np.asarray(lg), rtol=5e-4, atol=5e-4,
+            err_msg=f"cf={cf} {jtu.keystr(pe)}",
+        )
