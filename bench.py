@@ -93,24 +93,46 @@ _SUITE = {
         # 43.5% — activation HBM traffic favors the small batch)
         kind="lm", seq_len=2048, batch_size=8, steps_per_call=8, calls=6,
     ),
-    # MoE LM at lm_base dims, experts every other block (GShard layout):
-    # tokens/sec + MFU (active-FLOPs accounting) + router drop rate.
-    # warmup 10 calls (40 steps) + the synthetic Markov corpus so the
-    # recorded router health is the WARM equilibrium of the balancing
-    # machinery (fixed Switch aux + DeepSeek-style selection bias), not
-    # init-state garbage — the round-3 entry recorded an untrained
-    # router's drop=0.30 on uniform-random tokens (round-3 verdict item
-    # 3; see bench_lm_train's `data` docstring for why random tokens
-    # cannot measure router health)
+    # MoE LM at lm_base dims, experts every other block (GShard layout),
+    # under EXPERT-CHOICE routing (ops/moe.py expert_choice_gating) —
+    # the TPU-first router: experts pick tokens, so every buffer slot
+    # fills — zero drops and zero capacity padding BY CONSTRUCTION
+    # (cf 1.0: executed expert FLOPs == active FLOPs, vs the 1.5x a
+    # token-choice capacity factor executes). Measured round 5:
+    # 44.3% MFU vs 37.7% token-choice — the padding was the whole
+    # remaining MoE-dense gap (the round-5 BENCHMARKS.md MoE section
+    # records the full dispatch-glue shootout that led here). Groups
+    # of 256 strided tokens bound both the dispatch einsum cost and
+    # the EC routing-competition scope (group 128/512 measured 42.0/
+    # 41.4%).
     "lm_moe": dict(
         kind="lm", model="lm_moe", seq_len=2048, batch_size=8,
         steps_per_call=4, calls=4, warmup_calls=10, data="corpus",
-        # routing groups of 256 strided-interleaved tokens at capacity
-        # 1.5 (round-4 sweep, BENCHMARKS.md): the dispatch/combine
-        # einsums are O(group_size) per token, so 2048 -> 256 cuts them
-        # ~8x, and the interleave decorrelates per-group demand enough
-        # that cf 1.5 drops LESS (1.1%) than whole-sequence cf 2.0 did
-        # (1.4%) — +29% tokens/s at equal-or-better router health
+        model_kwargs={
+            "hidden_dim": 768, "depth": 12, "num_heads": 12,
+            "mlp_dim": 3072, "moe_every": 2, "num_experts": 8,
+            "moe_group_size": 256, "capacity_factor": 1.0,
+            "moe_router": "expert_choice",
+        },
+    ),
+    # the token-choice (GShard/Switch top-k) record: tokens/sec + MFU
+    # (active-FLOPs accounting) + router drop rate. warmup 10 calls
+    # (40 steps) + the synthetic Markov corpus so the recorded router
+    # health is the WARM equilibrium of the balancing machinery (fixed
+    # Switch aux + DeepSeek-style selection bias), not init-state
+    # garbage — the round-3 entry recorded an untrained router's
+    # drop=0.30 on uniform-random tokens (round-3 verdict item 3).
+    # Routing groups of 256 strided-interleaved tokens at capacity 1.5
+    # (round-4 sweep): the dispatch/combine einsums are O(group_size)
+    # per token, so 2048 -> 256 cuts them ~8x, and the interleave
+    # decorrelates per-group demand enough that cf 1.5 drops LESS
+    # (1.1%) than whole-sequence cf 2.0 did (1.4%). Kept in the suite:
+    # token-choice is the strictly-causal training scheme (see the EC
+    # caveat in ops/moe.py) and the multichip expert-parallel path's
+    # semantics.
+    "lm_moe_tc": dict(
+        kind="lm", model="lm_moe", seq_len=2048, batch_size=8,
+        steps_per_call=4, calls=4, warmup_calls=10, data="corpus",
         model_kwargs={
             "hidden_dim": 768, "depth": 12, "num_heads": 12,
             "mlp_dim": 3072, "moe_every": 2, "num_experts": 8,
@@ -160,8 +182,8 @@ def main(argv=None) -> int:
     p.add_argument("--models",
                    default="vit_base,vit_tiny,vit_tiny_unfused,"
                            "vit_tiny_fused,convnet,"
-                           "resnet18,resnet50,lm_long,lm_moe,lm_tiny_fused,"
-                           "lm_decode,lm_decode_bs1",
+                           "resnet18,resnet50,lm_long,lm_moe,lm_moe_tc,"
+                           "lm_tiny_fused,lm_decode,lm_decode_bs1",
                    help="comma-separated; first successful is the headline")
     p.add_argument("--precision", default="bf16", choices=["fp32", "bf16"])
     p.add_argument("--batch_size", type=int, default=0, help="override")

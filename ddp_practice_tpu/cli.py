@@ -96,6 +96,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "backward, O(P) memory (LM models)")
     p.add_argument("--num_experts", type=int, default=0,
                    help="MoE expert count (0 = auto from --expert axis)")
+    p.add_argument("--moe_router", default="topk",
+                   choices=["topk", "expert_choice"],
+                   help="MoE routing scheme: topk = tokens choose experts "
+                        "(GShard/Switch; aux loss + balance bias + capacity "
+                        "drops); expert_choice = experts choose tokens "
+                        "(perfect balance, zero drops/padding — ops/moe.py)")
     p.add_argument("--fsdp", action="store_true",
                    help="ZeRO-3: shard params + optimizer state over 'data'")
     p.add_argument("--devices", type=int, default=0,
@@ -209,6 +215,7 @@ def config_from_args(args) -> TrainConfig:
         augment_kind=args.augment_kind,
         fused_encoder=args.fused,
         num_experts=args.num_experts,
+        moe_router=args.moe_router,
         num_heads=args.num_heads,
         coordinator_address=args.coordinator,
         num_processes=args.num_processes,

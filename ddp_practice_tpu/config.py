@@ -212,6 +212,9 @@ class TrainConfig:
     # MoE expert count when mesh.expert > 1 (0 = auto: 8 rounded up to a
     # multiple of the expert axis)
     num_experts: int = 0
+    # MoE routing scheme: "topk" (tokens choose experts) |
+    # "expert_choice" (experts choose tokens; ops/moe.py)
+    moe_router: str = "topk"
     # attention head count override for transformer models (0 = model
     # default); tensor parallelism shards heads, so heads % tensor == 0
     num_heads: int = 0

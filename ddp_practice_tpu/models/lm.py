@@ -84,6 +84,8 @@ class TransformerLM(nn.Module):
     # (ops/moe.py group_size)
     moe_group_size: int = 0
     moe_group_stride: bool = True
+    # routing scheme: "topk" | "expert_choice" (ops/moe.py MoEMlp.router)
+    moe_router: str = "topk"
     # run each block as ONE Pallas kernel per direction with causal
     # masking (ops/fused_encoder.py, round 4) — the small-d short-seq
     # HBM-bound fix, now available to decoder LMs. Training-only
@@ -207,6 +209,7 @@ class TransformerLM(nn.Module):
                 moe_bias_rate=self.moe_bias_rate,
                 moe_group_size=self.moe_group_size,
                 moe_group_stride=self.moe_group_stride,
+                moe_router=self.moe_router,
                 # tri-state pass-through ("auto" must survive; `and` would
                 # collapse it to a bool). decode always takes the per-op
                 # KV-cache path; routed blocks can never fuse (the kernel

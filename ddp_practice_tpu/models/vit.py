@@ -291,6 +291,9 @@ class EncoderBlock(nn.Module):
     # tokens per routing group (0 = whole sequence); see ops/moe.py
     moe_group_size: int = 0
     moe_group_stride: bool = True
+    # routing scheme: "topk" (tokens choose) | "expert_choice" (experts
+    # choose — zero padding/drops; ops/moe.py MoEMlp.router)
+    moe_router: str = "topk"
     # run the whole layer as ONE Pallas kernel per direction
     # (ops/fused_encoder.py): the HBM-bound small-d regime's fix
     # (BENCHMARKS.md ViT-Tiny analysis). Short-sequence blocks whose
@@ -404,11 +407,12 @@ class EncoderBlock(nn.Module):
                 bias_update_rate=self.moe_bias_rate,
                 group_size=self.moe_group_size,
                 group_stride=self.moe_group_stride,
+                router=self.moe_router,
                 mlp_dim=self.mlp_dim,
                 dtype=self.dtype,
                 param_dtype=self.param_dtype,
                 name="moe",
-            )(y)
+            )(y, decode=decode)
             # residual-branch dropout for the routed MLP — the dense
             # MlpBlock applies its own internally; without this the MoE
             # blocks would silently train unregularized under --dropout

@@ -29,6 +29,8 @@ class ViTMoE(nn.Module):
     top_k: int = 2
     capacity_factor: float = 1.25
     moe_every: int = 2               # every 2nd block is MoE (GShard layout)
+    # routing scheme: "topk" | "expert_choice" (ops/moe.py MoEMlp.router)
+    moe_router: str = "topk"
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
     seq_axis: Optional[str] = None
@@ -55,6 +57,7 @@ class ViTMoE(nn.Module):
                 num_experts=self.num_experts,
                 moe_top_k=self.top_k,
                 capacity_factor=self.capacity_factor,
+                moe_router=self.moe_router,
                 dtype=self.dtype,
                 param_dtype=self.param_dtype,
                 seq_axis=self.seq_axis,

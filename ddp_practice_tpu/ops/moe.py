@@ -169,6 +169,48 @@ def top_k_routing(
     )
 
 
+def expert_choice_gating(
+    router_logits: jnp.ndarray,  # (G, T, E) fp32
+    *,
+    capacity: int,
+):
+    """Expert-choice routing (Zhou et al. 2022): experts pick tokens.
+
+    Each expert takes the top-`capacity` tokens of its softmax column,
+    so every buffer slot is filled — perfect load balance, zero drops,
+    and zero capacity padding BY CONSTRUCTION (executed expert FLOPs ==
+    active FLOPs; with capacity k*T/E the compute matches top-k routing
+    exactly). No auxiliary loss and no balancing bias are needed; the
+    machinery that token-choice requires to fight imbalance simply has
+    nothing to do. Combine weights are the raw router gates at the
+    picked (token, expert) pairs (the paper's formulation — tokens
+    chosen by several experts sum their contributions; tokens chosen by
+    none ride the residual).
+
+    Returns (dispatch (G,T,E,C), combine (G,T,E,C), uncovered — the
+    fraction of tokens no expert picked, the quality-relevant analogue
+    of token-choice's drop rate).
+
+    Caveat (documented, inherent to EC): a token's routing depends on
+    which OTHER tokens in its routing group compete for the same
+    experts — for causal LMs that lets training-time routing (only
+    routing, never attention) see the future. Mixture-of-Depths
+    (Raposo et al. 2024) discusses the same property and its inference
+    predictors; scope the competition with routing groups and prefer
+    token-choice when strict train-time causality matters.
+    """
+    g, t, e = router_logits.shape
+    gates = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+    scores = jnp.swapaxes(gates, 1, 2)                    # (G, E, T)
+    _, idx = jax.lax.top_k(scores, capacity)              # (G, E, C)
+    onehot = jax.nn.one_hot(idx, t, dtype=jnp.float32)    # (G, E, C, T)
+    dispatch = jnp.transpose(onehot, (0, 3, 1, 2))        # (G, T, E, C)
+    combine = dispatch * gates[..., None]
+    covered = jnp.clip(jnp.sum(dispatch, axis=(2, 3)), 0.0, 1.0)
+    uncovered = 1.0 - jnp.mean(covered)
+    return dispatch, combine, uncovered
+
+
 def top_k_gating(
     router_logits: jnp.ndarray,  # (G, T, E) fp32
     *,
@@ -586,9 +628,20 @@ class MoEMlp(nn.Module):
     #     at production shapes (BENCHMARKS.md round-5 MoE section
     #     records the full gather/sorted shootout).
     impl: str = "auto"
+    # routing scheme:
+    #   "topk" — tokens choose experts (GShard/Switch): the default;
+    #     needs the aux loss + balancing bias, pays capacity padding
+    #     (cf x active FLOPs executed) and drops overflow tokens.
+    #   "expert_choice" — experts choose tokens (expert_choice_gating):
+    #     perfect balance, zero drops, zero padding by construction —
+    #     executed == active FLOPs at cf 1.0, the TPU-efficiency
+    #     choice. Training-time routing sees the whole routing group
+    #     (causality caveat in the gating docstring).
+    router: str = "topk"
 
     @nn.compact
-    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:  # (G, T, D)
+    def __call__(self, x: jnp.ndarray, *, decode: bool = False
+                 ) -> jnp.ndarray:  # (G, T, D)
         impl = self.impl
         if impl == "auto":
             impl = "einsum"
@@ -597,11 +650,21 @@ class MoEMlp(nn.Module):
                 f"moe impl {impl!r} (want 'auto'|'einsum'|'gather'|"
                 "'sorted')"
             )
+        if self.router not in ("topk", "expert_choice"):
+            raise ValueError(
+                f"moe router {self.router!r} (want 'topk'|'expert_choice')"
+            )
+        if self.router == "expert_choice" and impl != "einsum":
+            raise ValueError(
+                "expert_choice routing runs on the einsum path (its "
+                "dispatch is already dense and padding-free); pass "
+                "impl='auto'/'einsum'"
+            )
         if impl == "sorted" and not self.is_initializing():
             return self._sorted(x)
         if impl == "gather" and not self.is_initializing():
             return self._gather(x)
-        return self._einsum(x)
+        return self._einsum(x, decode=decode)
 
     def _group(self, x):
         """Apply the routing-group reshape (see group_size/group_stride);
@@ -818,9 +881,12 @@ class MoEMlp(nn.Module):
         TRAINING path (mutable batch_stats, like the router-bias
         update): short inputs are NORMAL in decode/prefill (t0 =
         prompt length or 1 — inference.py drives this module with
-        the training group_size) and must stay silent."""
+        the training group_size) and must stay silent. The training
+        signal is a mutable "intermediates" collection (the metric
+        sows) — NOT batch_stats, which expert-choice models don't
+        create at all."""
         if (self.group_size > t0 and not self.is_initializing()
-                and self.is_mutable_collection("batch_stats")):
+                and self.is_mutable_collection("intermediates")):
             import warnings
 
             warnings.warn(
@@ -830,7 +896,8 @@ class MoEMlp(nn.Module):
                 stacklevel=2,
             )
 
-    def _einsum(self, x: jnp.ndarray) -> jnp.ndarray:
+    def _einsum(self, x: jnp.ndarray, *, decode: bool = False
+                ) -> jnp.ndarray:
         g0, t0, d = x.shape
         self._warn_oversized_group(t0)
         x, n_sub = self._group(x)
@@ -848,25 +915,67 @@ class MoEMlp(nn.Module):
             name="router",
         )
         logits = router(x.astype(jnp.float32))               # (G, T, E)
-        bias = self._router_bias(e)
-        dispatch, combine, aux, demand = top_k_gating(
-            logits, k=self.top_k, capacity=capacity,
-            routing_bias=None if bias is None else bias.value,
-        )
-        self._update_bias(bias, demand, e)
-        self.sow("intermediates", "moe_aux_loss", self.aux_loss_weight * aux)
-        # router health (diagnostic sows — no "aux_loss" in the name, so
-        # they never join the objective; train/steps.py surfaces them as
-        # moe_* metrics): per-expert share of ROUTED tokens, and the
-        # fraction of the k*T assignment slots lost to capacity drops
+        if self.router == "expert_choice" and not decode:
+            # experts pick tokens: full buffers, no aux loss, no
+            # balancing bias — the imbalance-fighting machinery has
+            # nothing to do (expert_choice_gating docstring). Capacity
+            # clamps to the group token count: small groups / few
+            # experts make cf*k*T/E exceed T, and an expert cannot
+            # pick more tokens than exist.
+            dispatch, combine, uncovered = expert_choice_gating(
+                logits, capacity=min(capacity, t)
+            )
+            self.sow(
+                "intermediates", "moe_aux_loss", jnp.zeros((), jnp.float32)
+            )
+            # the quality-relevant analogue of the drop rate: tokens no
+            # expert picked (they ride the residual unchanged). Capacity
+            # drops are zero by construction; this reports coverage.
+            self.sow("intermediates", "moe_drop_rate", uncovered)
+        else:
+            if self.router == "expert_choice":
+                # KV-cache decode: expert choice has no serving story of
+                # its own (with T=1 every expert would pick the lone
+                # token — E/k the trained compute, different function).
+                # Use the standard EC serving approximation: per-token
+                # top-k over the gates, capacity = t so nothing drops.
+                # Combine with the RAW gates at the picked experts —
+                # EC training combines with raw gates, so reusing
+                # top_k_gating's renormalized weights would rescale
+                # every MoE branch by ~1/(sum of picked gates) at
+                # serve time. A train/infer expert-selection mismatch
+                # is inherent to EC (Zhou et al. 2022 §3.2 /
+                # Mixture-of-Depths §inference discuss predictors);
+                # token-choice routing is the option without it.
+                dispatch, _combine, _aux, _demand = top_k_gating(
+                    logits, k=self.top_k, capacity=t, routing_bias=None,
+                )
+                gates = jax.nn.softmax(logits.astype(jnp.float32), -1)
+                combine = dispatch * gates[..., None]
+            else:
+                bias = self._router_bias(e)
+                dispatch, combine, aux, demand = top_k_gating(
+                    logits, k=self.top_k, capacity=capacity,
+                    routing_bias=None if bias is None else bias.value,
+                )
+                self._update_bias(bias, demand, e)
+                self.sow(
+                    "intermediates", "moe_aux_loss",
+                    self.aux_loss_weight * aux,
+                )
+                # the fraction of the k*T slots lost to capacity drops
+                # (diagnostic sows — no "aux_loss" in the name, so they
+                # never join the objective; train/steps.py surfaces
+                # them as moe_* metrics)
+                self.sow(
+                    "intermediates", "moe_drop_rate",
+                    1.0 - jnp.sum(dispatch) / (self.top_k * g * t),
+                )
+        # per-expert share of ROUTED tokens — shared router-health sow
         routed = jnp.sum(dispatch)
         self.sow(
             "intermediates", "moe_load_frac",
             jnp.sum(dispatch, axis=(0, 1, 3)) / jnp.maximum(routed, 1.0),
-        )
-        self.sow(
-            "intermediates", "moe_drop_rate",
-            1.0 - routed / (self.top_k * g * t),
         )
 
         w_in, b_in, w_out, b_out = self._expert_params(d, e, f)

@@ -224,6 +224,18 @@ class Trainer:
                     f"num_experts={n_exp} not divisible by expert axis {self.ep}"
                 )
             model_kwargs["num_experts"] = n_exp
+        if config.moe_router != "topk":
+            if config.model not in ("vit_tiny_moe", "lm_moe"):
+                raise ValueError(
+                    "--moe_router applies to the MoE model families "
+                    f"(vit_tiny_moe, lm_moe), not {config.model!r}"
+                )
+            model_kwargs["moe_router"] = config.moe_router
+            # expert choice fills buffers by construction: cf 1.0 IS
+            # "executed == active FLOPs". The registries' token-choice
+            # headroom defaults (lm_moe 2.0) would silently double the
+            # expert compute here.
+            model_kwargs.setdefault("capacity_factor", 1.0)
         if self.task == "lm":
             model_kwargs["vocab_size"] = self._vocab_size
             model_kwargs["max_len"] = config.seq_len
