@@ -59,16 +59,31 @@ class Dataset:
         return tuple(self.images.shape[1:])
 
 
+def read_idx_header(f, path: str = "<stream>"):
+    """Parse an IDX header from an open binary stream -> dims tuple.
+
+    The ONE definition of the header format, shared by the loader below
+    and the ingest tool's structural verification (data/ingest.py)."""
+    magic = struct.unpack(">I", f.read(4))[0]
+    dtype_code = (magic >> 8) & 0xFF
+    ndim = magic & 0xFF
+    if dtype_code != 0x08:  # unsigned byte — the only type MNIST uses
+        raise ValueError(f"unsupported IDX dtype 0x{dtype_code:02x} in {path}")
+    return struct.unpack(f">{ndim}I", f.read(4 * ndim))
+
+
+def idx_dims(path: str):
+    """Dims tuple of an IDX file (raw or .gz) without reading the data."""
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        return read_idx_header(f, path)
+
+
 def _read_idx(path: str) -> np.ndarray:
     """Parse an IDX-format file (the MNIST on-disk format)."""
     opener = gzip.open if path.endswith(".gz") else open
     with opener(path, "rb") as f:
-        magic = struct.unpack(">I", f.read(4))[0]
-        dtype_code = (magic >> 8) & 0xFF
-        ndim = magic & 0xFF
-        if dtype_code != 0x08:  # unsigned byte — the only type MNIST uses
-            raise ValueError(f"unsupported IDX dtype 0x{dtype_code:02x} in {path}")
-        dims = struct.unpack(f">{ndim}I", f.read(4 * ndim))
+        dims = read_idx_header(f, path)
         data = np.frombuffer(f.read(), dtype=np.uint8)
         return data.reshape(dims)
 

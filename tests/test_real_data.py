@@ -1,0 +1,129 @@
+"""Real-format data path, end to end (round-5 verdict item 5).
+
+The committed fixtures in tests/data/ are REAL on-disk formats — gzip
+IDX files byte-identical in structure to the MNIST distribution, and
+CIFAR-10 python pickle batches — with small synthetic (separable)
+pixels, so the full real-data path (`load_mnist`/`_load_cifar10` ->
+DataLoader -> Trainer) runs and LEARNS in CI without network egress.
+With the genuine archives ingested (`python -m
+ddp_practice_tpu.data.ingest`), the identical path reproduces the
+reference's 91.55%-in-3-epochs contract (PARITY.md "with real files").
+"""
+
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "data")
+MINI_MNIST = os.path.join(FIXTURES, "mini_mnist")
+MINI_CIFAR = os.path.join(FIXTURES, "mini_cifar")
+
+
+def test_mini_mnist_loads_as_real_idx():
+    from ddp_practice_tpu.data.datasets import load_dataset, load_mnist
+
+    train = load_mnist(MINI_MNIST, "train")
+    assert train is not None and train.name == "mnist-train"
+    assert train.images.shape == (256, 28, 28, 1)
+    assert train.images.dtype == np.uint8
+    # the registry resolves to the REAL loader, not the synthetic stand-in
+    ds = load_dataset("mnist", MINI_MNIST, "test", seed=0)
+    assert ds.name == "mnist-test" and len(ds) == 64
+
+
+def test_mini_cifar_loads_as_real_batches():
+    from ddp_practice_tpu.data.datasets import _load_cifar10
+
+    train = _load_cifar10(MINI_CIFAR, "train")
+    test = _load_cifar10(MINI_CIFAR, "test")
+    assert train.images.shape == (250, 32, 32, 3)
+    assert test.images.shape == (50, 32, 32, 3)
+    assert train.images.dtype == np.uint8
+
+
+def test_mnist_idx_trains_end_to_end():
+    """fit() on the committed IDX files: the real-format loader feeds
+    the full Trainer and the model learns (the pixels are separable;
+    chance is 10%)."""
+    from ddp_practice_tpu.config import TrainConfig
+    from ddp_practice_tpu.train.loop import fit
+
+    summary = fit(TrainConfig(
+        model="convnet", dataset="mnist", data_dir=MINI_MNIST,
+        epochs=10, batch_size=4, optimizer="adam", learning_rate=1e-3,
+        log_every_steps=0, compilation_cache="off",
+    ))
+    assert summary["accuracy"] > 0.5, summary
+
+
+def test_cifar_batches_train_end_to_end():
+    from ddp_practice_tpu.config import TrainConfig
+    from ddp_practice_tpu.train.loop import fit
+
+    summary = fit(TrainConfig(
+        model="convnet", dataset="cifar10", data_dir=MINI_CIFAR,
+        epochs=10, batch_size=5, optimizer="adam", learning_rate=1e-3,
+        log_every_steps=0, compilation_cache="off",
+    ))
+    assert summary["accuracy"] > 0.5, summary
+
+
+def test_ingest_places_and_structurally_verifies(tmp_path):
+    """The ingest tool finds IDX files under a torchvision-style tree,
+    checks their structure, and places them where the loader looks.
+    (Checksums apply to the canonical archives; the fixture uses
+    --no-verify exactly as its docstring prescribes.)"""
+    from ddp_practice_tpu.data.datasets import load_mnist
+    from ddp_practice_tpu.data.ingest import ingest_mnist
+
+    src = tmp_path / "torch_data" / "MNIST" / "raw"
+    src.parent.mkdir(parents=True)
+    shutil.copytree(MINI_MNIST, src)
+    out = tmp_path / "data"
+    rc = ingest_mnist(str(tmp_path / "torch_data"), str(out), verify=False)
+    assert rc == 0
+    assert load_mnist(str(out), "train") is not None
+
+
+def test_ingest_rejects_wrong_checksum(tmp_path):
+    """A file with the canonical name but the wrong bytes must fail
+    loudly under verification, never train silently."""
+    from ddp_practice_tpu.data.ingest import ingest_mnist
+
+    src = tmp_path / "src"
+    src.mkdir()
+    shutil.copy(
+        os.path.join(MINI_MNIST, "train-images-idx3-ubyte.gz"),
+        src / "train-images-idx3-ubyte.gz",
+    )
+    with pytest.raises(SystemExit, match="checksum mismatch"):
+        ingest_mnist(str(src), str(tmp_path / "out"), verify=True)
+
+
+def test_ingest_cifar_tree_structural_check(tmp_path):
+    """A pre-extracted CIFAR tree is structurally verified (batch count,
+    3072-wide uint8 rows, label count) before being placed; a truncated
+    batch fails loudly."""
+    from ddp_practice_tpu.data.ingest import ingest_cifar10
+
+    # the good fixture passes
+    out = tmp_path / "data"
+    rc = ingest_cifar10(MINI_CIFAR, str(out), verify=True)
+    assert rc == 0
+    assert (out / "cifar-10-batches-py" / "data_batch_1").exists()
+
+    # a corrupted copy fails
+    import pickle
+
+    bad_src = tmp_path / "bad"
+    shutil.copytree(
+        os.path.join(MINI_CIFAR, "cifar-10-batches-py"),
+        bad_src / "cifar-10-batches-py",
+    )
+    with open(bad_src / "cifar-10-batches-py" / "data_batch_3", "wb") as f:
+        pickle.dump({b"data": np.zeros((5, 7), np.uint8),
+                     b"labels": [0] * 5}, f)
+    with pytest.raises(SystemExit, match="not a CIFAR batch"):
+        ingest_cifar10(str(bad_src), str(tmp_path / "out2"), verify=True)
