@@ -47,6 +47,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="override the manifest's model name")
     p.add_argument("--seq_len", type=int, default=0,
                    help="override the manifest's max sequence length")
+    p.add_argument("--kv_cache", default="policy",
+                   choices=["policy", "int8"],
+                   help="KV-cache storage: policy dtype (default) or int8 "
+                        "(quantized cache + scales — ~1%% logit error, "
+                        "faster past ~768-token contexts; BENCHMARKS.md)")
     return p
 
 
@@ -74,11 +79,15 @@ def load_lm(args) -> tuple:
         if extra.get("precision_policy") == "bf16"
         else PrecisionPolicy.fp32()
     )
+    model_kw = {}
+    if getattr(args, "kv_cache", "policy") == "int8":
+        model_kw["kv_cache_dtype"] = "int8"
     model = create_model(
         name, policy=policy, vocab_size=vocab, max_len=seq_len,
         remat=bool(extra.get("remat", False)),
         pos_emb=extra.get("pos_emb", "learned"),
         tied_embeddings=bool(extra.get("tied_embeddings", False)),
+        **model_kw,
     )
     # rebuild the train-state TREE abstractly (shapes only, no init FLOPs)
     # so restore()'s strict path check accepts the leaves

@@ -43,9 +43,10 @@ class TransformerLM(nn.Module):
     seq_axis: Optional[str] = None  # mesh axis for sequence parallelism
     sp_impl: str = "ring"
     attn_impl: str = "xla"
-    # KV-cache storage dtype for decode (None = compute dtype); see
+    # KV-cache storage dtype for decode: None (= compute dtype), a
+    # jnp.dtype, or the string "int8" (quantized cache + scales); see
     # models/vit.py SelfAttention.kv_cache_dtype
-    kv_cache_dtype: Optional[jnp.dtype] = None
+    kv_cache_dtype: object = None
     # rematerialize each block's activations in the backward pass
     # (jax.checkpoint): trades ~1/3 more FLOPs for O(depth) less
     # activation memory — the standard long-context lever (with the

@@ -102,9 +102,15 @@ class SelfAttention(nn.Module):
     rope: bool = False              # rotary Q/K (ops/rope.py) vs none here
     # decode-mode KV-cache storage dtype. None = the compute dtype (bf16
     # under the bf16 policy — already the small option there); set
-    # jnp.bfloat16 to halve cache traffic under an fp32 policy. Writes
-    # round to this dtype; attention math runs at the q/k promotion.
-    kv_cache_dtype: Optional[jnp.dtype] = None
+    # jnp.bfloat16 to halve cache traffic under an fp32 policy. The
+    # string "int8" stores a QUANTIZED cache (1 byte/element + per-
+    # (batch, head, position) fp32 scales; ~1% relative logit error,
+    # pinned in tests/test_decode_attention.py) — measured +17.5%
+    # decode tokens/s at bs=8/L=1024 where the cache read dominates;
+    # below L~768 the scale traffic eats the saving (BENCHMARKS.md).
+    # Writes round to this dtype; attention math runs at the q/k
+    # promotion (int8 dequantizes inside the packed kernel).
+    kv_cache_dtype: object = None  # None | jnp.dtype | "int8"
 
     @nn.compact
     def __call__(self, x, *, decode: bool = False, attn_start=None):
@@ -171,7 +177,18 @@ class SelfAttention(nn.Module):
                     "decode (KV-cache) mode does not compose with sequence "
                     "parallelism — generate on a data/tensor-sharded mesh"
                 )
-            cache_dtype = self.kv_cache_dtype or k.dtype
+            # "int8": quantized cache — 1 byte/element plus per-(batch,
+            # head, position) fp32 scales. Decode is HBM-bound and the
+            # cache is ~40% of its traffic at batched sizes, so this is
+            # the decode-MBU lever (round 5; ops/decode_attention.py
+            # folds the scales into the kernel's score/probability
+            # rows). The scale buffers are small ((b, h, L) f32); their
+            # minor-dim dynamic updates may copy, which at ~KB scale is
+            # noise next to the MB-scale cache stream they halve.
+            quant = self.kv_cache_dtype == "int8"
+            cache_dtype = (
+                jnp.int8 if quant else (self.kv_cache_dtype or k.dtype)
+            )
             b_, s_, h_, hd_ = k.shape
             flat_kv = (b_, s_, h_ * hd_)
             cached_key = self.variable(
@@ -183,6 +200,16 @@ class SelfAttention(nn.Module):
             cache_index = self.variable(
                 "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
             )
+            key_scale = value_scale = None
+            if quant:
+                key_scale = self.variable(
+                    "cache", "cached_key_scale", jnp.zeros,
+                    (b_, h_, s_), jnp.float32,
+                )
+                value_scale = self.variable(
+                    "cache", "cached_value_scale", jnp.zeros,
+                    (b_, h_, s_), jnp.float32,
+                )
             if self.is_initializing():
                 out = dot_product_attention(q, k, v, causal=True, impl="xla")
             else:
@@ -204,14 +231,37 @@ class SelfAttention(nn.Module):
                     positions = cur + jnp.arange(s)
                     q = apply_rope(q, positions)
                     k = apply_rope(k, positions)
+                if quant:
+                    def _quantize(x4):
+                        # per-(batch, token, head) symmetric int8: the
+                        # scale is that row's max |.| mapped to 127
+                        amax = jnp.max(
+                            jnp.abs(x4.astype(jnp.float32)), axis=-1
+                        )                                # (b, s, h)
+                        scale = jnp.maximum(amax, 1e-8) / 127.0
+                        xq = jnp.round(
+                            x4.astype(jnp.float32) / scale[..., None]
+                        ).astype(jnp.int8)
+                        return xq, jnp.swapaxes(scale, 1, 2)  # (b, h, s)
+
+                    k_store, ks_new = _quantize(k)
+                    v_store, vs_new = _quantize(v)
+                    key_scale.value = lax.dynamic_update_slice(
+                        key_scale.value, ks_new, (0, 0, cur)
+                    )
+                    value_scale.value = lax.dynamic_update_slice(
+                        value_scale.value, vs_new, (0, 0, cur)
+                    )
+                else:
+                    k_store, v_store = k, v
                 kc = lax.dynamic_update_slice(
                     cached_key.value,
-                    k.reshape(flat_kv[0], s, -1).astype(cache_dtype),
+                    k_store.reshape(flat_kv[0], s, -1).astype(cache_dtype),
                     (0, cur, 0),
                 )
                 vc = lax.dynamic_update_slice(
                     cached_value.value,
-                    v.reshape(flat_kv[0], s, -1).astype(cache_dtype),
+                    v_store.reshape(flat_kv[0], s, -1).astype(cache_dtype),
                     (0, cur, 0),
                 )
                 cached_key.value = kc
@@ -219,10 +269,13 @@ class SelfAttention(nn.Module):
                 cache_index.value = cur + s
                 if s == 1 and _heads_per_pack(h_, hd_) is not None:
                     # token step: packed kernel on the flat cache —
-                    # no reshape, O(cur) cache reads
+                    # no reshape, O(cur) cache reads (int8: scales ride
+                    # as separate small operands)
                     out = decode_attention_packed(
                         q.reshape(flat_kv[0], 1, -1), kc, vc, cur,
                         attn_start, n_heads=h_,
+                        k_scale=key_scale.value if quant else None,
+                        v_scale=value_scale.value if quant else None,
                     ).reshape(flat_kv[0], 1, h_, hd_)
                 else:
                     # prefill (s = prompt length) or unpackable head
@@ -230,6 +283,15 @@ class SelfAttention(nn.Module):
                     # XLA path (amortized over the whole generation)
                     k4 = kc.reshape(flat_kv[0], max_len, h_, hd_)
                     v4 = vc.reshape(flat_kv[0], max_len, h_, hd_)
+                    if quant:
+                        # dequantize for the XLA path (one prefill pass
+                        # per generation — amortized)
+                        ks_t = jnp.swapaxes(key_scale.value, 1, 2)
+                        vs_t = jnp.swapaxes(value_scale.value, 1, 2)
+                        k4 = (k4.astype(jnp.float32)
+                              * ks_t[..., None]).astype(q.dtype)
+                        v4 = (v4.astype(jnp.float32)
+                              * vs_t[..., None]).astype(q.dtype)
                     pos_q = cur + jnp.arange(s)
                     mask = jnp.arange(max_len)[None, :] <= pos_q[:, None]
                     if attn_start is not None:
@@ -273,7 +335,8 @@ class EncoderBlock(nn.Module):
     attn_impl: str = "xla"
     causal: bool = False
     rope: bool = False
-    kv_cache_dtype: Optional[jnp.dtype] = None
+    # pass-through to SelfAttention: None | jnp.dtype | "int8"
+    kv_cache_dtype: object = None
     # residual-branch dropout (after the attention projection and inside
     # the MLP). Deliberately NOT on the attention probabilities: that
     # variant cannot compose with the flash/ring kernels, which never

@@ -103,12 +103,16 @@ def _kernel(
 def _kernel_single(
     cur_ref, start_ref,
     q_ref, k_ref, v_ref, o_ref,
-    *, sm_scale, L, n_heads, d, has_start,
+    *, sm_scale, L, n_heads, d, has_start, compute_dtype=None,
 ):
     """Single-block fast path (whole cache in one tile): plain softmax,
     no online state, no scratch carry — at large batch the multi-block
     kernel's per-cell state machinery dominates the step (bs=64 profile,
-    round 4), and a cache that fits one tile needs none of it."""
+    round 4), and a cache that fits one tile needs none of it.
+
+    compute_dtype: dtype the K/V tiles are cast to before the dots —
+    needed when the cache is stored quantized (int8), where the MXU
+    can't consume the raw tile."""
     b_idx = pl.program_id(0)
     cur = cur_ref[0]
     k_pos = jax.lax.broadcasted_iota(jnp.int32, (8, L), 1)
@@ -116,16 +120,56 @@ def _kernel_single(
     if has_start:
         valid &= k_pos >= start_ref[b_idx]
     penalty = jnp.where(valid, 0.0, _NEG_INF)
+    cd = compute_dtype or q_ref.dtype
     for hh in range(n_heads):
         lo, hi = hh * d, (hh + 1) * d
-        qs = (q_ref[:, lo:hi] * sm_scale).astype(q_ref.dtype)
+        qs = (q_ref[:, lo:hi] * sm_scale).astype(cd)
         q8 = jnp.broadcast_to(qs, (8, d))
-        s = _dot_tb(q8, k_ref[:, lo:hi]) + penalty       # (8, L) f32
+        s = _dot_tb(q8, k_ref[:, lo:hi].astype(cd)) + penalty  # (8, L) f32
         m = jnp.max(s, axis=1, keepdims=True)
         p = jnp.exp(s - m)
         l = jnp.sum(p, axis=1, keepdims=True)
         pv = lax.dot_general(
-            p.astype(v_ref.dtype), v_ref[:, lo:hi],
+            p.astype(cd), v_ref[:, lo:hi].astype(cd),
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        o_ref[:, lo:hi] = (pv[:1] / l[:1]).astype(o_ref.dtype)
+
+
+def _kernel_single_quant(
+    cur_ref, start_ref,
+    q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref,
+    *, sm_scale, L, n_heads, d, has_start, compute_dtype,
+):
+    """Single-tile kernel over an INT8 cache with per-(head, position)
+    scales, shapes (h, L). The scales never touch the int8 tiles
+    directly: the K scale multiplies the score row AFTER the q.k dot
+    (s_h(l) = ks(h,l) * <q_h, k_int8(l)>), and the V scale folds into
+    the probability vector BEFORE the p.v dot — two (8, L) VPU
+    multiplies replace any dequantized (L, d) materialization, so the
+    MXU still consumes plain tiles and HBM still streams 1 byte/elem."""
+    b_idx = pl.program_id(0)
+    cur = cur_ref[0]
+    k_pos = jax.lax.broadcasted_iota(jnp.int32, (8, L), 1)
+    valid = k_pos <= cur
+    if has_start:
+        valid &= k_pos >= start_ref[b_idx]
+    penalty = jnp.where(valid, 0.0, _NEG_INF)
+    cd = compute_dtype
+    for hh in range(n_heads):
+        lo, hi = hh * d, (hh + 1) * d
+        qs = (q_ref[:, lo:hi] * sm_scale).astype(cd)
+        q8 = jnp.broadcast_to(qs, (8, d))
+        s = _dot_tb(q8, k_ref[:, lo:hi].astype(cd))      # (8, L) f32
+        ks = ks_ref[hh, :].reshape(1, L)                 # (1, L) f32
+        s = s * ks + penalty
+        m = jnp.max(s, axis=1, keepdims=True)
+        p = jnp.exp(s - m)
+        l = jnp.sum(p, axis=1, keepdims=True)
+        vs = vs_ref[hh, :].reshape(1, L)
+        pv = lax.dot_general(
+            (p * vs).astype(cd), v_ref[:, lo:hi].astype(cd),
             (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
@@ -140,6 +184,8 @@ def decode_attention_packed(
     attn_start=None,       # optional (b,) int32: first valid key position
     *,
     n_heads: int,
+    k_scale=None,          # (b, h, L) f32 — int8-cache dequant scales
+    v_scale=None,
     block_l: int = 256,
     single_block_max: int = 1024,
 ) -> jnp.ndarray:
@@ -154,6 +200,13 @@ def decode_attention_packed(
     kernel, where `block_l` trades DMA granularity against grid
     overhead: reads round up to whole blocks past `cur` and skipped
     blocks cost ~nothing.
+
+    k_scale/v_scale mark an INT8 cache (models/vit.py
+    kv_cache_dtype="int8"): tiles stream at 1 byte/element and the
+    per-(head, position) scales fold into the score row / probability
+    vector inside the kernel (_kernel_single_quant) — decode traffic
+    is the bandwidth roofline, so halving cache bytes is the lever the
+    round-5 MBU work turned (BENCHMARKS.md decode section).
     """
     from jax.experimental.pallas import tpu as pltpu
 
@@ -171,6 +224,9 @@ def decode_attention_packed(
         )
     sm_scale = 1.0 / (d ** 0.5)
     has_start = attn_start is not None
+    quant = k_scale is not None
+    if quant and v_scale is None:
+        raise ValueError("int8 cache needs BOTH k_scale and v_scale")
 
     cur1 = jnp.asarray(cur, jnp.int32).reshape(1)
     start = (
@@ -180,7 +236,48 @@ def decode_attention_packed(
     interpret = jax.default_backend() == "cpu"
     sem = pltpu.CompilerParams
 
+    if quant and L > single_block_max:
+        # long-cache int8 falls back to a dequantized pass through the
+        # multi-block kernel below: correct, but it materializes a bf16
+        # cache copy — the quantized multi-block kernel is future work
+        # (the bench regime L<=1024 never takes this branch)
+        scale_k = jnp.swapaxes(k_scale, 1, 2).repeat(d, axis=-1)
+        scale_v = jnp.swapaxes(v_scale, 1, 2).repeat(d, axis=-1)
+        k_cache = (k_cache.astype(jnp.float32) * scale_k).astype(q.dtype)
+        v_cache = (v_cache.astype(jnp.float32) * scale_v).astype(q.dtype)
+        quant = False
+
     if L <= single_block_max:
+        if quant:
+            kernel = functools.partial(
+                _kernel_single_quant, sm_scale=sm_scale, L=L,
+                n_heads=n_heads, d=d, has_start=has_start,
+                compute_dtype=q.dtype,
+            )
+            scale_spec = pl.BlockSpec((None, n_heads, L),
+                                      lambda b_, *_: (b_, 0, 0))
+            return pl.pallas_call(
+                kernel,
+                grid_spec=pltpu.PrefetchScalarGridSpec(
+                    num_scalar_prefetch=2,
+                    grid=(b,),
+                    in_specs=[
+                        pl.BlockSpec((None, 1, hd_total),
+                                     lambda b_, *_: (b_, 0, 0)),
+                        pl.BlockSpec((None, L, hd_total),
+                                     lambda b_, *_: (b_, 0, 0)),
+                        pl.BlockSpec((None, L, hd_total),
+                                     lambda b_, *_: (b_, 0, 0)),
+                        scale_spec,
+                        scale_spec,
+                    ],
+                    out_specs=pl.BlockSpec((None, 1, hd_total),
+                                           lambda b_, *_: (b_, 0, 0)),
+                ),
+                out_shape=jax.ShapeDtypeStruct((b, 1, hd_total), q.dtype),
+                compiler_params=sem(dimension_semantics=("parallel",)),
+                interpret=interpret,
+            )(cur1, start, q, k_cache, v_cache, k_scale, v_scale)
         kernel = functools.partial(
             _kernel_single, sm_scale=sm_scale, L=L, n_heads=n_heads, d=d,
             has_start=has_start,

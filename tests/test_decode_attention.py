@@ -105,3 +105,41 @@ def test_q8_broadcast_matches_plain():
     np.testing.assert_allclose(
         np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5
     )
+
+
+def test_int8_kv_cache_decode_close_to_full_forward(devices):
+    """kv_cache_dtype='int8': cached decode through the quantized packed
+    kernel tracks the full forward within quantization tolerance (~1%
+    relative — per-(batch, head, position) symmetric scales), and the
+    cache actually stores int8."""
+    import numpy as np
+
+    from ddp_practice_tpu.inference import make_cache
+    from ddp_practice_tpu.models import create_model
+
+    VOCAB, TOTAL = 32, 16
+    rng = np.random.default_rng(1)
+    tokens = jnp.asarray(rng.integers(0, VOCAB, (2, 12)), jnp.int32)
+    kw = dict(vocab_size=VOCAB, max_len=TOTAL, hidden_dim=64, depth=2,
+              num_heads=1, mlp_dim=128)
+    m_q = create_model("lm_tiny", kv_cache_dtype="int8", **kw)
+    m_ref = create_model("lm_tiny", **kw)
+    params = m_ref.init(jax.random.PRNGKey(0), tokens)["params"]
+    full = m_ref.apply({"params": params}, tokens)
+
+    cache = make_cache(m_q, 2, TOTAL)
+    kc = cache["block0"]["attn"]["cached_key"]
+    assert kc.dtype == jnp.int8
+    assert cache["block0"]["attn"]["cached_key_scale"].shape == (2, 1, TOTAL)
+    logits, st = m_q.apply({"params": params, "cache": cache},
+                           tokens[:, :8], decode=True, mutable=["cache"])
+    outs = [logits]
+    for i in range(8, tokens.shape[1]):
+        lg, st = m_q.apply({"params": params, **st},
+                           tokens[:, i:i + 1], decode=True,
+                           mutable=["cache"])
+        outs.append(lg)
+    got = jnp.concatenate(outs, axis=1)
+    rel = float(jnp.max(jnp.abs(got - full))
+                / (jnp.max(jnp.abs(full)) + 1e-9))
+    assert rel < 0.05, rel
