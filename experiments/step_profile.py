@@ -1,0 +1,105 @@
+"""Per-op xprof decomposition of a bench-config train step.
+
+    python experiments/step_profile.py vit_base    # bs=192 headline step
+    python experiments/step_profile.py resnet50    # bs=128 at 224^2
+
+Backs the round-5 BENCHMARKS.md decompositions (ViT-Base headline /
+ResNet-50 accounting).
+"""
+import os
+import shutil
+import sys
+import tempfile
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+CONFIGS = {
+    "vit_base": dict(bsz=192, K=8, shape=(32, 32, 3), num_classes=10),
+    "resnet50": dict(bsz=128, K=4, shape=(224, 224, 3), num_classes=1000),
+}
+
+
+def main(name: str):
+    from ddp_practice_tpu.config import MeshConfig, PrecisionPolicy, TrainConfig
+    from ddp_practice_tpu.models import create_model
+    from ddp_practice_tpu.parallel.mesh import (
+        batch_sharding, build_mesh, replicated, shard_state)
+    from ddp_practice_tpu.parallel.ring import set_current_mesh
+    from ddp_practice_tpu.parallel.sharding_rules import param_sharding_rules
+    from ddp_practice_tpu.train.state import create_state, make_optimizer
+    from ddp_practice_tpu.train.steps import _train_step_fn
+    from ddp_practice_tpu.utils.xprof import op_summary
+
+    cfg = CONFIGS[name]
+    bsz, K, shape, ncls = cfg["bsz"], cfg["K"], cfg["shape"], cfg["num_classes"]
+    mesh = build_mesh(MeshConfig(data=-1))
+    set_current_mesh(mesh)
+    policy = PrecisionPolicy.from_name("bf16")
+    model = create_model(name, policy=policy, num_classes=ncls)
+    tcfg = TrainConfig(model=name, optimizer="adamw", learning_rate=3e-4)
+    tx = make_optimizer(tcfg)
+    sample = jnp.zeros((bsz,) + shape, jnp.float32)
+    abstract = jax.eval_shape(
+        lambda r: create_state(model, tx, rng=r, sample_input=sample),
+        jax.random.PRNGKey(0))
+    shardings = shard_state(abstract, mesh, param_sharding_rules(name))
+    state = jax.jit(
+        lambda r: create_state(model, tx, rng=r, sample_input=sample),
+        out_shardings=shardings)(jax.random.PRNGKey(0))
+
+    step_fn = _train_step_fn(model, tx, label_smoothing=0.0)
+    bsh = batch_sharding(mesh)
+    rep = replicated(mesh)
+    base_key = jax.random.PRNGKey(1)
+
+    def chunk(state):
+        def body(st, key):
+            imgs = jax.random.uniform(key, (bsz,) + shape, jnp.float32)
+            lbls = jax.random.randint(key, (bsz,), 0, ncls, jnp.int32)
+            batch = {
+                "image": lax.with_sharding_constraint(imgs, bsh),
+                "label": lax.with_sharding_constraint(lbls, bsh),
+            }
+            return step_fn(st, batch)
+        keys = jax.random.split(jax.random.fold_in(base_key, state.step), K)
+        state, ms = lax.scan(body, state, keys)
+        return state, jax.tree.map(lambda v: v[-1], ms)
+
+    jchunk = jax.jit(chunk, donate_argnums=0, in_shardings=(shardings,),
+                     out_shardings=(shardings, rep))
+    state, m = jchunk(state)
+    _ = float(m["loss"])
+    state, m = jchunk(state)
+    _ = float(m["loss"])
+
+    tmp = tempfile.mkdtemp(prefix=f"xp_{name}_")
+    with jax.profiler.trace(tmp):
+        state, m = jchunk(state)
+        _ = float(m["loss"])
+    s = op_summary(tmp)
+    total = s["total_ps"] / 1e9 / K
+    print(f"device op time: {total:.3f} ms/step ({K} steps)")
+    cats = sorted(s["categories"].items(), key=lambda kv: -kv[1]["ps"])
+    for cat, v in cats:
+        ms = v["ps"] / 1e9 / K
+        if ms > 0.005:
+            print(f"  {ms:7.3f} ms/step  {cat}  ({v['count']} ops)")
+    for (cat, nm), ps in sorted(s["ops"].items(), key=lambda kv: -kv[1])[:12]:
+        print(f"  {ps/1e9/K:7.3f} ms/step  [{cat}] {nm[:76]}")
+    print("---- copies and loop fusions ----")
+    shown = 0
+    for (cat, nm), ps in sorted(s["ops"].items(), key=lambda kv: -kv[1]):
+        if cat in ("copy-done", "copy", "loop fusion", "data formatting"):
+            print(f"  {ps/1e9/K:7.3f} ms/step  [{cat}] {nm[:76]}")
+            shown += 1
+            if shown > 25:
+                break
+    shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1] if len(sys.argv) > 1 else "vit_base")

@@ -5,6 +5,15 @@ The reference has no tests at all (SURVEY §4); its README checklist
 is the invariant list these tests assert. Distribution is tested without a
 cluster: XLA's host platform is forced to expose 8 devices, so the mesh,
 GSPMD sharding, collectives, and ring attention all run on one CPU.
+
+Two tiers (round 5):
+
+    pytest -m fast      # <60 s: one small config per subsystem — the
+                        # routine pre-commit gate (marker list: pytest.ini)
+    pytest tests/       # everything: interpret-mode Pallas numerics pins,
+                        # e2e fits, real 2-process rendezvous (~20 min on
+                        # this image's single CPU core; the cost is in
+                        # exactly the tests worth keeping)
 """
 
 import os

@@ -30,6 +30,7 @@ def test_deterministic_per_key(devices):
     assert not np.array_equal(a, c)
 
 
+@pytest.mark.fast
 def test_shapes_preserved(devices):
     x = _images(b=4, h=28, w=28, c=1)
     y = random_crop_flip(x, jax.random.PRNGKey(0), pad=4)

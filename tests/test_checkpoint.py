@@ -24,6 +24,7 @@ def _state():
     )
 
 
+@pytest.mark.fast
 def test_roundtrip(tmp_path):
     state = _state()
     d = str(tmp_path / "ck")
@@ -104,6 +105,7 @@ def test_save_is_crash_safe_mid_write(tmp_path, monkeypatch):
                 if p.name.startswith("tmp.")]
 
 
+@pytest.mark.fast
 def test_retention_keeps_last_k(tmp_path):
     state = _state()
     d = str(tmp_path / "ck")

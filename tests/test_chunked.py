@@ -7,6 +7,7 @@ the sub-K epoch tail.
 """
 
 import jax
+import pytest
 import jax.numpy as jnp
 import numpy as np
 
@@ -68,6 +69,7 @@ def test_chunked_matches_sequential(devices):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
 
 
+@pytest.mark.fast
 def test_prefetch_chunked_order_and_tail(devices):
     """10 batches at K=4 -> two chunks (batches 0-3, 4-7) then two singles,
     in order, with values intact."""

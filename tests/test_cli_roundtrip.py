@@ -72,6 +72,7 @@ def test_metrics_file_records_curves(tmp_path, capsys, devices):
     assert records[-1]["accuracy"] == summary["accuracy"]
 
 
+@pytest.mark.fast
 def test_generate_rejects_non_lm_checkpoint(tmp_path, capsys, devices):
     argv = [
         "--model", "convnet", "--dataset", "synthetic",

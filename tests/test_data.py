@@ -24,6 +24,7 @@ def _write_idx(path, arr: np.ndarray):
         f.write(arr.astype(np.uint8).tobytes())
 
 
+@pytest.mark.fast
 def test_idx_roundtrip(tmp_path):
     arr = np.arange(2 * 4 * 3, dtype=np.uint8).reshape(2, 4, 3)
     p = str(tmp_path / "x-idx3-ubyte")
@@ -46,6 +47,7 @@ def test_epoch_indices_deterministic_and_reshuffled():
     np.testing.assert_array_equal(np.sort(a), np.arange(100))  # permutation
 
 
+@pytest.mark.fast
 def test_shards_disjoint_and_cover():
     """Union of per-process batch slices == the full epoch order."""
     n, gbs, nproc = 64, 16, 4

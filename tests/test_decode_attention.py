@@ -37,6 +37,7 @@ def _reference(q, kc, vc, cur, attn_start=None):
 
 
 @pytest.mark.parametrize("L,cur", [(256, 0), (256, 100), (256, 255)])
+@pytest.mark.fast
 def test_single_block_matches_reference(L, cur):
     q, kc, vc, c = _setup(L, cur)
     got = decode_attention_packed(q, kc, vc, c, n_heads=H)

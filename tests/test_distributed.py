@@ -11,6 +11,7 @@ Asserts the invariants of the reference's README checklist (SURVEY §4):
 """
 
 import jax
+import pytest
 import jax.numpy as jnp
 import numpy as np
 
@@ -55,6 +56,7 @@ def _make(mesh_cfg, devices=None):
     return mesh, state, step, ev, bsh
 
 
+@pytest.mark.fast
 def test_dp8_runs_and_replicas_identical(devices):
     mesh, state, step, _, bsh = _make(MeshConfig(data=8))
     batch = {k: jax.device_put(v, bsh) for k, v in _batch(32).items()}

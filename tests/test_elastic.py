@@ -40,6 +40,7 @@ def test_watchdog_grace_before_first_beat():
     assert not fired
 
 
+@pytest.mark.fast
 def test_watchdog_quiet_with_beats():
     fired = []
     wd = StepWatchdog(0.4, on_timeout=lambda s: fired.append(s)).start()
@@ -76,6 +77,7 @@ def test_watchdog_probe_beats_on_resolution():
     assert not fired
 
 
+@pytest.mark.fast
 def test_assert_in_sync_single_process_noop():
     assert_in_sync(12345)  # 1 process: trivially in sync
 

@@ -21,6 +21,7 @@ def _qkv(b=2, s=256, h=2, d=64, seed=0, dtype=jnp.float32):
 
 
 @pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.fast
 def test_flash_matches_dense(causal):
     q, k, v = _qkv()
     want = _attention(q, k, v, causal=causal)

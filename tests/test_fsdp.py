@@ -8,6 +8,7 @@ identical to replicated DP, and (c) FSDP composes with tensor parallelism.
 """
 
 import jax
+import pytest
 import jax.numpy as jnp
 import numpy as np
 
@@ -48,6 +49,7 @@ def _make(mesh_cfg, *, model_name="convnet", rules=None, model_kwargs=None,
     return mesh, state, step, bsh
 
 
+@pytest.mark.fast
 def test_fsdp_leaves_sharded_over_data(devices):
     rules = fsdp_rules(8, None, min_leaf_size=128)
     mesh, state, _, _ = _make(MeshConfig(data=8), rules=rules)

@@ -35,6 +35,7 @@ def variables():
     return _block().init(jax.random.PRNGKey(0), _x(1))
 
 
+@pytest.mark.fast
 def test_forward_matches_unfused(devices, variables):
     x = _x(b=6, seed=1)  # 6 also exercises _fit_tile on a non-pow2 batch
     want = _block().apply(variables, x)
@@ -187,8 +188,11 @@ def test_causality_of_fused_kernel(devices):
 def test_fused_lm_matches_unfused(devices):
     """TransformerLM(fused=True): same logits and grads as the unfused
     model (params are identical — fused is an execution strategy)."""
+    # depth 2 keeps the layer-chaining pin (residual handoff between
+    # fused layers); mlp 128 halves the interpret-mode cost that made
+    # this the suite's slowest test (18s at mlp 256)
     kw = dict(vocab_size=64, max_len=32, hidden_dim=128, depth=2,
-              num_heads=2, mlp_dim=256)
+              num_heads=2, mlp_dim=128)
     lm = create_model("lm_tiny", policy=None, **kw)
     lm_f = create_model("lm_tiny", policy=None, fused=True, **kw)
     toks = jnp.asarray(

@@ -133,6 +133,7 @@ def test_eos_pads_tail(devices, lm):
     )  # ...and the rest is padding
 
 
+@pytest.mark.fast
 def test_sample_logits_filters(devices):
     logits = jnp.asarray([[1.0, 3.0, 2.0, -1.0]])
     key = jax.random.PRNGKey(0)
@@ -146,6 +147,7 @@ def test_sample_logits_filters(devices):
     assert 0 <= int(sample_logits(logits, key, top_p=0.99)[0]) < 4
 
 
+@pytest.mark.fast
 def test_byte_codec_roundtrip(devices):
     s = "hello, TPU\n"
     assert decode_bytes(encode_bytes(s)[0]) == s

@@ -47,6 +47,7 @@ def test_writer_loader_roundtrip(tmp_path):
     np.testing.assert_array_equal(ds.labels, lbls)
 
 
+@pytest.mark.fast
 def test_writer_rejects_wrong_count(tmp_path):
     root = str(tmp_path / "corpus")
     imgs = np.zeros((2, 4, 4, 1), np.uint8)

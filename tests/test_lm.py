@@ -37,6 +37,7 @@ def _tiny_lm(**kw):
     return create_model("lm_tiny", **kw)
 
 
+@pytest.mark.fast
 def test_lm_forward_shapes_and_dtype(devices):
     model = _tiny_lm()
     tokens = jnp.zeros((2, 16), jnp.int32)
@@ -49,6 +50,7 @@ def test_lm_forward_shapes_and_dtype(devices):
     assert logits.dtype == jnp.float32
 
 
+@pytest.mark.fast
 def test_lm_is_causal(devices):
     """Perturbing token t must not change logits at positions < t."""
     model = _tiny_lm()

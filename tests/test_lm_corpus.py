@@ -21,6 +21,7 @@ from ddp_practice_tpu.data.lm_corpus import (
 from ddp_practice_tpu.data.sharding import ShardSpec
 
 
+@pytest.mark.fast
 def test_synthetic_corpus_deterministic():
     a = synthetic_token_corpus(4096, seed=7)
     b = synthetic_token_corpus(4096, seed=7)
@@ -49,6 +50,7 @@ def test_text_corpus_missing_raises(tmp_path):
         load_text_corpus(str(tmp_path / "nope"))
 
 
+@pytest.mark.fast
 def test_loader_windows_disjoint_and_deterministic():
     corpus = synthetic_token_corpus(4096, seed=0)
     loader = LMDataLoader(

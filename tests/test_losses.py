@@ -1,6 +1,7 @@
 """Loss/metric op tests: known-value cross-entropy, weighted counts."""
 
 import jax.numpy as jnp
+import pytest
 import numpy as np
 
 from ddp_practice_tpu.ops import accuracy_counts, cross_entropy
@@ -20,6 +21,7 @@ def test_cross_entropy_confident_correct():
     assert float(cross_entropy(logits, labels)) < 1e-6
 
 
+@pytest.mark.fast
 def test_cross_entropy_weighted_ignores_padding():
     logits = jnp.asarray([[10.0, 0.0], [0.0, 10.0], [-50.0, 50.0]])
     labels = jnp.asarray([0, 1, 0])  # third is "wrong" but weight 0
@@ -27,6 +29,7 @@ def test_cross_entropy_weighted_ignores_padding():
     assert float(cross_entropy(logits, labels, weight=w)) < 1e-3
 
 
+@pytest.mark.fast
 def test_accuracy_counts_weighted():
     logits = jnp.asarray([[1.0, 0.0], [0.0, 1.0], [1.0, 0.0], [1.0, 0.0]])
     labels = jnp.asarray([0, 1, 1, 0])

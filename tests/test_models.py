@@ -6,6 +6,7 @@ The ConvNet contract comes from the reference architecture
 """
 
 import jax
+import pytest
 import jax.numpy as jnp
 import numpy as np
 
@@ -21,6 +22,7 @@ def _init_and_apply(model, x, train=False):
     return variables, model.apply(variables, x, train=train)
 
 
+@pytest.mark.fast
 def test_convnet_shapes_match_reference():
     model = create_model("convnet")
     x = jnp.zeros((2, 28, 28, 1))
@@ -58,6 +60,7 @@ def test_resnet50_forward():
     assert logits.shape == (1, 10)
 
 
+@pytest.mark.fast
 def test_vit_tiny_forward():
     model = create_model("vit_tiny", depth=2)
     x = jnp.zeros((2, 32, 32, 3))

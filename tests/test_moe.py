@@ -20,6 +20,7 @@ from ddp_practice_tpu.parallel.sharding_rules import param_sharding_rules
 from ddp_practice_tpu.train import create_state, make_optimizer, make_train_step
 
 
+@pytest.mark.fast
 def test_gating_capacity_and_normalization():
     rng = np.random.default_rng(0)
     logits = jnp.asarray(rng.normal(size=(2, 16, 4)), jnp.float32)
@@ -478,6 +479,7 @@ def test_expert_choice_perfect_balance_no_state():
     assert float(jnp.linalg.norm(g["router"]["kernel"])) > 0
 
 
+@pytest.mark.fast
 def test_expert_choice_gating_slots_full():
     """Every (expert, slot) pair selects exactly one token — zero
     padding by construction (ops/moe.py expert_choice_gating)."""

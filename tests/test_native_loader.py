@@ -24,6 +24,7 @@ def _ds():
     )
 
 
+@pytest.mark.fast
 def test_native_gather_matches_numpy():
     ds = _ds()
     gather = native_loader.make_gather(ds)

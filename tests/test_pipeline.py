@@ -43,6 +43,7 @@ def _models():
     return piped, seq
 
 
+@pytest.mark.fast
 def test_pipeline_forward_matches_sequential(pipe_mesh):
     piped, seq = _models()
     x = _images()

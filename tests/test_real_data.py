@@ -21,6 +21,7 @@ MINI_MNIST = os.path.join(FIXTURES, "mini_mnist")
 MINI_CIFAR = os.path.join(FIXTURES, "mini_cifar")
 
 
+@pytest.mark.fast
 def test_mini_mnist_loads_as_real_idx():
     from ddp_practice_tpu.data.datasets import load_dataset, load_mnist
 
@@ -52,7 +53,7 @@ def test_mnist_idx_trains_end_to_end():
 
     summary = fit(TrainConfig(
         model="convnet", dataset="mnist", data_dir=MINI_MNIST,
-        epochs=10, batch_size=4, optimizer="adam", learning_rate=1e-3,
+        epochs=4, batch_size=4, optimizer="adam", learning_rate=3e-3,
         log_every_steps=0, compilation_cache="off",
     ))
     assert summary["accuracy"] > 0.5, summary
@@ -64,7 +65,7 @@ def test_cifar_batches_train_end_to_end():
 
     summary = fit(TrainConfig(
         model="convnet", dataset="cifar10", data_dir=MINI_CIFAR,
-        epochs=10, batch_size=5, optimizer="adam", learning_rate=1e-3,
+        epochs=4, batch_size=5, optimizer="adam", learning_rate=3e-3,
         log_every_steps=0, compilation_cache="off",
     ))
     assert summary["accuracy"] > 0.5, summary

@@ -38,6 +38,7 @@ def test_host_placement_keeps_streaming(devices):
     assert tr.resident_train_step is None
 
 
+@pytest.mark.fast
 def test_epoch_plan_matches_iteration(devices):
     """epoch_plan is exactly the order __iter__ walks (same permutation,
     same wrap-padding, same weights)."""

@@ -35,6 +35,7 @@ def _rope_lm(**kw):
     return create_model("lm_tiny", **kw)
 
 
+@pytest.mark.fast
 def test_rope_scores_are_relative(devices):
     """q_i · k_j after rotation depends only on i - j: shifting both
     positions by the same amount leaves the dot product unchanged."""

@@ -7,6 +7,7 @@ pin the numerics to the replicated baseline.
 """
 
 import jax
+import pytest
 import jax.numpy as jnp
 import numpy as np
 
@@ -46,6 +47,7 @@ def _batch(n=8, seed=0):
     }
 
 
+@pytest.mark.fast
 def test_tp_sharding_rules_applied(devices):
     rules = param_sharding_rules("vit_tiny")
     mesh, state, _, _ = _setup(MeshConfig(data=2, tensor=4), rules)

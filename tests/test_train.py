@@ -5,6 +5,7 @@ chance within the epoch budget (origin_main.py reaches 91.55% on MNIST in
 3 epochs; here on the synthetic stand-in dataset we require >90%)."""
 
 import jax
+import pytest
 import jax.numpy as jnp
 import numpy as np
 
@@ -48,6 +49,7 @@ def test_clip_norm_bounds_sgd_update():
     )
 
 
+@pytest.mark.fast
 def test_train_step_decreases_loss():
     model, tx, state = _tiny_setup()
     step = make_train_step(model, tx)

@@ -40,6 +40,7 @@ def _qkv(b=2, s=32, h=8, d=8, seed=0):
 
 
 @pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.fast
 def test_ulysses_matches_dense(seq_mesh, causal):
     q, k, v = _qkv()
     dense = _attention(q, k, v, causal=causal)

@@ -78,6 +78,7 @@ def test_op_summary_roundtrip(tmp_path):
     assert s["ops"][("convolution fusion", "%conv.2")] == 3_000_000
 
 
+@pytest.mark.fast
 def test_directory_discovery_and_missing(tmp_path):
     with pytest.raises(FileNotFoundError):
         op_summary(str(tmp_path))
