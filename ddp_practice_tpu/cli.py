@@ -90,10 +90,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--microbatches", type=int, default=4,
                    help="GPipe microbatches per step (pipe > 1)")
     p.add_argument("--pipe_schedule", default="gpipe",
-                   choices=["gpipe", "1f1b"],
+                   choices=["gpipe", "1f1b", "interleaved"],
                    help="pipeline schedule (pipe > 1): gpipe = autodiff "
-                        "scan, activation memory O(M+P); 1f1b = interleaved "
-                        "backward, O(P) memory (LM models)")
+                        "scan, activation memory O(M+P); 1f1b = one-F-one-B "
+                        "backward, O(P) memory; interleaved = virtual "
+                        "pipeline chunks (Megatron), ~V-fold smaller "
+                        "bubble (LM models)")
+    p.add_argument("--num_virtual", type=int, default=2,
+                   help="virtual pipeline chunks per device (interleaved "
+                        "schedule only; depth must divide pipe*V)")
     p.add_argument("--num_experts", type=int, default=0,
                    help="MoE expert count (0 = auto from --expert axis)")
     p.add_argument("--moe_router", default="topk",
@@ -211,6 +216,7 @@ def config_from_args(args) -> TrainConfig:
         attn_impl=args.attn_impl,
         num_microbatches=args.microbatches,
         pipe_schedule=args.pipe_schedule,
+        num_virtual=args.num_virtual,
         augment=args.augment,
         augment_kind=args.augment_kind,
         fused_encoder=args.fused,

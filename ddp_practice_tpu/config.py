@@ -196,6 +196,8 @@ class TrainConfig:
     # O(M + P)) | "1f1b" (LM only; explicit interleaved backward with an
     # O(P) input stash — parallel/pipeline_1f1b.py)
     pipe_schedule: str = "gpipe"
+    # virtual pipeline chunks per device (interleaved schedule only)
+    num_virtual: int = 2
     # on-device input augmentation (random crop + horizontal flip inside
     # the jitted train step, ops/augment.py); image models only
     augment: bool = False

@@ -118,14 +118,25 @@ class PipelinedLM:
         sp_impl: str = "ring",
         attn_impl: str = "xla",
         schedule: str = "gpipe",
+        # interleaved schedule only: layer chunks per device (virtual
+        # pipeline stages, Megatron-style — parallel/interleave.py)
+        num_virtual: int = 2,
         axis_name: Optional[str] = None,
     ):
-        if depth % max(num_stages, 1) != 0:
-            raise ValueError(f"depth {depth} % stages {num_stages} != 0")
         if pos_emb not in ("learned", "rope"):
             raise ValueError(f"unknown pos_emb {pos_emb!r}")
-        if schedule not in ("gpipe", "1f1b"):
-            raise ValueError(f"unknown schedule {schedule!r} (gpipe|1f1b)")
+        if schedule not in ("gpipe", "1f1b", "interleaved"):
+            raise ValueError(
+                f"unknown schedule {schedule!r} (gpipe|1f1b|interleaved)"
+            )
+        n_logical = (
+            num_stages * num_virtual if schedule == "interleaved"
+            else num_stages
+        )
+        if depth % max(n_logical, 1) != 0:
+            raise ValueError(
+                f"depth {depth} % logical stages {n_logical} != 0"
+            )
         self.vocab_size = vocab_size
         self.max_len = max_len
         self.hidden_dim = hidden_dim
@@ -133,6 +144,7 @@ class PipelinedLM:
         self.num_heads = num_heads
         self.mlp_dim = mlp_dim
         self.num_stages = num_stages
+        self.num_virtual = num_virtual
         self.num_microbatches = num_microbatches
         self.pipe_axis = pipe_axis
         self.remat = remat
@@ -215,6 +227,7 @@ class PipelinedLM:
         )
         from ddp_practice_tpu.parallel.pipeline_1f1b import (
             pipeline_1f1b_loss_and_grad,
+            pipeline_interleaved_loss_and_grad,
         )
 
         M = self.num_microbatches
@@ -262,21 +275,41 @@ class PipelinedLM:
                 aux.update(correct=correct, total=total)
             return loss_sum, aux
 
-        stages = stack_stages(params["blocks"], self.num_stages)
-        loss_sum, aux, stage_grads, head_grads, dxs = (
-            pipeline_1f1b_loss_and_grad(
-                block_fn,
-                head_loss_fn,
-                stages,
-                params["head"],
-                xs,
-                targets.reshape((M, b // M, s)),
-                weight.reshape((M, b // M, s)),
-                num_microbatches=M,
-                compute_dtype=self.dtype,
-                axis_name=self.pipe_axis,
+        if self.schedule == "interleaved":
+            stages = stack_stages(
+                params["blocks"], self.num_stages * self.num_virtual
             )
-        )
+            loss_sum, aux, stage_grads, head_grads, dxs = (
+                pipeline_interleaved_loss_and_grad(
+                    block_fn,
+                    head_loss_fn,
+                    stages,
+                    params["head"],
+                    xs,
+                    targets.reshape((M, b // M, s)),
+                    weight.reshape((M, b // M, s)),
+                    num_microbatches=M,
+                    num_virtual=self.num_virtual,
+                    compute_dtype=self.dtype,
+                    axis_name=self.pipe_axis,
+                )
+            )
+        else:
+            stages = stack_stages(params["blocks"], self.num_stages)
+            loss_sum, aux, stage_grads, head_grads, dxs = (
+                pipeline_1f1b_loss_and_grad(
+                    block_fn,
+                    head_loss_fn,
+                    stages,
+                    params["head"],
+                    xs,
+                    targets.reshape((M, b // M, s)),
+                    weight.reshape((M, b // M, s)),
+                    num_microbatches=M,
+                    compute_dtype=self.dtype,
+                    axis_name=self.pipe_axis,
+                )
+            )
         denom = jnp.maximum(aux["weight"], 1.0)
         loss = loss_sum / denom
         # the schedule differentiates the loss SUM; rescale to mean-loss

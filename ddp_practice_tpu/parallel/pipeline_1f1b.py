@@ -57,6 +57,52 @@ from ddp_practice_tpu.config import MeshConfig
 from ddp_practice_tpu.parallel.ring import get_current_mesh
 
 
+def _head_cond(head_loss_fn, head_params, y_b, tgt, wgt, aux_shape,
+               is_head):
+    """The last-stage head+loss vjp under lax.cond — ONE definition for
+    both schedules (plain 1F1B and interleaved). `is_head` is uniform
+    across a device's tensor/seq shards, so GSPMD collectives inside the
+    taken branch stay lockstep. Returns (loss_sum, aux, dhp, dy)."""
+    f32 = jnp.float32
+
+    def do_head(operands):
+        hp_, y_ = operands
+        loss_sum, h_vjp, aux = jax.vjp(
+            lambda h, yy: head_loss_fn(h, yy, tgt, wgt),
+            hp_, y_, has_aux=True,
+        )
+        dhp, dy = h_vjp(jnp.ones((), loss_sum.dtype))
+        return loss_sum, aux, dhp, dy.astype(f32)
+
+    def skip_head(operands):
+        hp_, y_ = operands
+        return (
+            jnp.zeros((), f32),
+            jax.tree.map(lambda a: jnp.zeros((), f32), aux_shape),
+            jax.tree.map(lambda p: jnp.zeros(p.shape, p.dtype), hp_),
+            jnp.zeros_like(y_),
+        )
+
+    return lax.cond(is_head, do_head, skip_head, (head_params, y_b))
+
+
+def _reduce_outputs(axis_name, dsp_acc, dhp_acc, loss_acc, aux_acc,
+                    dxs_buf):
+    """Final psums shared by both schedule kernels: grads/loss sum over
+    'data'; last-stage-only values replicate over 'pipe' via the
+    masked-psum idiom (accumulators are zero off their producing stage,
+    so a plain psum IS the mask)."""
+    data = MeshConfig.AXIS_DATA
+    loss = lax.psum(loss_acc, (axis_name, data))
+    aux = jax.tree.map(lambda a: lax.psum(a, (axis_name, data)), aux_acc)
+    stage_grads = jax.tree.map(lambda g: lax.psum(g, data)[None], dsp_acc)
+    head_grads = jax.tree.map(
+        lambda g: lax.psum(g, (axis_name, data)), dhp_acc
+    )
+    dxs = lax.psum(dxs_buf, axis_name)
+    return loss, aux, stage_grads, head_grads, dxs
+
+
 def pipeline_1f1b_loss_and_grad(
     block_fn: Callable,
     head_loss_fn: Callable,
@@ -120,6 +166,266 @@ def pipeline_1f1b_loss_and_grad(
     )
     return jax.jit(fn)(
         stage_params, head_params, xs.astype(jnp.float32), targets, weights
+    )
+
+
+def pipeline_interleaved_loss_and_grad(
+    block_fn: Callable,
+    head_loss_fn: Callable,
+    stage_params,
+    head_params,
+    xs: jnp.ndarray,
+    targets: jnp.ndarray,
+    weights: jnp.ndarray,
+    *,
+    num_microbatches: int,
+    num_virtual: int = 2,
+    compute_dtype=jnp.float32,
+    axis_name: str = MeshConfig.AXIS_PIPE,
+    mesh=None,
+):
+    """Interleaved (virtual-stage) 1F1B — Megatron §2.2 on the masked-SPMD
+    scan machinery.
+
+    Same contract as pipeline_1f1b_loss_and_grad, except `stage_params`'
+    leading leaf dim is S = num_virtual * P logical stages (stage
+    s = v*P + i runs as chunk v on device i), and the schedule comes
+    from constant tables (parallel/interleave.py: generated at trace
+    time, dependency-validated by its own tests). Each device executes
+    ONE chunk-op per tick (lax.cond picks the F or B body — `kind` is
+    uniform across a device's tensor/seq shards, so collectives inside
+    the branch stay lockstep); activations and cotangents ride the same
+    single fwd/bwd ppermute pair per tick, with chunk-boundary hops
+    (device P-1 -> 0 forward, 0 -> P-1 backward) carried by the ring
+    wrap and re-keyed by the RECEIVER from the sender's table row. The
+    purchase over plain 1F1B is the bubble: fill/drain ramps cost P
+    ticks per chunk instead of P*V (measured table: P=4, M=8 idle
+    fraction 0.273 -> 0.158 at V=2; BENCHMARKS.md schedule table)."""
+    import numpy as np
+
+    from ddp_practice_tpu.parallel.interleave import build_tables
+
+    mesh = mesh or get_current_mesh()
+    if mesh is None:
+        raise ValueError(
+            "pipeline_interleaved needs a mesh (set_current_mesh)"
+        )
+    P_ = mesh.shape[axis_name]
+    V = num_virtual
+    tables = build_tables(P_, V, num_microbatches)
+    data = MeshConfig.AXIS_DATA
+    mb_spec = P(None, data)
+    # (S, ...) logical-stage params -> (P, V, ...): device i holds chunks
+    # [i, P+i, ...] (stage s = v*P + i)
+    def to_device_major(p):
+        return jnp.swapaxes(
+            p.reshape((V, P_) + p.shape[1:]), 0, 1
+        )
+
+    dev_params = jax.tree.map(to_device_major, stage_params)
+    param_spec = jax.tree.map(lambda _: P(axis_name), dev_params)
+    head_spec = jax.tree.map(lambda _: P(), head_params)
+    fn = jax.shard_map(
+        functools.partial(
+            _interleaved_local,
+            block_fn=block_fn,
+            head_loss_fn=head_loss_fn,
+            num_mb=num_microbatches,
+            num_virtual=V,
+            axis_name=axis_name,
+            compute_dtype=compute_dtype,
+            kind_tab=tables.kind, chunk_tab=tables.chunk,
+            mb_tab=tables.mb,
+        ),
+        mesh=mesh,
+        in_specs=(param_spec, head_spec, mb_spec, mb_spec, mb_spec),
+        out_specs=(P(), P(), param_spec, head_spec, mb_spec),
+        axis_names=frozenset({axis_name, data}),
+        check_vma=False,
+    )
+    loss, aux, dev_grads, head_grads, dxs = jax.jit(fn)(
+        dev_params, head_params, xs.astype(jnp.float32), targets, weights
+    )
+    # back to (S, ...) logical-stage layout
+    def to_stage_major(g):
+        return jnp.swapaxes(g, 0, 1).reshape(
+            (V * P_,) + g.shape[2:]
+        )
+
+    return loss, aux, jax.tree.map(to_stage_major, dev_grads), head_grads, dxs
+
+
+def _interleaved_local(dev_params, head_params, xs, targets, weights, *,
+                       block_fn, head_loss_fn, num_mb, num_virtual,
+                       axis_name, compute_dtype, kind_tab, chunk_tab,
+                       mb_tab):
+    sp = jax.tree.map(lambda p: jnp.squeeze(p, axis=0), dev_params)  # (V,...)
+    n_stages = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    M, V = num_mb, num_virtual
+    mb_shape = xs.shape[1:]
+    T = kind_tab.shape[0]
+    fwd_perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+    bwd_perm = [(i, (i - 1) % n_stages) for i in range(n_stages)]
+    f32 = jnp.float32
+    kind_c = jnp.asarray(kind_tab)    # (T, P) int32 constants
+    chunk_c = jnp.asarray(chunk_tab)
+    mb_c = jnp.asarray(mb_tab)
+
+    def fwd_chunk(sp_, v, x_):
+        """One chunk's blocks, chunk picked by traced v via lax.switch
+        (vjp flows only through the taken branch — off-chunk param
+        grads come out zero, which is exactly the masked accumulate)."""
+        return lax.switch(
+            v,
+            [
+                (lambda xx, vv=vv: block_fn(
+                    jax.tree.map(lambda p: p[vv], sp_),
+                    xx.astype(compute_dtype),
+                ).astype(f32))
+                for vv in range(V)
+            ],
+            x_,
+        )
+
+    aux_shape = jax.eval_shape(
+        lambda hp, y, t, w: head_loss_fn(hp, y, t, w)[1],
+        head_params, jnp.zeros(mb_shape, f32), targets[0], weights[0],
+    )
+
+    def tick(carry, t):
+        (act_buf, dy_buf, stash, dsp_acc, dhp_acc, loss_acc, aux_acc,
+         dxs_buf) = carry
+        krow = lax.dynamic_index_in_dim(kind_c, t, 0, False)   # (P,)
+        crow = lax.dynamic_index_in_dim(chunk_c, t, 0, False)
+        mrow = lax.dynamic_index_in_dim(mb_c, t, 0, False)
+        my_k, my_v, my_m = krow[idx], crow[idx], mrow[idx]
+        # buffers key on the raw microbatch index: interleaved in-flight
+        # counts per (device, chunk) reach M (chunk 0's backwards all run
+        # last), so the plain-1F1B 2P-1 ring would collide — O(M*V)
+        # activation state is the documented Megatron trade for the
+        # V-fold smaller bubble
+        slot = jnp.clip(my_m, 0, M - 1)
+
+        # ---- forward body (kind == 1) ----
+        def do_f(ops):
+            act_buf, stash, *_rest = ops
+            x_in = jnp.where(
+                (my_v == 0) & (idx == 0),
+                lax.dynamic_index_in_dim(
+                    xs, jnp.clip(my_m, 0, M - 1), 0, False
+                ),
+                act_buf[my_v, slot],
+            )
+            y = fwd_chunk(sp, my_v, x_in)
+            stash = stash.at[my_v, slot].set(x_in)
+            return y, stash
+
+        def skip_f(ops):
+            return jnp.zeros(mb_shape, f32), ops[1]
+
+        y_f, stash = lax.cond(my_k == 1, do_f, skip_f, (act_buf, stash))
+        y_hop = lax.ppermute(y_f, axis_name, fwd_perm)
+        # receiver files the arrival under the SENDER's table row
+        prev = (idx - 1) % n_stages
+        sv = crow[prev]
+        recv_v = jnp.where(idx == 0, sv + 1, sv)
+        recv_ok = (krow[prev] == 1) & (recv_v < V)
+        act_buf = jnp.where(
+            recv_ok,
+            act_buf.at[jnp.clip(recv_v, 0, V - 1),
+                       jnp.clip(mrow[prev], 0, M - 1)].set(y_hop),
+            act_buf,
+        )
+
+        # ---- backward body (kind == 2) ----
+        def do_b(ops):
+            dy_buf_, stash_ = ops
+            x_b = stash_[my_v, slot]
+            y_b, blocks_vjp = jax.vjp(
+                lambda p_, x_: fwd_chunk(p_, my_v, x_), sp, x_b
+            )
+            tgt = lax.dynamic_index_in_dim(
+                targets, jnp.clip(my_m, 0, M - 1), 0, False
+            )
+            wgt = lax.dynamic_index_in_dim(
+                weights, jnp.clip(my_m, 0, M - 1), 0, False
+            )
+            is_head = (idx == n_stages - 1) & (my_v == V - 1)
+            loss_m, aux_m, dhp_m, dy_head = _head_cond(
+                head_loss_fn, head_params, y_b, tgt, wgt, aux_shape,
+                is_head,
+            )
+            dy_ct = jnp.where(is_head, dy_head, dy_buf_[my_v, slot])
+            dsp_m, dx_m = blocks_vjp(dy_ct)
+            # f32 so both cond branches agree regardless of param dtype
+            dsp_m = jax.tree.map(lambda g: g.astype(f32), dsp_m)
+            return loss_m, aux_m, dhp_m, dsp_m, dx_m.astype(f32), is_head
+
+        def skip_b(ops):
+            return (
+                jnp.zeros((), f32),
+                jax.tree.map(lambda a: jnp.zeros((), f32), aux_shape),
+                jax.tree.map(
+                    lambda p: jnp.zeros(p.shape, p.dtype), head_params
+                ),
+                jax.tree.map(lambda p: jnp.zeros(p.shape, f32), sp),
+                jnp.zeros(mb_shape, f32),
+                jnp.asarray(False),
+            )
+
+        b_on = my_k == 2
+        loss_m, aux_m, dhp_m, dsp_m, dx_m, is_head = lax.cond(
+            b_on, do_b, skip_b, (dy_buf, stash)
+        )
+        bmask = b_on.astype(f32)
+        dsp_acc = jax.tree.map(
+            lambda a, gr: a + gr.astype(f32) * bmask, dsp_acc, dsp_m
+        )
+        dhp_acc = jax.tree.map(
+            lambda a, gr: a + gr.astype(f32) * bmask, dhp_acc, dhp_m
+        )
+        emit = b_on & is_head
+        loss_acc = loss_acc + jnp.where(emit, loss_m, 0.0)
+        aux_acc = jax.tree.map(
+            lambda a, v_: a + jnp.where(emit, v_.astype(f32), 0.0),
+            aux_acc, aux_m,
+        )
+        dxs_buf = jnp.where(
+            b_on & (idx == 0) & (my_v == 0),
+            lax.dynamic_update_index_in_dim(
+                dxs_buf, dx_m.astype(f32), jnp.clip(my_m, 0, M - 1), 0
+            ),
+            dxs_buf,
+        )
+        dx_hop = lax.ppermute(dx_m, axis_name, bwd_perm)
+        nxt = (idx + 1) % n_stages
+        rv = crow[nxt]
+        recv_bv = jnp.where(idx == n_stages - 1, rv - 1, rv)
+        recv_ok_b = (krow[nxt] == 2) & (recv_bv >= 0)
+        dy_buf = jnp.where(
+            recv_ok_b,
+            dy_buf.at[jnp.clip(recv_bv, 0, V - 1),
+                      jnp.clip(mrow[nxt], 0, M - 1)].set(dx_hop),
+            dy_buf,
+        )
+        return (act_buf, dy_buf, stash, dsp_acc, dhp_acc, loss_acc,
+                aux_acc, dxs_buf), None
+
+    carry = (
+        jnp.zeros((V, M) + mb_shape, f32),            # act inbox
+        jnp.zeros((V, M) + mb_shape, f32),            # dy inbox
+        jnp.zeros((V, M) + mb_shape, f32),            # stash
+        jax.tree.map(lambda p: jnp.zeros(p.shape, f32), sp),
+        jax.tree.map(lambda p: jnp.zeros(p.shape, f32), head_params),
+        jnp.zeros((), f32),
+        jax.tree.map(lambda a: jnp.zeros((), f32), aux_shape),
+        jnp.zeros((M,) + mb_shape, f32),
+    )
+    carry, _ = lax.scan(tick, carry, jnp.arange(T))
+    (_, _, _, dsp_acc, dhp_acc, loss_acc, aux_acc, dxs_buf) = carry
+    return _reduce_outputs(
+        axis_name, dsp_acc, dhp_acc, loss_acc, aux_acc, dxs_buf
     )
 
 
@@ -209,29 +515,9 @@ def _1f1b_local(stage_params, head_params, xs, targets, weights, *,
             wgt = lax.dynamic_index_in_dim(weights, bm_c, 0, False)
 
             y_b, blocks_vjp = jax.vjp(fwd, sp, x_b)
-
-            def do_head(operands):
-                hp_, y_ = operands
-                loss_sum, h_vjp, aux = jax.vjp(
-                    lambda h, yy: head_loss_fn(h, yy, tgt, wgt),
-                    hp_, y_, has_aux=True,
-                )
-                dhp, dy = h_vjp(jnp.ones((), loss_sum.dtype))
-                return loss_sum, aux, dhp, dy.astype(f32)
-
-            def skip_head(operands):
-                hp_, y_ = operands
-                return (
-                    jnp.zeros((), f32),
-                    jax.tree.map(lambda a: jnp.zeros((), f32), aux_shape),
-                    jax.tree.map(
-                        lambda p: jnp.zeros(p.shape, p.dtype), hp_
-                    ),
-                    jnp.zeros_like(y_),
-                )
-
-            loss_m, aux_m, dhp_m, dy_head = lax.cond(
-                is_last, do_head, skip_head, (head_params, y_b)
+            loss_m, aux_m, dhp_m, dy_head = _head_cond(
+                head_loss_fn, head_params, y_b, tgt, wgt, aux_shape,
+                is_last,
             )
             zero_f = jnp.asarray(0.0, f32)
             dy_ct = jnp.where(is_last, dy_head, dy_in)
@@ -294,19 +580,6 @@ def _1f1b_local(stage_params, head_params, xs, targets, weights, *,
     carry, _ = lax.scan(make_tick(False, True), carry,
                         jnp.arange(steady_end, T))
     (_, _, _, dsp_acc, dhp_acc, loss_acc, aux_acc, dxs_buf) = carry
-
-    data = MeshConfig.AXIS_DATA
-    # reductions: grads/loss sum over 'data'; last-stage-only values
-    # (loss, aux counts, dxs-at-stage-0, head grads) replicate over
-    # 'pipe' via the masked-psum idiom (the accumulators are already zero
-    # off their producing stage, so a plain psum IS the mask)
-    loss = lax.psum(loss_acc, (axis_name, data))
-    aux = jax.tree.map(lambda a: lax.psum(a, (axis_name, data)), aux_acc)
-    stage_grads = jax.tree.map(
-        lambda g: lax.psum(g, data)[None], dsp_acc
+    return _reduce_outputs(
+        axis_name, dsp_acc, dhp_acc, loss_acc, aux_acc, dxs_buf
     )
-    head_grads = jax.tree.map(
-        lambda g: lax.psum(g, (axis_name, data)), dhp_acc
-    )
-    dxs = lax.psum(dxs_buf, axis_name)
-    return loss, aux, stage_grads, head_grads, dxs

@@ -211,6 +211,8 @@ class Trainer:
             model_kwargs["num_microbatches"] = config.num_microbatches
             if config.pipe_schedule != "gpipe":
                 model_kwargs["schedule"] = config.pipe_schedule
+            if config.pipe_schedule == "interleaved":
+                model_kwargs["num_virtual"] = config.num_virtual
             # tensor parallelism composes: the pipeline shard_map is manual
             # over 'pipe'/'data' only, so the _vit_pipe_rule tensor specs
             # ride GSPMD inside each stage (parallel/pipeline.py)

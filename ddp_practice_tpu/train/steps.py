@@ -284,7 +284,7 @@ def _lm_train_step_fn(model, tx, label_smoothing: float = 0.0, seed: int = 0,
             loss = loss + _sown_aux_loss(inter)
             return loss, (logits, new_stats, inter)
 
-        if getattr(model, "schedule", None) == "1f1b":
+        if getattr(model, "schedule", None) in ("1f1b", "interleaved"):
             # memory-bounded pipeline: the model runs its own fwd+bwd
             # interleaving (parallel/pipeline_1f1b.py) — autodiff of the
             # forward would force the GPipe all-F-then-all-B order. The
