@@ -771,3 +771,17 @@ def bench_pipeline(
                         1,
                     )
     return results
+
+
+def bench_serve(**kwargs) -> dict:
+    """Continuous-batching vs static-batch serving on one Poisson trace.
+
+    Delegates to serve/bench.py serve_bench (the serving subsystem owns
+    its methodology — see that module's docstring); registered here so
+    the benchmark surface stays one import. Returns the report dict with
+    per-mode tokens/sec and TTFT/latency percentiles plus the
+    continuous/static throughput ratio (BENCHMARKS.md serving section).
+    """
+    from ddp_practice_tpu.serve.bench import serve_bench
+
+    return serve_bench(**kwargs)

@@ -15,6 +15,8 @@ Examples:
   python -m ddp_practice_tpu.cli --precision bf16     # the "AMP" variant
   python -m ddp_practice_tpu.cli --model vit_tiny --dataset cifar10 \\
       --tensor 2 --optimizer adamw --lr 1e-3
+  python -m ddp_practice_tpu.cli serve                # continuous-batching
+                                                      # serve bench (serve/)
 """
 
 from __future__ import annotations
@@ -22,6 +24,7 @@ from __future__ import annotations
 import argparse
 import dataclasses
 import json
+import sys
 import time
 
 from ddp_practice_tpu.config import MeshConfig, TrainConfig
@@ -246,16 +249,37 @@ def config_from_args(args) -> TrainConfig:
 
 
 def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if argv and argv[0] == "serve":
+        # inference subcommand: the training flags below don't apply, so
+        # dispatch before the trainer parser sees the argv (serve/bench.py
+        # owns the serve flag surface)
+        from ddp_practice_tpu.serve.bench import main as serve_main
+
+        return serve_main(argv[1:])
     args = build_parser().parse_args(argv)
     if args.devices:
         import os
 
         os.environ.setdefault("JAX_NUM_CPU_DEVICES", str(args.devices))
     if args.cpu:
+        import os
+
         import jax
 
         jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", args.cpu)
+        try:
+            jax.config.update("jax_num_cpu_devices", args.cpu)
+        except AttributeError:
+            # older jax: the option doesn't exist; the XLA flag works as
+            # long as jax hasn't initialized its backends yet (it hasn't —
+            # the train loop import below is the first device touch)
+            flags = os.environ.get("XLA_FLAGS", "")
+            if "xla_force_host_platform_device_count" not in flags:
+                os.environ["XLA_FLAGS"] = (
+                    flags
+                    + f" --xla_force_host_platform_device_count={args.cpu}"
+                ).strip()
     from ddp_practice_tpu.train.loop import fit  # deferred: jax import cost
 
     t0 = time.time()

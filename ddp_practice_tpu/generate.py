@@ -55,13 +55,16 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
-def load_lm(args) -> tuple:
-    """(model, params) rebuilt from the checkpoint manifest + leaves."""
-    manifest = ckpt.latest_manifest(args.ckpt_dir)
+def load_lm(ckpt_dir, *, model=None, seq_len=0, kv_cache="policy") -> tuple:
+    """(model, params, batch_stats, step) rebuilt from the checkpoint
+    manifest + leaves — shared by this CLI and the serving entry point
+    (serve/bench.py), which is why it takes plain kwargs rather than the
+    parsed argparse namespace."""
+    manifest = ckpt.latest_manifest(ckpt_dir)
     if manifest is None:
-        raise SystemExit(f"no checkpoint under {args.ckpt_dir!r}")
+        raise SystemExit(f"no checkpoint under {ckpt_dir!r}")
     extra = manifest.get("extra", {})
-    name = args.model or extra.get("model")
+    name = model or extra.get("model")
     if not name or not name.startswith("lm_"):
         raise SystemExit(
             f"checkpoint model {name!r} is not an LM (lm_*) — generation "
@@ -72,7 +75,7 @@ def load_lm(args) -> tuple:
             "lm_pipe has no KV-cache decode path — generate from an "
             "equivalent lm_tiny/lm_base checkpoint instead"
         )
-    seq_len = args.seq_len or int(extra.get("seq_len", 2048))
+    seq_len = seq_len or int(extra.get("seq_len", 2048))
     vocab = int(extra.get("vocab_size", 256))
     policy = (
         PrecisionPolicy.bf16()
@@ -80,7 +83,7 @@ def load_lm(args) -> tuple:
         else PrecisionPolicy.fp32()
     )
     model_kw = {}
-    if getattr(args, "kv_cache", "policy") == "int8":
+    if kv_cache == "int8":
         model_kw["kv_cache_dtype"] = "int8"
     model = create_model(
         name, policy=policy, vocab_size=vocab, max_len=seq_len,
@@ -105,7 +108,7 @@ def load_lm(args) -> tuple:
         lambda r: create_state(model, tx, rng=r, sample_input=sample),
         jax.random.PRNGKey(0),
     )
-    state = ckpt.restore(args.ckpt_dir, abstract)
+    state = ckpt.restore(ckpt_dir, abstract)
     params = state.params
     if extra.get("precision_policy") == "bf16":
         # inference needs no fp32 masters: stream bf16 params (half the
@@ -119,7 +122,10 @@ def load_lm(args) -> tuple:
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
-    model, params, batch_stats, step = load_lm(args)
+    model, params, batch_stats, step = load_lm(
+        args.ckpt_dir, model=args.model, seq_len=args.seq_len,
+        kv_cache=args.kv_cache,
+    )
     prompt = jnp.asarray(encode_bytes(args.prompt))
     gen = jax.jit(
         make_generate_fn(

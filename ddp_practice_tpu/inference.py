@@ -71,6 +71,38 @@ def make_cache(model, batch: int, total_len: int) -> Any:
     return jax.tree.map(lambda a: jnp.zeros(a.shape, a.dtype), shapes)
 
 
+def decode_apply(
+    model,
+    params,
+    cache: Any,
+    tokens: jnp.ndarray,
+    *,
+    attn_start=None,
+    batch_stats: Any = None,
+) -> tuple:
+    """One decode-mode model application: `(new_cache, logits)`.
+
+    The single primitive both inference paths are built from — a prompt
+    prefill is `decode_apply` with `tokens` spanning the prompt, a decode
+    step is `decode_apply` with one token per sequence — so the one-shot
+    generator below and the continuous-batching engine (serve/engine.py)
+    share the exact apply (and therefore the exact logits): the cache
+    collection threads through functionally, the write cursor advances by
+    `tokens.shape[1]`, and `attn_start` masks left padding per sequence.
+    """
+    variables = {"params": params, "cache": cache}
+    if batch_stats is not None:
+        variables["batch_stats"] = batch_stats
+    logits, mut = model.apply(
+        variables,
+        tokens,
+        decode=True,
+        mutable=["cache"],
+        attn_start=attn_start,
+    )
+    return mut["cache"], logits
+
+
 def sample_logits(
     logits: jnp.ndarray,
     key: Optional[jax.Array],
@@ -164,16 +196,9 @@ def make_generate_fn(
                 jnp.asarray(prompt_lens, jnp.int32), 1, prompt_len
             )
             attn_start = (prompt_len - lens).astype(jnp.int32)
-        cache = make_cache(model, b, total)
-        variables = {"params": params, "cache": cache}
-        if batch_stats is not None:
-            variables["batch_stats"] = batch_stats
-        logits, mut = model.apply(
-            variables,
-            prompt,
-            decode=True,
-            mutable=["cache"],
-            attn_start=attn_start,
+        cache, logits = decode_apply(
+            model, params, make_cache(model, b, total), prompt,
+            attn_start=attn_start, batch_stats=batch_stats,
         )
         carry_key = key if key is not None else jax.random.PRNGKey(0)
         done = jnp.zeros((b,), bool)
@@ -188,21 +213,15 @@ def make_generate_fn(
             tok = jnp.where(done, jnp.asarray(pad_id, jnp.int32), tok)
             if eos_id is not None:
                 done = done | (tok == eos_id)
-            step_vars = {"params": params, "cache": cache}
-            if batch_stats is not None:
-                step_vars["batch_stats"] = batch_stats
-            logits, mut = model.apply(
-                step_vars,
-                tok[:, None],
-                decode=True,
-                mutable=["cache"],
-                attn_start=attn_start,
+            cache, logits = decode_apply(
+                model, params, cache, tok[:, None],
+                attn_start=attn_start, batch_stats=batch_stats,
             )
-            return (mut["cache"], logits[:, -1], k, done), tok
+            return (cache, logits[:, -1], k, done), tok
 
         (_, _, _, _), toks = lax.scan(
             step,
-            (mut["cache"], logits[:, -1], carry_key, done),
+            (cache, logits[:, -1], carry_key, done),
             None,
             length=max_new_tokens,
         )

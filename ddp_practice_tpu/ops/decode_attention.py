@@ -50,6 +50,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
+from ddp_practice_tpu.ops.pallas_compat import tpu_compiler_params
 
 from ddp_practice_tpu.ops.flash_attention import (
     _LANES,
@@ -234,7 +235,7 @@ def decode_attention_packed(
         if has_start else jnp.zeros((b,), jnp.int32)
     )
     interpret = jax.default_backend() == "cpu"
-    sem = pltpu.CompilerParams
+    sem = tpu_compiler_params
 
     if quant and L > single_block_max:
         # long-cache int8 falls back to a dequantized pass through the

@@ -69,6 +69,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
+from ddp_practice_tpu.ops.pallas_compat import tpu_compiler_params
 
 _NEG_INF = -1e30
 _LANES = 128
@@ -260,7 +261,7 @@ def _flash_fwd(q, k, v, *, causal, block_q, block_k, interpret):
             pltpu.VMEM((block_q, _LANES), jnp.float32),
             pltpu.VMEM((block_q, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")
         ),
         interpret=interpret,
@@ -408,7 +409,7 @@ def _flash_bwd(q, k, v, do, lse, delta, *, causal, block_q, block_k,
             pltpu.VMEM((block_k, d), jnp.float32),
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")
         ),
         interpret=interpret,
@@ -433,7 +434,7 @@ def _flash_bwd(q, k, v, do, lse, delta, *, causal, block_q, block_k,
         out_specs=pl.BlockSpec((None, block_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")
         ),
         interpret=interpret,
@@ -590,7 +591,7 @@ def _flash_fwd_packed(qf, kf, vf, *, n_heads, causal, block_q, block_k,
             pltpu.VMEM((hpc, block_q, _LANES), jnp.float32),
             pltpu.VMEM((block_q, w), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")
         ),
@@ -779,7 +780,7 @@ def _flash_bwd_packed(qf, kf, vf, do, out, lse_pk, *, n_heads, causal,
             pltpu.VMEM((block_k, w), jnp.float32),
             pltpu.VMEM((block_k, w), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")
         ),
@@ -816,7 +817,7 @@ def _flash_bwd_packed(qf, kf, vf, do, out, lse_pk, *, n_heads, causal,
             pltpu.VMEM((block_q, w), jnp.float32),
             pltpu.VMEM((block_q, hpc), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")
         ),

@@ -42,6 +42,7 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from ddp_practice_tpu.ops.pallas_compat import tpu_compiler_params
 
 _LN_EPS = 1e-6  # flax.linen.LayerNorm default
 
@@ -379,7 +380,7 @@ def _vmem_params(interpret):
             f"DDP_TPU_FUSED_VMEM_MB={raw!r}: want a positive integer "
             "(MB of scoped VMEM to declare for the fused encoder kernels)"
         ) from None
-    return pltpu.CompilerParams(vmem_limit_bytes=mb * 1024 * 1024)
+    return tpu_compiler_params(vmem_limit_bytes=mb * 1024 * 1024)
 
 
 def _fit_tile(n, tile):

@@ -1,0 +1,20 @@
+"""Pallas TPU API compatibility: CompilerParams naming across jax versions.
+
+Current jax spells it `pltpu.CompilerParams`; the older jax this image
+may ship only has `pltpu.TPUCompilerParams` (same fields — the kernels
+here use `dimension_semantics` and `vmem_limit_bytes`, both present in
+either). Kernel modules call this factory instead of naming the class,
+so the version split lives in one place (mirrors parallel/compat.py for
+shard_map).
+"""
+
+from __future__ import annotations
+
+
+def tpu_compiler_params(**kwargs):
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None)
+    if cls is None:
+        cls = pltpu.TPUCompilerParams
+    return cls(**kwargs)

@@ -1,0 +1,29 @@
+"""jax API compatibility: shard_map across jax versions.
+
+`jax.shard_map` (with the `check_vma` kwarg) is the stable spelling on
+current jax; the image this repo targets may ship an older jax where it
+only exists as `jax.experimental.shard_map.shard_map` (kwarg
+`check_rep`). Every internal call site imports the symbol from here so
+the version split lives in exactly one place.
+"""
+
+from __future__ import annotations
+
+import jax
+
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:  # pre-stable jax: experimental module, check_rep/auto spellings
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True,
+                  axis_names=None):
+        # new API names the MANUAL axes (axis_names, default all); the
+        # old API names the complement (auto = axes left to GSPMD)
+        kw = {}
+        if axis_names is not None:
+            kw["auto"] = frozenset(mesh.axis_names) - frozenset(axis_names)
+        return _shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=check_vma, **kw,
+        )

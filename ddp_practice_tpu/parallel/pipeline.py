@@ -33,6 +33,7 @@ from jax.sharding import PartitionSpec as P
 
 from ddp_practice_tpu.config import MeshConfig
 from ddp_practice_tpu.parallel.ring import get_current_mesh
+from ddp_practice_tpu.parallel.compat import shard_map
 
 
 def pipeline_apply(
@@ -65,7 +66,7 @@ def pipeline_apply(
     # tensor-parallel parameter shardings (sharding_rules._vit_pipe_rule)
     # propagate into the per-stage matmuls and XLA inserts the Megatron
     # all-reduces over 'tensor' there — TP x PP without hand collectives
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(
             _pipeline_local,
             block_fn=block_fn,

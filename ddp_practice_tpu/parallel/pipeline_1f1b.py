@@ -55,6 +55,7 @@ from jax.sharding import PartitionSpec as P
 
 from ddp_practice_tpu.config import MeshConfig
 from ddp_practice_tpu.parallel.ring import get_current_mesh
+from ddp_practice_tpu.parallel.compat import shard_map
 
 
 def _head_cond(head_loss_fn, head_params, y_b, tgt, wgt, aux_shape,
@@ -149,7 +150,7 @@ def pipeline_1f1b_loss_and_grad(
     mb_spec = P(None, data)  # microbatch dim replicated, batch over 'data'
     param_spec = jax.tree.map(lambda _: P(axis_name), stage_params)
     head_spec = jax.tree.map(lambda _: P(), head_params)
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(
             _1f1b_local,
             block_fn=block_fn,
@@ -225,7 +226,7 @@ def pipeline_interleaved_loss_and_grad(
     dev_params = jax.tree.map(to_device_major, stage_params)
     param_spec = jax.tree.map(lambda _: P(axis_name), dev_params)
     head_spec = jax.tree.map(lambda _: P(), head_params)
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(
             _interleaved_local,
             block_fn=block_fn,

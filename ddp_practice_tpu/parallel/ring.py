@@ -25,6 +25,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ddp_practice_tpu.config import MeshConfig
+from ddp_practice_tpu.parallel.compat import shard_map
 
 _NEG_INF = -1e30
 
@@ -100,7 +101,7 @@ def ring_attention(
     # batch over data, sequence over the ring axis, heads stay sharded over
     # tensor (heads are independent in attention, so TP composes with SP)
     mesh, spec = _island_mesh_and_spec(mesh, axis_name)
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(
             _ring_attention_local, axis_name=axis_name, causal=causal,
             impl=impl,

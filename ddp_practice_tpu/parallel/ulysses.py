@@ -25,6 +25,7 @@ import functools
 import jax
 from jax import lax
 
+from ddp_practice_tpu.parallel.compat import shard_map
 from ddp_practice_tpu.parallel.ring import (
     _axis_bound,
     _island_mesh_and_spec,
@@ -50,7 +51,7 @@ def ulysses_attention(q, k, v, *, axis_name: str, causal: bool = False,
             "(set via parallel.ring.set_current_mesh)"
         )
     mesh, spec = _island_mesh_and_spec(mesh, axis_name)
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(
             _ulysses_local, axis_name=axis_name, causal=causal, impl=impl
         ),

@@ -1,0 +1,42 @@
+"""serve/: TPU-native continuous-batching inference engine.
+
+Layers (each its own module, composable and separately testable):
+
+- kv_slots.py  — slot-based KV-cache pool: fixed `(max_slots, max_len)`
+  cache, left-aligned admission at a shared write cursor, whole-row
+  scatter on admit, free-list slot reuse;
+- engine.py    — SlotEngine: bucketed jitted prefill-admit + one jitted
+  batched decode step; static shapes, so batch composition churns with
+  zero recompiles;
+- scheduler.py — FIFO queue, admission control (bounded queue sheds),
+  per-request deadlines, EOS/length release, injectable clock
+  (FakeClock for deterministic CPU tests);
+- metrics.py   — TTFT/TPOT/queue-depth/occupancy/tokens-per-sec over the
+  utils metrics registry, emitted through the process-0 gate;
+- bench.py     — serve_bench: one Poisson trace through the continuous
+  engine and the static-batch baseline (BENCHMARKS.md records the
+  curves); also the `cli.py serve` entry point.
+"""
+
+from ddp_practice_tpu.serve.engine import EngineConfig, SlotEngine
+from ddp_practice_tpu.serve.kv_slots import SlotAllocator
+from ddp_practice_tpu.serve.metrics import ServeMetrics
+from ddp_practice_tpu.serve.scheduler import (
+    Completion,
+    FakeClock,
+    MonotonicClock,
+    Request,
+    Scheduler,
+)
+
+__all__ = [
+    "Completion",
+    "EngineConfig",
+    "FakeClock",
+    "MonotonicClock",
+    "Request",
+    "Scheduler",
+    "ServeMetrics",
+    "SlotAllocator",
+    "SlotEngine",
+]

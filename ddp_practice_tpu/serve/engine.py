@@ -1,0 +1,307 @@
+"""Continuous-batching engine core: two jitted programs, zero recompiles.
+
+The one-shot path (inference.make_generate_fn) compiles prefill + a
+`lax.scan` of decode steps into ONE program per (batch, prompt_len,
+max_new_tokens) triple — a new request shape means a new XLA program,
+and nothing can join until the scan returns. This engine splits the
+same `decode_apply` primitive into two separately-jitted functions with
+STATIC shapes, so batch composition can churn at token granularity:
+
+- `prefill+admit` (one compile per prompt bucket width): run the new
+  request's prompt through a batch-1 scratch cache positioned to end at
+  the pool cursor, then scatter the scratch rows + next-token logits
+  into the pool at the slot index (kv_slots.write_slot);
+- `decode step` (one compile, ever): sample one token per slot from the
+  carried last-logits, apply the model batch-wide at s=1, return new
+  logits/tokens. Free slots ride along emitting pad tokens — their rows
+  are garbage by construction and invisible by masking. A lax.scan runs
+  `decode_burst` such steps per dispatch (multi-step scheduling) so the
+  constant host/dispatch cost amortizes over K tokens; releases become
+  burst-granular, the tokens do not change (pinned in
+  tests/test_serve_engine.py).
+
+Prompts are LEFT-padded into a small set of bucket widths
+(EngineConfig.prompt_buckets), so the prefill jit cache is bounded by
+the bucket count however many distinct prompt lengths arrive — the
+"no recompilation churn" property the scheduler tests pin via
+`compile_stats()`.
+
+Sampling is per-slot (each request carries its own fold_in'd PRNG
+chain), so a request's tokens do not depend on what else shares the
+batch — the property that makes continuous batching transparent to
+clients. Greedy decode is bit-identical to the one-shot generator
+(tests/test_serve_equivalence.py) because both paths run the same
+`decode_apply` and the same `sample_logits`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ddp_practice_tpu.inference import decode_apply, make_cache, sample_logits
+from ddp_practice_tpu.serve.kv_slots import (
+    SlotAllocator,
+    set_cursor,
+    write_slot,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineConfig:
+    """Compile-time serving knobs (all closed over by the jitted fns)."""
+
+    max_slots: int = 4
+    # pool positions per slot; 0 = the model's max_len
+    max_len: int = 0
+    # LEFT-pad prompt widths for the bucketed prefill compile cache; the
+    # largest bucket is also the base cursor (admission always has room
+    # to place a full-width prompt behind the cursor)
+    prompt_buckets: Tuple[int, ...] = (8, 16, 32, 64)
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 0.0
+    eos_id: Optional[int] = None
+    pad_id: int = 0
+    # decode steps per dispatch (multi-step scheduling): a lax.scan of K
+    # single-token steps amortizes the per-dispatch host overhead K-fold
+    # at the cost of slot-release granularity — a request finishing
+    # mid-burst holds its slot (and the scheduler discards its surplus
+    # tokens) until the burst boundary, E[K/2] wasted slot-steps per
+    # request vs the static baseline's E[max - asked]. K=1 is exact
+    # token-granularity scheduling (the deterministic-test setting).
+    decode_burst: int = 1
+
+
+class SlotEngine:
+    """Slot-granular admission + batched single-token decode.
+
+    Pure mechanism: WHAT to admit/release and WHEN is the scheduler's
+    job (serve/scheduler.py); this class owns the device state (cache
+    pool, last-logits, attention starts, per-slot PRNG keys) and the two
+    jitted programs. All host<->device traffic per step is one token
+    vector readback.
+    """
+
+    def __init__(self, model, params, config: EngineConfig = EngineConfig(),
+                 *, batch_stats: Any = None) -> None:
+        if getattr(model, "pos_emb", None) != "rope":
+            raise ValueError(
+                "SlotEngine needs pos_emb='rope' — slot admission "
+                "left-aligns prompts at arbitrary cache offsets, which "
+                "only relative positions survive (models/lm.py attn_start)"
+            )
+        if not config.prompt_buckets:
+            raise ValueError("prompt_buckets must be non-empty")
+        self.model = model
+        self.params = params
+        self.batch_stats = batch_stats
+        self.config = config
+        self.max_len = config.max_len or model.max_len
+        self.buckets = tuple(sorted(set(config.prompt_buckets)))
+        self.base_cursor = self.buckets[-1]
+        if self.base_cursor >= self.max_len:
+            raise ValueError(
+                f"largest prompt bucket {self.base_cursor} leaves no decode "
+                f"headroom in max_len {self.max_len}"
+            )
+        s = config.max_slots
+        self.allocator = SlotAllocator(s)
+        self.cursor = self.base_cursor  # host mirror of the device cursor
+        self._cache = set_cursor(
+            make_cache(model, s, self.max_len), self.base_cursor
+        )
+        self._last_logits = jnp.zeros((s, model.vocab_size), model.dtype)
+        self._attn_starts = jnp.zeros((s,), jnp.int32)
+        self._keys = jnp.zeros((s, 2), jnp.uint32)
+        self._active = np.zeros((s,), bool)
+        if config.decode_burst < 1:
+            raise ValueError("decode_burst must be >= 1")
+        self._prefill_jit = jax.jit(self._prefill_admit)
+        self._decode_jit = jax.jit(self._decode_burst)
+
+    # ---------------------------------------------------------------- jitted
+    def _prefill_admit(self, params, pool, last_logits, attn_starts,
+                       tokens, start, attn_start, slot):
+        """tokens (1, w) left-padded; start = cursor - w; one compile per w."""
+        scratch = set_cursor(make_cache(self.model, 1, self.max_len), start)
+        scratch, logits = decode_apply(
+            self.model, params, scratch, tokens,
+            attn_start=attn_start[None], batch_stats=self.batch_stats,
+        )
+        pool = write_slot(pool, scratch, slot)
+        last_logits = lax.dynamic_update_slice(
+            last_logits, logits[:, -1].astype(last_logits.dtype), (slot, 0)
+        )
+        attn_starts = lax.dynamic_update_slice(
+            attn_starts, attn_start[None], (slot,)
+        )
+        return pool, last_logits, attn_starts
+
+    def _decode_body(self, params, pool, last_logits, attn_starts,
+                     active, keys):
+        cfg = self.config
+        if cfg.temperature == 0.0:
+            toks = sample_logits(last_logits, None, temperature=0.0)
+            new_keys = keys
+        else:
+            split = jax.vmap(lambda k: jax.random.split(k, 2))(keys)
+            subs, new_keys = split[:, 0], split[:, 1]
+            toks = jax.vmap(
+                lambda lg, k: sample_logits(
+                    lg[None], k, temperature=cfg.temperature,
+                    top_k=cfg.top_k, top_p=cfg.top_p,
+                )[0]
+            )(last_logits, subs)
+        toks = jnp.where(
+            active, toks.astype(jnp.int32), jnp.int32(cfg.pad_id)
+        )
+        pool, logits = decode_apply(
+            self.model, params, pool, toks[:, None],
+            attn_start=attn_starts, batch_stats=self.batch_stats,
+        )
+        return pool, logits[:, -1], toks, new_keys
+
+    def _decode_burst(self, params, pool, last_logits, attn_starts,
+                      active, keys):
+        """lax.scan of `decode_burst` single-token steps per dispatch —
+        the host-overhead amortizer (multi-step scheduling). Returns
+        tokens (K, max_slots); K=1 is plain token-granular stepping."""
+
+        def body(carry, _):
+            pool, last_logits, keys = carry
+            pool, last_logits, toks, keys = self._decode_body(
+                params, pool, last_logits, attn_starts, active, keys
+            )
+            return (pool, last_logits, keys), toks
+
+        (pool, last_logits, keys), toks = lax.scan(
+            body, (pool, last_logits, keys), None,
+            length=self.config.decode_burst,
+        )
+        return pool, last_logits, toks, keys
+
+    # ----------------------------------------------------------------- host
+    def bucket_for(self, prompt_len: int) -> int:
+        """Smallest bucket width holding `prompt_len` (raises if none)."""
+        for w in self.buckets:
+            if prompt_len <= w:
+                return w
+        raise ValueError(
+            f"prompt length {prompt_len} exceeds the largest bucket "
+            f"{self.buckets[-1]}"
+        )
+
+    @property
+    def headroom(self) -> int:
+        """Decode positions left before the pool cursor hits max_len."""
+        return self.max_len - self.cursor
+
+    @property
+    def num_active(self) -> int:
+        return self.allocator.num_used
+
+    @property
+    def num_free(self) -> int:
+        return self.allocator.num_free
+
+    def admit(self, prompt: Sequence[int], *, seed: int = 0) -> int:
+        """Prefill `prompt` into a free slot; returns the slot index.
+
+        The prompt joins exactly where the running batch is: its last
+        token's K/V lands at `cursor - 1`, so the NEXT decode step
+        produces its first generated token together with everyone
+        else's. Raises if no slot is free or the prompt outgrows the
+        buckets — admission POLICY (queueing, shedding) lives in the
+        scheduler.
+        """
+        p = len(prompt)
+        if p == 0:
+            raise ValueError("prompt must contain at least one token")
+        w = self.bucket_for(p)
+        slot = self.allocator.alloc()
+        if slot is None:
+            raise RuntimeError("no free slot — scheduler must gate admits")
+        start = self.cursor - w
+        assert start >= 0, (self.cursor, w)  # cursor >= base >= every bucket
+        padded = np.full((1, w), self.config.pad_id, np.int32)
+        padded[0, w - p:] = np.asarray(prompt, np.int32)
+        (self._cache, self._last_logits,
+         self._attn_starts) = self._prefill_jit(
+            self.params, self._cache, self._last_logits, self._attn_starts,
+            jnp.asarray(padded), jnp.int32(start),
+            jnp.int32(self.cursor - p), jnp.int32(slot),
+        )
+        # keyed by the REQUEST's seed alone (not the slot), so a
+        # request's sampled tokens are independent of where admission
+        # happened to place it — batch composition stays invisible
+        self._keys = self._keys.at[slot].set(jax.random.PRNGKey(seed))
+        self._active[slot] = True
+        return slot
+
+    def step_burst(self) -> np.ndarray:
+        """One dispatch of `decode_burst` steps; tokens (K, max_slots).
+
+        Advances the shared cursor by K positions. Entries for free
+        slots are pad_id; the scheduler maps active slots' token rows
+        back to their requests, decides EOS/length/deadline release,
+        and discards rows past a request's release point.
+        """
+        k = self.config.decode_burst
+        if self.headroom < k:
+            raise RuntimeError(
+                "pool positions exhausted — drain and reset_epoch()"
+            )
+        (self._cache, self._last_logits, toks,
+         self._keys) = self._decode_jit(
+            self.params, self._cache, self._last_logits, self._attn_starts,
+            jnp.asarray(self._active), self._keys,
+        )
+        self.cursor += k
+        return np.asarray(jax.device_get(toks))
+
+    def step(self) -> np.ndarray:
+        """One decode step for the whole pool; tokens (max_slots,).
+        Token-granular stepping — requires decode_burst=1 (use
+        step_burst for the amortized path)."""
+        if self.config.decode_burst != 1:
+            raise RuntimeError("step() needs decode_burst=1")
+        return self.step_burst()[0]
+
+    def release(self, slot: int) -> None:
+        """Free a slot. Pure bookkeeping: the next admission overwrites
+        the slot's entire cache row (kv_slots.write_slot), so no device
+        work happens at release time."""
+        self.allocator.free(slot)
+        self._active[slot] = False
+
+    def reset_epoch(self) -> None:
+        """Rewind the shared cursor to the base (all slots must be free).
+
+        Positions are a global resource under the shared-cursor design;
+        when the scheduler has drained all active requests it rewinds
+        the clock instead of reallocating the pool. Stale K/V stays in
+        the buffers — every future admission wipes its whole slot row.
+        """
+        if self.allocator.num_used:
+            raise RuntimeError("reset_epoch with active slots")
+        self._cache = set_cursor(self._cache, self.base_cursor)
+        self._attn_starts = jnp.zeros_like(self._attn_starts)
+        self.cursor = self.base_cursor
+
+    def compile_stats(self) -> dict:
+        """Jit cache sizes — the no-recompilation-churn observable.
+
+        After warmup (one admit per bucket width in play, one decode
+        step), these counts must stay CONSTANT however many requests
+        churn through (tests/test_serve_scheduler.py pins this).
+        """
+        return {
+            "prefill_compiles": self._prefill_jit._cache_size(),
+            "decode_compiles": self._decode_jit._cache_size(),
+        }

@@ -1,0 +1,125 @@
+"""Slot-based KV-cache pool: the memory layer of continuous batching.
+
+The one-shot generator (inference.py) allocates a fresh KV cache per
+`gen()` call and throws it away — fine for a CLI, fatal for serving,
+where a new cache per request means a new jit trace per batch
+composition. Here the cache is a fixed **pool**: the flax "cache"
+collection of a decode-mode model, allocated ONCE at
+`(max_slots, max_len)`, where the batch dimension of every cache leaf is
+reinterpreted as a slot index. Requests are admitted into free slots and
+released on EOS/length/deadline; shapes never change, so the engine's two
+jitted programs (serve/engine.py) compile once and serve arbitrary
+request churn.
+
+Alignment invariant (what makes a SHARED write cursor work): the model's
+cache keeps one scalar `cache_index` per block — all slots write at the
+same position every step. Continuous batching needs per-slot histories,
+which this layer gets by LEFT-ALIGNMENT, the same trick as
+`pad_left_prompts`: a request admitted while the pool cursor is `cur`
+has its prompt prefilled at positions `[cur - w, cur)` (w = padded
+bucket width) in a batch-1 scratch cache, whose rows are then scattered
+into the pool at the slot index. Its last prompt token lands at
+`cur - 1` — exactly where every running request's latest token sits — and
+`attn_start = cur - prompt_len` masks everything earlier. RoPE positions
+are relative, so the uniform shift is invisible (models/lm.py requires
+pos_emb="rope" for attn_start).
+
+The cost of the shared cursor is that pool POSITIONS are a global
+resource: every decode step consumes one position for all slots. When
+headroom runs out the scheduler drains active requests and calls
+`reset_cursor` (a per-slot ring/paged layout is the follow-up recorded
+in ROADMAP.md). Stale K/V from a previous occupant is never visible:
+`write_slot` overwrites the slot's ENTIRE row (the scratch cache is
+zeros outside the prompt window), and attention only reads
+`[attn_start, cur]`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def set_cursor(cache: Any, value) -> Any:
+    """Return `cache` with every scalar write-cursor leaf set to `value`.
+
+    The decode cache's only scalar leaves are the per-block `cache_index`
+    cursors (and `pos_index` for learned positions), so ndim==0 is the
+    cursor predicate. `value` may be traced (the scratch prefill sets it
+    to a dynamic start inside jit).
+    """
+    return jax.tree.map(
+        lambda l: jnp.asarray(value, l.dtype) if l.ndim == 0 else l, cache
+    )
+
+
+def read_cursor(cache: Any) -> jnp.ndarray:
+    """The shared write cursor (any scalar leaf — they advance in lockstep)."""
+    for leaf in jax.tree.leaves(cache):
+        if leaf.ndim == 0:
+            return leaf
+    raise ValueError("cache has no scalar cursor leaf — not a decode cache")
+
+
+def write_slot(pool: Any, scratch: Any, slot) -> Any:
+    """Scatter a batch-1 scratch cache into `pool` at row `slot`.
+
+    Non-scalar leaves are `(slots, ...)` vs `(1, ...)` — a
+    dynamic_update_slice on the batch axis (slot may be traced). Scalar
+    cursor leaves keep the POOL's value: the scratch prefill is
+    constructed to end exactly at the pool cursor (engine.admit), so the
+    pool's clock is untouched by admissions.
+    """
+
+    def per_leaf(p, s):
+        if p.ndim == 0:
+            return p
+        return lax.dynamic_update_slice(
+            p, s.astype(p.dtype), (slot,) + (0,) * (p.ndim - 1)
+        )
+
+    return jax.tree.map(per_leaf, pool, scratch)
+
+
+class SlotAllocator:
+    """Host-side free-list over the pool's slot indices.
+
+    Pure bookkeeping — no device state. Freed slots go to the BACK of the
+    free list so reuse is observable in tests (a released slot is handed
+    out again once the older free slots are consumed) and allocation
+    order is deterministic.
+    """
+
+    def __init__(self, max_slots: int) -> None:
+        if max_slots <= 0:
+            raise ValueError("max_slots must be positive")
+        self.max_slots = max_slots
+        self._free: List[int] = list(range(max_slots))
+        self._used: set = set()
+
+    def alloc(self) -> Optional[int]:
+        if not self._free:
+            return None
+        slot = self._free.pop(0)
+        self._used.add(slot)
+        return slot
+
+    def free(self, slot: int) -> None:
+        if slot not in self._used:
+            raise ValueError(f"slot {slot} is not allocated")
+        self._used.remove(slot)
+        self._free.append(slot)
+
+    @property
+    def num_used(self) -> int:
+        return len(self._used)
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    def used_slots(self) -> List[int]:
+        return sorted(self._used)

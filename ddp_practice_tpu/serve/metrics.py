@@ -1,0 +1,71 @@
+"""Serving observability: TTFT / TPOT / queue depth / occupancy / tok/s.
+
+A thin adapter between the scheduler's lifecycle hooks and the generic
+registry (utils/metrics.py). The scheduler calls `on_submit` /
+`on_tick` / `on_complete`; this class names the metrics and decides
+what is a counter vs a gauge vs a distribution:
+
+- ``serve_ttft_s`` (histogram): arrival -> first generated token, the
+  user-perceived responsiveness number continuous batching exists to
+  protect (a queued request's clock runs while it waits);
+- ``serve_tpot_s`` (histogram): mean inter-token latency after the
+  first token — the streaming smoothness number;
+- ``serve_queue_depth`` / ``serve_slot_occupancy`` (gauges): the two
+  saturation signals (queue growing = shed soon; occupancy < 1 with a
+  queue = admission is the bottleneck);
+- ``serve_tokens_total`` and per-status request counters.
+
+`report(elapsed_s)` folds in tokens/sec; `emit()` logs one JSON line
+through the process-0 gate (utils/logging.emit_metrics) so multi-host
+replicas don't duplicate metric lines.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ddp_practice_tpu.utils.logging import emit_metrics
+from ddp_practice_tpu.utils.metrics import MetricsRegistry
+
+
+class ServeMetrics:
+    def __init__(self, registry: Optional[MetricsRegistry] = None) -> None:
+        self.registry = registry or MetricsRegistry()
+        r = self.registry
+        self.ttft = r.histogram("serve_ttft_s")
+        self.tpot = r.histogram("serve_tpot_s")
+        self.queue_depth = r.gauge("serve_queue_depth")
+        self.slot_occupancy = r.gauge("serve_slot_occupancy")
+        self.tokens_total = r.counter("serve_tokens_total")
+        self.submitted = r.counter("serve_requests_submitted")
+
+    # scheduler hooks ------------------------------------------------------
+    def on_submit(self, scheduler) -> None:
+        self.submitted.inc()
+        self.queue_depth.set(len(scheduler.queue))
+
+    def on_tick(self, scheduler) -> None:
+        self.queue_depth.set(len(scheduler.queue))
+        eng = scheduler.engine
+        self.slot_occupancy.set(eng.num_active / eng.allocator.max_slots)
+
+    def on_complete(self, completion, scheduler) -> None:
+        self.registry.counter(f"serve_requests_{completion.status}").inc()
+        self.tokens_total.inc(len(completion.tokens))
+        if completion.ttft is not None:
+            self.ttft.observe(completion.ttft)
+        if completion.tpot is not None:
+            self.tpot.observe(completion.tpot)
+
+    # reporting ------------------------------------------------------------
+    def report(self, elapsed_s: Optional[float] = None) -> dict:
+        snap = self.registry.snapshot()
+        if elapsed_s and elapsed_s > 0:
+            snap["serve_tokens_per_sec"] = (
+                self.tokens_total.value / elapsed_s
+            )
+        return snap
+
+    def emit(self, elapsed_s: Optional[float] = None, logger=None):
+        """One `metrics {...}` line on process 0 (None elsewhere)."""
+        return emit_metrics(self.report(elapsed_s), logger)

@@ -1,0 +1,247 @@
+"""Serving scheduler: FIFO admission, deadlines, shedding, slot churn.
+
+Policy layer over the SlotEngine mechanism. One `step()` is one
+scheduler tick:
+
+1. expire queued requests whose deadline already passed (they would
+   burn prefill FLOPs to produce tokens nobody is waiting for);
+2. admit from the FIFO queue into free slots — prefill interleaves with
+   the running decode batch at slot granularity, the continuous-batching
+   move (a request admitted at tick t decodes its first token at tick
+   t together with every running request's next token);
+3. run one batched decode step, hand each active request its token, and
+   release slots on EOS / length cap / deadline.
+
+Admission control is two-tier: `submit()` SHEDS when the bounded queue
+is full (backpressure at the door — the overload answer for "heavy
+traffic from millions of users" is a fast no, not an unbounded queue),
+and the admit loop REJECTS requests that can never run (prompt larger
+than every bucket, or more new tokens than a fresh pool has positions).
+When the shared cursor runs out of headroom for the next request the
+scheduler drains active slots, then rewinds the pool clock
+(engine.reset_epoch) and continues — see kv_slots.py for why positions
+are a global resource.
+
+Time is injected: the real server uses the monotonic clock, tests use
+`FakeClock` (a fixed virtual step per engine tick), so a 20-request
+trace with deadlines replays bit-for-bit deterministically on CPU.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import deque
+from typing import Deque, Dict, List, Optional, Sequence
+
+from ddp_practice_tpu.serve.engine import SlotEngine
+
+
+class MonotonicClock:
+    """Wall time; `tick()` is a no-op (real time advances by itself)."""
+
+    def now(self) -> float:
+        return time.monotonic()
+
+    def tick(self) -> None:
+        pass
+
+
+class FakeClock:
+    """Deterministic virtual time: one engine step = `step_s` seconds."""
+
+    def __init__(self, start: float = 0.0, step_s: float = 0.01) -> None:
+        self._now = start
+        self.step_s = step_s
+
+    def now(self) -> float:
+        return self._now
+
+    def advance(self, dt: float) -> None:
+        self._now += dt
+
+    def tick(self) -> None:
+        self._now += self.step_s
+
+
+@dataclasses.dataclass
+class Request:
+    rid: int
+    prompt: Sequence[int]
+    max_new_tokens: int = 32
+    # absolute deadline (clock domain); None = no deadline. Expired in
+    # queue -> timeout without prefill; expired while running -> early
+    # release with the tokens produced so far.
+    deadline: Optional[float] = None
+    seed: int = 0
+    # stamped by submit() when None; pre-set it (clock domain) when the
+    # TRUE arrival predates the submit call — e.g. the bench replays a
+    # trace and may poll arrivals a tick late; latency must not quietly
+    # exclude that wait
+    arrival: Optional[float] = None
+
+
+@dataclasses.dataclass
+class Completion:
+    rid: int
+    tokens: List[int]
+    # "eos" | "length" | "timeout" | "shed" | "rejected"
+    status: str
+    arrival: float
+    finish: float
+    ttft: Optional[float] = None   # arrival -> first generated token
+    tpot: Optional[float] = None   # mean inter-token latency after the first
+
+
+@dataclasses.dataclass
+class _Running:
+    req: Request
+    slot: int
+    tokens: List[int] = dataclasses.field(default_factory=list)
+    first_token_time: Optional[float] = None
+
+
+class Scheduler:
+    """FIFO continuous-batching scheduler over one SlotEngine."""
+
+    def __init__(self, engine: SlotEngine, *, clock=None, max_queue: int = 64,
+                 metrics=None) -> None:
+        self.engine = engine
+        self.clock = clock or MonotonicClock()
+        self.max_queue = max_queue
+        self.metrics = metrics
+        self.queue: Deque[Request] = deque()
+        self.running: Dict[int, _Running] = {}  # slot -> state
+        self.completions: List[Completion] = []
+
+    # ------------------------------------------------------------ intake
+    def submit(self, req: Request) -> bool:
+        """Enqueue; False = shed (queue at bound) or rejected (malformed).
+        Both are completions too — the client gets a fast negative, not
+        silence."""
+        if req.arrival is None:
+            req.arrival = self.clock.now()
+        if req.max_new_tokens < 1:
+            # needed=0 would slip past every headroom guard and a
+            # zero-token request would still emit one token — a fast
+            # reject is the only sane answer
+            self._finish(req, [], "rejected")
+            return False
+        if len(self.queue) >= self.max_queue:
+            self._finish(req, [], "shed")
+            return False
+        self.queue.append(req)
+        if self.metrics:
+            self.metrics.on_submit(self)
+        return True
+
+    # ------------------------------------------------------------ internals
+    def _finish(self, req: Request, tokens: List[int], status: str,
+                first_token_time: Optional[float] = None) -> Completion:
+        now = self.clock.now()
+        ttft = tpot = None
+        if first_token_time is not None:
+            ttft = first_token_time - req.arrival
+            if len(tokens) > 1:
+                tpot = (now - first_token_time) / (len(tokens) - 1)
+        c = Completion(
+            rid=req.rid, tokens=tokens, status=status,
+            arrival=req.arrival, finish=now, ttft=ttft, tpot=tpot,
+        )
+        self.completions.append(c)
+        if self.metrics:
+            self.metrics.on_complete(c, self)
+        return c
+
+    def _expire_queue(self) -> None:
+        now = self.clock.now()
+        kept: Deque[Request] = deque()
+        for req in self.queue:
+            if req.deadline is not None and now > req.deadline:
+                self._finish(req, [], "timeout")
+            else:
+                kept.append(req)
+        self.queue = kept
+
+    def _admit(self) -> None:
+        eng = self.engine
+        fresh_headroom = eng.max_len - eng.base_cursor
+        burst = eng.config.decode_burst
+        while self.queue and eng.num_free > 0:
+            req = self.queue[0]
+            try:
+                eng.bucket_for(len(req.prompt))
+            except ValueError:
+                self.queue.popleft()
+                self._finish(req, [], "rejected")
+                continue
+            # positions consumed are burst-granular: a request finishing
+            # mid-burst still rides to the burst boundary
+            needed = -(-req.max_new_tokens // burst) * burst
+            if needed > fresh_headroom:
+                # can never fit, even in an empty pool
+                self.queue.popleft()
+                self._finish(req, [], "rejected")
+                continue
+            if eng.headroom < needed:
+                if eng.num_active == 0:
+                    eng.reset_epoch()  # empty pool: rewind the clock
+                else:
+                    break  # drain the running batch first
+            self.queue.popleft()
+            slot = eng.admit(req.prompt, seed=req.seed)
+            self.running[slot] = _Running(req=req, slot=slot)
+
+    # ------------------------------------------------------------ the tick
+    def step(self) -> List[Completion]:
+        """One tick: expire -> admit -> decode -> release. Returns the
+        completions finalized during this tick."""
+        before = len(self.completions)
+        self._expire_queue()
+        self._admit()
+        if self.running:
+            burst = self.engine.step_burst()  # (K, max_slots)
+            eos = self.engine.config.eos_id
+            for row in burst:
+                self.clock.tick()
+                now = self.clock.now()
+                for slot, st in list(self.running.items()):
+                    tok = int(row[slot])
+                    st.tokens.append(tok)
+                    if st.first_token_time is None:
+                        st.first_token_time = now
+                    done_status = None
+                    if eos is not None and tok == eos:
+                        done_status = "eos"
+                    elif len(st.tokens) >= st.req.max_new_tokens:
+                        done_status = "length"
+                    elif (st.req.deadline is not None
+                          and now > st.req.deadline):
+                        done_status = "timeout"
+                    if done_status:
+                        # released mid-burst: later rows of this burst
+                        # no longer map to this request (its surplus
+                        # tokens are discarded with it)
+                        del self.running[slot]
+                        self.engine.release(slot)
+                        self._finish(
+                            st.req, st.tokens, done_status,
+                            st.first_token_time,
+                        )
+                if not self.running:
+                    break  # the rest of the burst is free-slot padding
+        if self.metrics:
+            self.metrics.on_tick(self)
+        return self.completions[before:]
+
+    @property
+    def idle(self) -> bool:
+        return not self.queue and not self.running
+
+    def run_until_idle(self, max_ticks: int = 100_000) -> List[Completion]:
+        """Drive ticks until queue and slots drain (tests + CLI serving)."""
+        for _ in range(max_ticks):
+            if self.idle:
+                return self.completions
+            self.step()
+        raise RuntimeError(f"not idle after {max_ticks} ticks")

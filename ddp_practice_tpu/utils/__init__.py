@@ -1,7 +1,23 @@
-"""Utilities: process-0 logging, timing, profiling hooks."""
+"""Utilities: process-0 logging, metrics registry, timing, profiling."""
 
-from ddp_practice_tpu.utils.logging import get_logger, main_process_only
+from ddp_practice_tpu.utils.logging import (
+    emit_metrics,
+    get_logger,
+    main_process_only,
+)
+from ddp_practice_tpu.utils.metrics import (
+    MetricsRegistry,
+    default_registry,
+)
 from ddp_practice_tpu.utils.timing import Timer
 from ddp_practice_tpu.utils.profiling import profile_region
 
-__all__ = ["get_logger", "main_process_only", "Timer", "profile_region"]
+__all__ = [
+    "emit_metrics",
+    "get_logger",
+    "main_process_only",
+    "MetricsRegistry",
+    "default_registry",
+    "Timer",
+    "profile_region",
+]

@@ -36,3 +36,22 @@ def main_process_only(fn):
         return None
 
     return wrapper
+
+
+@main_process_only
+def emit_metrics(snapshot: dict, logger: logging.Logger = None) -> str:
+    """Log one `metrics {...}` JSON line — process 0 only.
+
+    The serving observability sink (serve/metrics.py): every replica of a
+    multi-host server runs the same scheduler loop and accumulates the
+    same registry, so an ungated emit would print one duplicate line per
+    host. Routed through `main_process_only`, consistent with every other
+    rank-0 side effect in the framework (train/loop.py info0/warn0).
+    Returns the rendered line (None on non-0 processes — the decorator's
+    contract), which is what the unit test pins.
+    """
+    import json
+
+    line = "metrics " + json.dumps(snapshot, sort_keys=True, default=float)
+    (logger or get_logger("ddp_practice_tpu.serve")).info(line)
+    return line

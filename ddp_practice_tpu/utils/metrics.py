@@ -1,0 +1,137 @@
+"""Process-local metrics registry: counters, gauges, histograms.
+
+The training loop logs scalar step metrics straight to its JSONL file
+(train/loop.py --metrics_file); serving needs something stateful — TTFT
+and per-token-latency distributions, queue-depth gauges, token counters —
+that accumulates across thousands of engine steps and renders one
+snapshot line. This registry is that accumulator: pure host-side Python
+(nothing here touches jax), cheap enough to update inside the serve loop,
+and snapshot() flattens to a plain dict so the process-0-gated emitter
+(utils/logging.py emit_metrics) and the bench reports can both consume it.
+
+Percentiles come from a bounded reservoir: a histogram keeps the most
+recent `max_samples` observations (running count/sum stay exact), so a
+long-lived server's memory stays O(1) while p50/p99 track the current
+traffic rather than the whole history.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Iterable, Optional
+
+
+class Counter:
+    """Monotonic count (requests served, tokens emitted, sheds)."""
+
+    def __init__(self) -> None:
+        self.value = 0.0
+
+    def inc(self, n: float = 1.0) -> None:
+        if n < 0:
+            raise ValueError("counters only go up — use a Gauge")
+        self.value += n
+
+
+class Gauge:
+    """Last-write-wins level (queue depth, slot occupancy)."""
+
+    def __init__(self) -> None:
+        self.value = 0.0
+
+    def set(self, v: float) -> None:
+        self.value = float(v)
+
+
+class Histogram:
+    """Streaming distribution with exact count/sum and reservoir quantiles."""
+
+    def __init__(self, max_samples: int = 4096) -> None:
+        if max_samples <= 0:
+            raise ValueError("max_samples must be positive")
+        self.count = 0
+        self.sum = 0.0
+        self._max = max_samples
+        self._samples: list = []
+        self._next = 0  # ring-buffer cursor once the reservoir is full
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        self.count += 1
+        self.sum += v
+        if len(self._samples) < self._max:
+            self._samples.append(v)
+        else:
+            self._samples[self._next] = v
+            self._next = (self._next + 1) % self._max
+
+    @property
+    def mean(self) -> float:
+        return self.sum / self.count if self.count else 0.0
+
+    def percentile(self, p: float) -> float:
+        """p in [0, 100]; nearest-rank over the retained reservoir."""
+        if not self._samples:
+            return 0.0
+        s = sorted(self._samples)
+        rank = min(len(s) - 1, max(0, round(p / 100.0 * (len(s) - 1))))
+        return s[int(rank)]
+
+    def summary(self, percentiles: Iterable[float] = (50, 90, 99)) -> dict:
+        out = {"count": self.count, "mean": self.mean}
+        for p in percentiles:
+            out[f"p{p:g}"] = self.percentile(p)
+        return out
+
+
+class MetricsRegistry:
+    """Create-or-get named metrics; snapshot() flattens to one dict.
+
+    Thread-safe on the create path only (a serve loop is single-threaded,
+    but request submission may come from another thread); individual
+    updates are plain float ops under the GIL.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: Dict[str, Counter] = {}
+        self._gauges: Dict[str, Gauge] = {}
+        self._histograms: Dict[str, Histogram] = {}
+
+    def counter(self, name: str) -> Counter:
+        with self._lock:
+            return self._counters.setdefault(name, Counter())
+
+    def gauge(self, name: str) -> Gauge:
+        with self._lock:
+            return self._gauges.setdefault(name, Gauge())
+
+    def histogram(self, name: str, max_samples: int = 4096) -> Histogram:
+        with self._lock:
+            return self._histograms.setdefault(
+                name, Histogram(max_samples=max_samples)
+            )
+
+    def snapshot(self) -> dict:
+        """Flat `{name: value}` dict; histograms expand to name_count /
+        name_mean / name_p50 / name_p90 / name_p99."""
+        out: dict = {}
+        for name, c in self._counters.items():
+            out[name] = c.value
+        for name, g in self._gauges.items():
+            out[name] = g.value
+        for name, h in self._histograms.items():
+            for k, v in h.summary().items():
+                out[f"{name}_{k}"] = v
+        return out
+
+
+_default: Optional[MetricsRegistry] = None
+
+
+def default_registry() -> MetricsRegistry:
+    """Process-wide registry for callers that don't thread their own."""
+    global _default
+    if _default is None:
+        _default = MetricsRegistry()
+    return _default

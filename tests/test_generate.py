@@ -148,6 +148,40 @@ def test_sample_logits_filters(devices):
 
 
 @pytest.mark.fast
+def test_sample_logits_topk_then_topp_bf16(devices):
+    """Filter COMPOSITION on bf16 logits: k first, then p (the docstring
+    contract), with the fp32 upcast before the filter math.
+
+    logits [10, 8, 6, 4]: raw softmax ~[.865, .117, .016, .002]; after
+    top_k=2 the renormalized top token carries ~.8808. top_p=0.88 sits
+    between those two masses, so the order is observable: k-then-p drops
+    the runner-up (exclusive mass before it .8808 > .88 under fp32 math)
+    and EVERY draw is the argmax; p-then-k would keep it (.865 < .88)
+    and the runner-up would appear with ~12% probability per draw.
+    The same threshold also pins the upcast: bf16 cumsum rounds .8808
+    down to .8789 < .88 and would keep the runner-up too."""
+    logits = jnp.asarray([[10.0, 8.0, 6.0, 4.0]], jnp.bfloat16)
+    for s in range(40):
+        key = jax.random.PRNGKey(s)
+        assert int(sample_logits(logits, key, top_k=2, top_p=0.88)[0]) == 0
+    # with p above both masses the top-2 set survives intact (and ONLY
+    # the top-2: k already removed the rest)
+    seen = {
+        int(sample_logits(logits, jax.random.PRNGKey(s),
+                          top_k=2, top_p=0.95)[0])
+        for s in range(200)
+    }
+    assert seen == {0, 1}
+    # the argmax always survives top_p, however tiny p is and whatever
+    # the temperature did to the bf16 logits first
+    for s in range(40):
+        key = jax.random.PRNGKey(s)
+        assert int(sample_logits(
+            logits, key, temperature=2.5, top_p=1e-6
+        )[0]) == 0
+
+
+@pytest.mark.fast
 def test_byte_codec_roundtrip(devices):
     s = "hello, TPU\n"
     assert decode_bytes(encode_bytes(s)[0]) == s

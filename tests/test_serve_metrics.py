@@ -1,0 +1,110 @@
+"""Metrics registry (utils/metrics.py) + process-0 emission gate
+(utils/logging.py emit_metrics) + serving adapter (serve/metrics.py).
+
+The multi-host invariant pinned here: metric lines are a rank-0 side
+effect like every other print/save in the framework — a non-0 process
+calling emit_metrics produces NOTHING (no log record, None return), so
+an N-host serving deployment emits one line per snapshot, not N.
+"""
+
+import logging
+
+import pytest
+
+from ddp_practice_tpu.utils.logging import emit_metrics, get_logger
+from ddp_practice_tpu.utils.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+)
+
+
+@pytest.mark.fast
+def test_counter_gauge_histogram(devices):
+    c = Counter()
+    c.inc()
+    c.inc(4)
+    assert c.value == 5
+    with pytest.raises(ValueError):
+        c.inc(-1)
+
+    g = Gauge()
+    g.set(3)
+    g.set(1.5)
+    assert g.value == 1.5
+
+    h = Histogram()
+    for v in range(1, 101):
+        h.observe(float(v))
+    assert h.count == 100 and h.mean == pytest.approx(50.5)
+    assert h.percentile(50) == pytest.approx(50.0, abs=1.0)
+    assert h.percentile(99) == pytest.approx(99.0, abs=1.0)
+    s = h.summary()
+    assert s["count"] == 100 and "p99" in s
+
+
+@pytest.mark.fast
+def test_histogram_reservoir_bounds_memory(devices):
+    h = Histogram(max_samples=8)
+    for v in range(1000):
+        h.observe(float(v))
+    assert h.count == 1000            # exact count survives the bound
+    assert h.sum == pytest.approx(sum(range(1000)))
+    assert len(h._samples) == 8       # reservoir stays bounded
+    # quantiles reflect recent traffic (the last ring-buffer writes)
+    assert h.percentile(50) >= 900
+
+
+@pytest.mark.fast
+def test_registry_create_or_get_and_snapshot(devices):
+    r = MetricsRegistry()
+    assert r.counter("a") is r.counter("a")
+    r.counter("a").inc(2)
+    r.gauge("b").set(7)
+    r.histogram("c").observe(1.0)
+    snap = r.snapshot()
+    assert snap["a"] == 2 and snap["b"] == 7
+    assert snap["c_count"] == 1 and snap["c_mean"] == 1.0
+
+
+@pytest.mark.fast
+def test_emit_metrics_process0_gate(devices, monkeypatch, caplog):
+    """Process 0 emits one line; any other process index emits nothing."""
+    import jax
+
+    logger = get_logger("ddp_practice_tpu.serve.test_gate")
+    logger.propagate = True  # let caplog's root handler see it
+
+    with caplog.at_level(logging.INFO,
+                         logger="ddp_practice_tpu.serve.test_gate"):
+        monkeypatch.setattr(jax, "process_index", lambda: 0)
+        line = emit_metrics({"serve_tokens_total": 5}, logger)
+        assert line.startswith("metrics ")
+        assert '"serve_tokens_total": 5' in line
+        assert any("serve_tokens_total" in r.message for r in caplog.records)
+
+        caplog.clear()
+        monkeypatch.setattr(jax, "process_index", lambda: 1)
+        assert emit_metrics({"serve_tokens_total": 5}, logger) is None
+        assert not caplog.records
+
+
+@pytest.mark.fast
+def test_serve_metrics_report(devices):
+    """The adapter names/types serving metrics and folds in tokens/sec."""
+    from ddp_practice_tpu.serve.metrics import ServeMetrics
+    from ddp_practice_tpu.serve.scheduler import Completion
+
+    m = ServeMetrics()
+    m.tokens_total.inc(40)
+    m.on_complete(
+        Completion(rid=0, tokens=[1, 2], status="eos", arrival=0.0,
+                   finish=1.0, ttft=0.5, tpot=0.1),
+        scheduler=None,
+    )
+    rep = m.report(elapsed_s=2.0)
+    assert rep["serve_tokens_per_sec"] == pytest.approx(21.0)  # 42 / 2
+    assert rep["serve_requests_eos"] == 1
+    assert rep["serve_ttft_s_count"] == 1
+    assert rep["serve_tpot_s_p50"] == pytest.approx(0.1)
