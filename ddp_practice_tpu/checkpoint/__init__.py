@@ -18,7 +18,11 @@ good checkpoint):
   complete checkpoint);
 - previous checkpoints are retained (`keep_last`, default 3) and pruned
   oldest-first only after the new one is complete;
-- `restore` picks the newest *complete* step dir, ignoring temp debris.
+- `restore` picks the newest *complete* step dir, ignoring temp debris;
+  "complete" means the manifest PARSES — a manifest truncated mid-write
+  (torn legacy-layout copy, power loss inside the json dump) makes the
+  restore fall back to the previous complete checkpoint instead of
+  dying on the corrupt one.
 
 Multi-host: EVERY process calls save() (the barriers are collective).
 Leaves whose shards span hosts (FSDP/TP state) are NOT gathered — each
@@ -105,24 +109,46 @@ def _complete_steps(directory: str) -> List[int]:
     return sorted(steps)
 
 
+def _manifest_ok(ckpt_dir: str) -> bool:
+    """True when the manifest PARSES, not merely exists.
+
+    Rename makes a whole-dir publish atomic, but the manifest byte
+    stream itself is not: a power loss mid-`json.dump` (or a torn copy
+    of an older single-file layout) leaves a manifest that exists and
+    parse-fails — presence alone would select it and restore would die
+    on the ONLY checkpoint it was willing to look at. A corrupt newest
+    manifest must instead fall back to the previous complete checkpoint
+    (losing one interval of work beats losing the run)."""
+    try:
+        with open(os.path.join(ckpt_dir, _MANIFEST)) as f:
+            json.load(f)
+        return True
+    except (OSError, ValueError, UnicodeDecodeError):
+        # ValueError covers json.JSONDecodeError (its subclass)
+        return False
+
+
 def _resolve(directory: str) -> Optional[str]:
     """Directory actually holding leaves.npz/manifest.json, or None.
 
     step_N dirs win over a legacy root-level checkpoint: any step_N was
     written after the legacy file (this writer only produces step dirs),
     so preferring legacy would silently resume pre-upgrade state.
+    Newest first, but a step whose manifest is corrupt/unreadable
+    (_manifest_ok) is SKIPPED, not fatal — older complete checkpoints
+    are still perfectly good recovery points.
 
-    Last resort: a *complete* (manifest present) dir under a temp or
+    Last resort: a *complete* (manifest parses) dir under a temp or
     rename-aside name. A crash in the same-step re-save window can leave
     the only complete copies as tmp.step_N.*/step_N.old.* — both written
     with manifest last, so completeness still implies integrity — and
     refusing them would strand a recoverable run with no checkpoint."""
-    steps = _complete_steps(directory)
-    if steps:
-        return os.path.join(directory, f"step_{steps[-1]}")
-    if os.path.exists(os.path.join(directory, _MANIFEST)) and os.path.exists(
-        os.path.join(directory, _LEAVES)
-    ):
+    for step in reversed(_complete_steps(directory)):
+        cand = os.path.join(directory, f"step_{step}")
+        if _manifest_ok(cand):
+            return cand
+    if os.path.exists(os.path.join(directory, _LEAVES)) \
+            and _manifest_ok(directory):
         return directory  # legacy single-checkpoint layout
     best, best_step = None, -1
     if os.path.isdir(directory):
@@ -130,9 +156,9 @@ def _resolve(directory: str) -> Optional[str]:
             if not (name.startswith("tmp.step_") or ".old." in name):
                 continue
             m = re.search(r"step_(\d+)", name)
-            if m and os.path.exists(os.path.join(directory, name, _MANIFEST)):
-                if int(m.group(1)) > best_step:
-                    best, best_step = name, int(m.group(1))
+            if m and int(m.group(1)) > best_step \
+                    and _manifest_ok(os.path.join(directory, name)):
+                best, best_step = name, int(m.group(1))
     return os.path.join(directory, best) if best else None
 
 

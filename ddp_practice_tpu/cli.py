@@ -17,6 +17,10 @@ Examples:
       --tensor 2 --optimizer adamw --lr 1e-3
   python -m ddp_practice_tpu.cli serve                # continuous-batching
                                                       # serve bench (serve/)
+  python -m ddp_practice_tpu.cli serve --replicas 2 \\
+      --fault-plan '{"faults": [{"kind": "crash", "tick": 40}]}'
+                                       # fault-tolerant router fleet:
+                                       # goodput under injected faults
 """
 
 from __future__ import annotations

@@ -1,4 +1,4 @@
-"""serve/: TPU-native continuous-batching inference engine.
+"""serve/: TPU-native continuous-batching inference engine + fleet.
 
 Layers (each its own module, composable and separately testable):
 
@@ -7,20 +7,49 @@ Layers (each its own module, composable and separately testable):
   scatter on admit, free-list slot reuse;
 - engine.py    — SlotEngine: bucketed jitted prefill-admit + one jitted
   batched decode step; static shapes, so batch composition churns with
-  zero recompiles;
+  zero recompiles; per-slot finite-logits flag contains a NaN to one
+  request;
 - scheduler.py — FIFO queue, admission control (bounded queue sheds),
   per-request deadlines, EOS/length release, injectable clock
-  (FakeClock for deterministic CPU tests);
-- metrics.py   — TTFT/TPOT/queue-depth/occupancy/tokens-per-sec over the
-  utils metrics registry, emitted through the process-0 gate;
+  (FakeClock for deterministic CPU tests) and fault hook;
+- faults.py    — seeded, JSON-serializable FaultPlan (crash / latency /
+  nan_logits / admit_fail) driving deterministic chaos tests and
+  goodput-under-faults benches;
+- health.py    — per-replica HEALTHY/DEGRADED/DEAD state machine with a
+  consecutive-failure circuit breaker and backoff half-open probes;
+- router.py    — fault-tolerant least-loaded dispatch over N replicas:
+  bounded retries with backoff+jitter, crash failover that migrates
+  in-flight requests (prompt + tokens-so-far re-prefill,
+  token-identical under greedy), brown-out degradation;
+- metrics.py   — TTFT/TPOT/queue-depth/occupancy per replica plus the
+  fleet counters (retries, failovers, sheds-by-reason, breaker state,
+  brown-out), emitted through the process-0 gate;
 - bench.py     — serve_bench: one Poisson trace through the continuous
-  engine and the static-batch baseline (BENCHMARKS.md records the
+  engine, the static-batch baseline, and (--replicas) the router fleet
+  with optional --fault-plan goodput runs (BENCHMARKS.md records the
   curves); also the `cli.py serve` entry point.
 """
 
 from ddp_practice_tpu.serve.engine import EngineConfig, SlotEngine
+from ddp_practice_tpu.serve.faults import (
+    FaultInjector,
+    FaultPlan,
+    FaultSpec,
+    ReplicaCrashed,
+)
+from ddp_practice_tpu.serve.health import (
+    BreakerConfig,
+    CircuitBreaker,
+    HealthState,
+    ReplicaHealth,
+)
 from ddp_practice_tpu.serve.kv_slots import SlotAllocator
-from ddp_practice_tpu.serve.metrics import ServeMetrics
+from ddp_practice_tpu.serve.metrics import RouterMetrics, ServeMetrics
+from ddp_practice_tpu.serve.router import (
+    Router,
+    RouterConfig,
+    make_router,
+)
 from ddp_practice_tpu.serve.scheduler import (
     Completion,
     FakeClock,
@@ -30,13 +59,25 @@ from ddp_practice_tpu.serve.scheduler import (
 )
 
 __all__ = [
+    "BreakerConfig",
+    "CircuitBreaker",
     "Completion",
     "EngineConfig",
     "FakeClock",
+    "FaultInjector",
+    "FaultPlan",
+    "FaultSpec",
+    "HealthState",
     "MonotonicClock",
+    "ReplicaCrashed",
+    "ReplicaHealth",
     "Request",
+    "Router",
+    "RouterConfig",
+    "RouterMetrics",
     "Scheduler",
     "ServeMetrics",
     "SlotAllocator",
     "SlotEngine",
+    "make_router",
 ]

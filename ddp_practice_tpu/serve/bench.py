@@ -25,6 +25,14 @@ Wall-clock timing closes with a host readback (np.asarray of the token
 block / the scheduler's device_get per step), so no async dispatch leaks
 into the window. Warmup compiles happen before the trace clock starts
 for BOTH servers.
+
+`--replicas N` additionally replays the trace through N replicas behind
+the fault-tolerant router (serve/router.py); with `--fault-plan` the
+router row becomes a GOODPUT-under-faults measurement — tokens still
+delivered while a seeded FaultPlan crashes replicas, stalls ticks, or
+poisons logits. replicas=1 with no plan measures the router's own
+overhead against the direct continuous path (should be within noise —
+the router adds host-side bookkeeping only).
 """
 
 from __future__ import annotations
@@ -161,6 +169,82 @@ def _run_continuous(model, params, trace, *, max_slots, prompt_buckets,
     }
 
 
+def _run_router(model, params, trace, *, replicas, max_slots,
+                prompt_buckets, max_len, decode_burst, eos_id,
+                fault_plan=None) -> dict:
+    """The fleet path: N identical replicas behind the fault-tolerant
+    router (serve/router.py). Scored like the continuous server — useful
+    tokens of requests that finished ok — which under an injected
+    FaultPlan is a GOODPUT number: tokens the fleet still delivered
+    while replicas crashed, stalled, or emitted NaNs."""
+    from ddp_practice_tpu.serve.engine import EngineConfig
+    from ddp_practice_tpu.serve.router import RouterConfig, make_router
+    from ddp_practice_tpu.serve.scheduler import Request
+
+    router = make_router(
+        model, params, replicas,
+        EngineConfig(
+            max_slots=max_slots, max_len=max_len,
+            prompt_buckets=prompt_buckets, temperature=0.0,
+            decode_burst=decode_burst, eos_id=eos_id,
+        ),
+        max_queue=len(trace),
+        config=RouterConfig(),
+        fault_plan=fault_plan,
+    )
+    # warm EVERY configured bucket, not just the trace prompts' widths:
+    # failover re-prefills carry prompt+salvaged-tokens and can land in
+    # a larger bucket — its compile must happen out here, not inside the
+    # timed goodput window
+    router.warmup()
+
+    t0 = time.monotonic()
+    i = 0
+    while not (i >= len(trace) and router.idle):
+        now = time.monotonic() - t0
+        while i < len(trace) and trace[i]["arrival"] <= now:
+            t = trace[i]
+            router.submit(Request(
+                rid=t["rid"], prompt=t["prompt"],
+                max_new_tokens=t["max_new_tokens"],
+                arrival=t0 + t["arrival"],
+            ))
+            i += 1
+        if router.idle:
+            # idle with arrivals left: sleep to the next one. (idle with
+            # NONE left is reachable too — door sheds on a dead fleet
+            # finalize instantly — and the loop condition exits then.)
+            if i < len(trace):
+                time.sleep(max(0.0, trace[i]["arrival"] - now))
+            continue
+        router.step()
+    elapsed = time.monotonic() - t0
+
+    ok = [c for c in router.completions if c.status in ("eos", "length")]
+    ok_tokens = sum(len(c.tokens) for c in ok)
+    statuses: dict = {}
+    for c in router.completions:
+        statuses[c.status] = statuses.get(c.status, 0) + 1
+    m = router.metrics
+    return {
+        "mode": f"router x{replicas}",
+        "elapsed_s": elapsed,
+        "useful_tokens": ok_tokens,
+        "tokens_per_sec": ok_tokens / elapsed,
+        "goodput_tokens_per_sec": ok_tokens / elapsed,
+        "ttft_s": _percentiles([c.ttft for c in ok if c.ttft is not None]),
+        "tpot_s": _percentiles([c.tpot for c in ok if c.tpot is not None]),
+        "latency_s": _percentiles([c.finish - c.arrival for c in ok]),
+        "completions": len(router.completions),
+        "statuses": statuses,
+        "retries": m.retries.value,
+        "failovers": m.failovers.value,
+        "breaker_trips": m.breaker_trips.value,
+        "replica_states": router.states(),
+        "compile_stats": router.compile_stats(),
+    }
+
+
 def _run_static(model, params, trace, *, max_slots, width, max_new,
                 eos_id) -> dict:
     """Static-batch baseline: fixed (max_slots, width) prompts, everyone
@@ -260,6 +344,11 @@ def serve_bench(
     # regardless. None = no EOS in the trace.
     eos_id: Optional[int] = 46,
     seed: int = 0,
+    # fleet path: 0 = skip the router bench; N >= 1 runs the SAME trace
+    # through N replicas behind serve/router.py (replicas=1 measures the
+    # router's overhead against the direct continuous path)
+    replicas: int = 0,
+    fault_plan=None,
 ) -> dict:
     """Replay one Poisson trace through both servers; return the report."""
     model, params = _build_model(
@@ -281,7 +370,7 @@ def serve_bench(
         width=max(prompt_buckets), max_new=max(max_new_range),
         eos_id=eos_id,
     )
-    return {
+    report = {
         "trace": {
             "n_requests": n_requests, "rate_hz": rate_hz, "seed": seed,
             "prompt_len_range": list(prompt_len_range),
@@ -294,6 +383,20 @@ def serve_bench(
             if static["tokens_per_sec"] else float("inf")
         ),
     }
+    if replicas >= 1:
+        report["router"] = _run_router(
+            model, params, trace, replicas=replicas, max_slots=max_slots,
+            prompt_buckets=tuple(prompt_buckets), max_len=max_len,
+            decode_burst=decode_burst, eos_id=eos_id,
+            fault_plan=fault_plan,
+        )
+        if fault_plan is not None:
+            report["fault_plan"] = fault_plan.to_json()
+        report["router_vs_continuous"] = (
+            report["router"]["tokens_per_sec"] / cont["tokens_per_sec"]
+            if cont["tokens_per_sec"] else float("inf")
+        )
+    return report
 
 
 # --------------------------------------------------------------------- CLI
@@ -324,6 +427,16 @@ def build_parser() -> argparse.ArgumentParser:
                    help="bench: trace length")
     p.add_argument("--rate", type=float, default=8.0,
                    help="bench: Poisson arrival rate (req/s)")
+    p.add_argument("--replicas", type=int, default=0,
+                   help="bench: also run the trace through N engine "
+                        "replicas behind the fault-tolerant router "
+                        "(serve/router.py; 0 = skip)")
+    p.add_argument("--fault-plan", dest="fault_plan", default=None,
+                   metavar="JSON",
+                   help="bench: inject a serve/faults.py FaultPlan into "
+                        "the router run — a JSON string or a path to a "
+                        "JSON file; the router row then reports GOODPUT "
+                        "under those faults (requires --replicas)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--json", action="store_true")
     return p
@@ -382,9 +495,18 @@ def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     if args.ckpt_dir:
         return _serve_checkpoint(args)
+    if args.fault_plan and not args.replicas:
+        raise SystemExit("--fault-plan needs --replicas N (faults are "
+                         "injected into the router fleet run)")
     bench_kw = {}
     if args.decode_burst is not None:
         bench_kw["decode_burst"] = args.decode_burst
+    if args.replicas:
+        from ddp_practice_tpu.serve.faults import FaultPlan
+
+        bench_kw["replicas"] = args.replicas
+        if args.fault_plan:
+            bench_kw["fault_plan"] = FaultPlan.from_json(args.fault_plan)
     report = serve_bench(
         n_requests=args.requests, rate_hz=args.rate,
         max_slots=args.max_slots, seed=args.seed, **bench_kw,
@@ -397,7 +519,8 @@ def main(argv=None) -> int:
             f"[serve_bench] {args.requests} requests @ {args.rate}/s, "
             f"{args.max_slots} slots"
         )
-        for r in (c, s):
+        rows = [c, s] + ([report["router"]] if "router" in report else [])
+        for r in rows:
             print(
                 f"  {r['mode']:>10}: {r['tokens_per_sec']:8.1f} tok/s  "
                 f"ttft p50 {r['ttft_s']['p50'] * 1e3:7.1f} ms  "
@@ -406,6 +529,18 @@ def main(argv=None) -> int:
             )
         print(f"  continuous/static throughput: "
               f"{report['throughput_ratio']:.2f}x")
+        if "router" in report:
+            r = report["router"]
+            faults = " under injected faults" if args.fault_plan else ""
+            print(
+                f"  router{faults}: goodput "
+                f"{r['goodput_tokens_per_sec']:.1f} tok/s  statuses "
+                f"{r['statuses']}  retries {r['retries']:.0f}  "
+                f"failovers {r['failovers']:.0f}  "
+                f"breaker trips {r['breaker_trips']:.0f}"
+            )
+            print(f"  router/continuous throughput: "
+                  f"{report['router_vs_continuous']:.2f}x")
     return 0
 
 

@@ -120,6 +120,7 @@ class SlotEngine:
         self._attn_starts = jnp.zeros((s,), jnp.int32)
         self._keys = jnp.zeros((s, 2), jnp.uint32)
         self._active = np.zeros((s,), bool)
+        self.last_finite = np.ones((1, s), bool)  # updated per step_burst
         if config.decode_burst < 1:
             raise ValueError("decode_burst must be >= 1")
         self._prefill_jit = jax.jit(self._prefill_admit)
@@ -146,6 +147,12 @@ class SlotEngine:
     def _decode_body(self, params, pool, last_logits, attn_starts,
                      active, keys):
         cfg = self.config
+        # per-slot finite-logits flag, computed on the SAMPLING INPUT: a
+        # non-finite row (bf16 overflow, poisoned cache) marks only its
+        # own slot — attention is per-row, so the NaN cannot cross slots,
+        # and this flag is what lets the scheduler finish ONE request
+        # with status "error" instead of serving garbage batch-wide
+        finite = jnp.isfinite(last_logits).all(axis=-1)
         if cfg.temperature == 0.0:
             toks = sample_logits(last_logits, None, temperature=0.0)
             new_keys = keys
@@ -165,7 +172,7 @@ class SlotEngine:
             self.model, params, pool, toks[:, None],
             attn_start=attn_starts, batch_stats=self.batch_stats,
         )
-        return pool, logits[:, -1], toks, new_keys
+        return pool, logits[:, -1], toks, new_keys, finite
 
     def _decode_burst(self, params, pool, last_logits, attn_starts,
                       active, keys):
@@ -175,16 +182,16 @@ class SlotEngine:
 
         def body(carry, _):
             pool, last_logits, keys = carry
-            pool, last_logits, toks, keys = self._decode_body(
+            pool, last_logits, toks, keys, finite = self._decode_body(
                 params, pool, last_logits, attn_starts, active, keys
             )
-            return (pool, last_logits, keys), toks
+            return (pool, last_logits, keys), (toks, finite)
 
-        (pool, last_logits, keys), toks = lax.scan(
+        (pool, last_logits, keys), (toks, finite) = lax.scan(
             body, (pool, last_logits, keys), None,
             length=self.config.decode_burst,
         )
-        return pool, last_logits, toks, keys
+        return pool, last_logits, toks, keys, finite
 
     # ----------------------------------------------------------------- host
     def bucket_for(self, prompt_len: int) -> int:
@@ -258,12 +265,17 @@ class SlotEngine:
                 "pool positions exhausted — drain and reset_epoch()"
             )
         (self._cache, self._last_logits, toks,
-         self._keys) = self._decode_jit(
+         self._keys, finite) = self._decode_jit(
             self.params, self._cache, self._last_logits, self._attn_starts,
             jnp.asarray(self._active), self._keys,
         )
         self.cursor += k
-        return np.asarray(jax.device_get(toks))
+        toks, finite = jax.device_get((toks, finite))
+        # (K, max_slots) bool: False rows mark slots whose token this
+        # burst was sampled from non-finite logits — the scheduler
+        # finishes those requests with status "error"
+        self.last_finite = np.asarray(finite)
+        return np.asarray(toks)
 
     def step(self) -> np.ndarray:
         """One decode step for the whole pool; tokens (max_slots,).
@@ -272,6 +284,13 @@ class SlotEngine:
         if self.config.decode_burst != 1:
             raise RuntimeError("step() needs decode_burst=1")
         return self.step_burst()[0]
+
+    def poison_slot(self, slot: int) -> None:
+        """Overwrite one slot's pending sampling input with NaN — the
+        deterministic stand-in for a numerical blow-up (serve/faults.py
+        `nan_logits`). Host-side, between dispatches; the next decode
+        burst's finite flag turns False for exactly this slot."""
+        self._last_logits = self._last_logits.at[slot].set(jnp.nan)
 
     def release(self, slot: int) -> None:
         """Free a slot. Pure bookkeeping: the next admission overwrites

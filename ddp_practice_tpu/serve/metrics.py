@@ -25,7 +25,7 @@ from __future__ import annotations
 from typing import Optional
 
 from ddp_practice_tpu.utils.logging import emit_metrics
-from ddp_practice_tpu.utils.metrics import MetricsRegistry
+from ddp_practice_tpu.utils.metrics import MetricsRegistry, labelled
 
 
 class ServeMetrics:
@@ -69,3 +69,53 @@ class ServeMetrics:
     def emit(self, elapsed_s: Optional[float] = None, logger=None):
         """One `metrics {...}` line on process 0 (None elsewhere)."""
         return emit_metrics(self.report(elapsed_s), logger)
+
+
+# health-state gauge encoding (serve_replica_state{replica=i}): a gauge
+# is a float, so the three states get stable small ints
+STATE_CODES = {"healthy": 0.0, "degraded": 1.0, "dead": 2.0}
+
+
+class RouterMetrics:
+    """Fleet-level observability for serve/router.py.
+
+    Same registry idiom as ServeMetrics but for the router's concerns:
+    retries/failovers (how often the fault machinery earns its keep),
+    sheds BY REASON (queue_full vs brownout vs no_replica are three
+    different operator actions), per-replica breaker state, and the
+    brown-out gauge pair (active flag + the fleet-pressure signal that
+    drives it).
+    """
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None) -> None:
+        self.registry = registry or MetricsRegistry()
+        r = self.registry
+        self.retries = r.counter("serve_retries_total")
+        self.failovers = r.counter("serve_failovers_total")
+        self.breaker_trips = r.counter("serve_breaker_trips_total")
+        self.brownout_active = r.gauge("serve_brownout_active")
+        self.fleet_pressure = r.gauge("serve_fleet_pressure")
+        self.tokens_total = r.counter("serve_router_tokens_total")
+        self.submitted = r.counter("serve_router_requests_submitted")
+
+    def on_shed(self, reason: str) -> None:
+        self.registry.counter(
+            labelled("serve_sheds_total", reason=reason)
+        ).inc()
+
+    def on_replica_state(self, replica: int, state: str) -> None:
+        self.registry.gauge(
+            labelled("serve_replica_state", replica=replica)
+        ).set(STATE_CODES[state])
+
+    def on_finalize(self, completion) -> None:
+        self.registry.counter(
+            f"serve_router_requests_{completion.status}"
+        ).inc()
+        self.tokens_total.inc(len(completion.tokens))
+
+    def report(self) -> dict:
+        return self.registry.snapshot()
+
+    def emit(self, logger=None):
+        return emit_metrics(self.report(), logger)

@@ -1,0 +1,540 @@
+"""Fault-tolerant router over N SlotEngine replicas.
+
+One SlotEngine is one chip's batch; heavy traffic needs a fleet. This
+router is the serving mirror of train/elastic.py: the training side
+fails fast (watchdog) and recovers by checkpoint; the serving side
+fails fast (circuit breaker, serve/health.py) and recovers by REQUEST
+MIGRATION — a dead replica's in-flight requests are re-admitted on a
+surviving replica as `prompt + tokens-generated-so-far`, a fresh
+prefill that is token-identical under greedy decoding (the tokens
+already streamed to the host were sampled from finite logits; decoding
+is a pure function of the token prefix).
+
+Dispatch is least-loaded, driven by the per-replica serve/metrics.py
+gauges (queue depth + slot occupancy), preferring HEALTHY replicas over
+DEGRADED ones. Failures are answered in layers:
+
+- one bad completion (status "error": non-finite logits, transient
+  admission failure) → bounded retry budget with exponential backoff +
+  jitter (utils/backoff.py), on whichever replica is then least loaded;
+- consecutive failures → breaker trips, replica goes DEAD, in-flight
+  work migrates, half-open probes with backoff decide when it returns;
+- fleet overload → brown-out: when fleet pressure ((active + queued) /
+  total slots) crosses `brownout_on`, low-priority requests
+  (Request.priority >= shed_priority) are shed at the door AND out of
+  replica queues, and new admissions get their `max_new_tokens` capped
+  (degraded answers beat no answers); both revert when pressure falls
+  below `brownout_off` (hysteresis, so the mode doesn't flap).
+
+Every request ends in a defined terminal status — "eos"/"length" (ok),
+"timeout" (deadline), "shed" (backpressure/brown-out), "rejected"
+(malformed), or "error" (retry budget exhausted) — the chaos tests'
+none-lost invariant. Time is injected (the schedulers' clock), so a
+FaultPlan replay on FakeClock replicas is bit-for-bit deterministic.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import heapq
+from typing import Dict, List, Optional, Sequence
+
+from ddp_practice_tpu.serve.engine import EngineConfig, SlotEngine
+from ddp_practice_tpu.serve.faults import FaultPlan, ReplicaCrashed
+from ddp_practice_tpu.serve.health import (
+    BreakerConfig,
+    HealthState,
+    ReplicaHealth,
+)
+from ddp_practice_tpu.serve.metrics import RouterMetrics, ServeMetrics
+from ddp_practice_tpu.serve.scheduler import (
+    Completion,
+    MonotonicClock,
+    Request,
+    Scheduler,
+)
+from ddp_practice_tpu.utils.backoff import backoff_delay
+from ddp_practice_tpu.utils.metrics import MetricsRegistry
+
+
+@dataclasses.dataclass(frozen=True)
+class RouterConfig:
+    # ---- retry budget (per request, for "error" completions)
+    max_retries: int = 2
+    retry_base_s: float = 0.02
+    retry_factor: float = 2.0
+    retry_max_s: float = 1.0
+    retry_jitter: float = 0.5
+    # stamped as Request.deadline when the client set none (None = no
+    # per-request timeout)
+    request_timeout_s: Optional[float] = None
+    # ---- circuit breaker (consecutive "error"s; crashes trip instantly)
+    trip_after: int = 3
+    probe_base_s: float = 0.05
+    probe_factor: float = 2.0
+    probe_max_s: float = 5.0
+    probe_jitter: float = 0.0
+    # ---- brown-out (fleet pressure = (active + queued) / total slots)
+    brownout_on: float = 1.5
+    brownout_off: float = 0.75
+    brownout_max_new: int = 16
+    # priority classes >= this are shed while browned out (0 =
+    # interactive traffic, never brown-out shed)
+    shed_priority: int = 1
+    # jitter seed root: per-request retry jitter folds in the rid, per-
+    # replica probe jitter folds in the replica id — deterministic replay,
+    # de-synchronized fleet
+    seed: int = 0
+
+
+@dataclasses.dataclass
+class _Tracked:
+    """Router-side lifecycle of one client request across attempts."""
+
+    req: Request
+    budget: int                 # max_new_tokens after any brown-out cap
+    prefix: List[int] = dataclasses.field(default_factory=list)
+    first_token_time: Optional[float] = None
+    retries: int = 0            # error retries consumed (bounded)
+    failovers: int = 0          # crash migrations (not budget-bounded)
+    done: bool = False
+
+
+class ReplicaHandle:
+    """One replica: engine + scheduler + gauges + health, as the router
+    sees it. The scheduler/engine pair is exactly the PR-1 single-replica
+    serving stack — the router composes, it does not reimplement."""
+
+    def __init__(self, rid: int, scheduler: Scheduler,
+                 breaker: BreakerConfig) -> None:
+        self.id = rid
+        self.scheduler = scheduler
+        self.engine: SlotEngine = scheduler.engine
+        self.health = ReplicaHealth(breaker)
+        self.consumed = 0  # completions watermark (survives restarts)
+
+    @property
+    def load(self) -> float:
+        """Least-loaded dispatch signal: queue depth + occupied slots,
+        read from the replica's ServeMetrics gauges (the ROADMAP's
+        'metrics gauges are the routing signals'); falls back to direct
+        scheduler state when the replica carries no metrics."""
+        m = self.scheduler.metrics
+        slots = self.engine.config.max_slots
+        if m is not None:
+            return m.queue_depth.value + m.slot_occupancy.value * slots
+        return len(self.scheduler.queue) + self.engine.num_active
+
+    @property
+    def has_queue_space(self) -> bool:
+        return len(self.scheduler.queue) < self.scheduler.max_queue
+
+    def probe_ok(self, now: float) -> bool:
+        """Half-open probe: is the replica reachable again? With an
+        injected fault plan the answer is the plan's crash window; a
+        replica that crashed for real (no injector) is assumed
+        restartable — in-process, restart() rebuilds its device state."""
+        inj = self.scheduler.fault_hook
+        return inj is None or inj.alive(now)
+
+    def restart(self) -> None:
+        """Bring a probed-alive replica back: free every slot, rewind
+        the pool clock. The scheduler's queue/running were already
+        evacuated at death; its completions list (and our watermark)
+        survive so no completion is double-consumed."""
+        eng = self.engine
+        for slot in list(eng.allocator.used_slots()):
+            eng.release(slot)
+        eng.reset_epoch()
+        inj = self.scheduler.fault_hook
+        if inj is not None:
+            inj.revive()
+
+
+class Router:
+    """Least-loaded, health-checked dispatch over a replica fleet."""
+
+    def __init__(self, schedulers: Sequence[Scheduler], *, clock=None,
+                 config: RouterConfig = RouterConfig(),
+                 metrics: Optional[RouterMetrics] = None) -> None:
+        if not schedulers:
+            raise ValueError("need at least one replica")
+        self.clock = clock or schedulers[0].clock
+        self.config = config
+        self.metrics = metrics or RouterMetrics()
+        self.handles = [
+            ReplicaHandle(i, s, BreakerConfig(
+                trip_after=config.trip_after,
+                probe_base_s=config.probe_base_s,
+                probe_factor=config.probe_factor,
+                probe_max_s=config.probe_max_s,
+                probe_jitter=config.probe_jitter,
+                seed=config.seed + i,
+            ))
+            for i, s in enumerate(schedulers)
+        ]
+        self.tracked: Dict[int, _Tracked] = {}
+        self.completions: List[Completion] = []
+        self.brownout = False
+        self._pending = 0
+        self._retry_q: List[tuple] = []  # (ready_at, seq, rid) heap
+        self._retry_seq = 0
+        for h in self.handles:
+            self.metrics.on_replica_state(h.id, h.health.state.value)
+
+    # ------------------------------------------------------------ intake
+    def submit(self, req: Request) -> bool:
+        """Route one request; False = terminal at the door (shed or
+        rejected — a completion exists either way, never silence)."""
+        if req.arrival is None:
+            req.arrival = self.clock.now()
+        if req.rid in self.tracked:
+            raise ValueError(f"duplicate rid {req.rid}")
+        cfg = self.config
+        if req.deadline is None and cfg.request_timeout_s is not None:
+            req.deadline = req.arrival + cfg.request_timeout_s
+        self.metrics.submitted.inc()
+        budget = req.max_new_tokens
+        if req.max_new_tokens < 1:
+            # malformed beats browned-out: "rejected" is terminal advice
+            # (never resubmit), "shed" invites a retry that can only fail
+            self._finalize(self._track(req, budget), [], "rejected")
+            return False
+        if self.brownout:
+            if req.priority >= cfg.shed_priority:
+                tr = self._track(req, budget)
+                self._finalize(tr, [], "shed")
+                self.metrics.on_shed("brownout")
+                return False
+            budget = min(budget, cfg.brownout_max_new)
+        tr = self._track(req, budget)
+        if not self._dispatch(tr):
+            self._finalize(tr, [], "shed")
+            self.metrics.on_shed(
+                "no_replica" if not self._alive() else "fleet_full"
+            )
+            return False
+        return True
+
+    def _track(self, req: Request, budget: int) -> _Tracked:
+        tr = _Tracked(req=req, budget=budget)
+        self.tracked[req.rid] = tr
+        self._pending += 1
+        return tr
+
+    # ---------------------------------------------------------- dispatch
+    def _alive(self) -> List[ReplicaHandle]:
+        return [h for h in self.handles if h.health.alive]
+
+    def _dispatch(self, tr: _Tracked) -> bool:
+        """Place (or re-place) a tracked request on the best replica.
+        False = nowhere to put it right now (caller sheds or requeues)."""
+        remaining = tr.budget - len(tr.prefix)
+        if remaining <= 0:
+            # a migrated request that already produced its whole budget
+            self._finalize(tr, list(tr.prefix), "length",
+                           tr.first_token_time)
+            return True
+        cands = [h for h in self._alive() if h.has_queue_space]
+        if not cands:
+            return False
+        # HEALTHY before DEGRADED, then least-loaded, then stable id
+        h = min(cands, key=lambda h: (
+            h.health.state is HealthState.DEGRADED, h.load, h.id,
+        ))
+        req = tr.req
+        if tr.prefix:
+            try:
+                h.engine.bucket_for(len(req.prompt) + len(tr.prefix))
+            except ValueError:
+                # prompt+prefix outgrew every prefill bucket (a long
+                # generation migrated late): drop the salvage and
+                # regenerate from the original prompt — it fit once, it
+                # fits again, and a deterministic decode reproduces the
+                # same tokens (the per-request PRNG chain restarts from
+                # the request seed). Recompute beats a lost request.
+                tr.prefix = []
+                remaining = tr.budget
+        sub = Request(
+            rid=req.rid,
+            # failover/retry resume: the tokens already produced ARE the
+            # continuation — re-admitting prompt+prefix as a fresh
+            # prefill reproduces the remaining tokens exactly under
+            # greedy decoding
+            prompt=list(req.prompt) + list(tr.prefix),
+            max_new_tokens=remaining,
+            deadline=req.deadline,
+            seed=req.seed,
+            arrival=req.arrival,
+            priority=req.priority,
+        )
+        h.scheduler.submit(sub)
+        return True
+
+    def _requeue(self, tr: _Tracked, delay_s: float) -> None:
+        now = self.clock.now()
+        deadline = tr.req.deadline
+        if deadline is not None and now + delay_s > deadline:
+            self._finalize(tr, list(tr.prefix), "timeout",
+                           tr.first_token_time)
+            return
+        self._retry_seq += 1
+        heapq.heappush(
+            self._retry_q, (now + delay_s, self._retry_seq, tr.req.rid)
+        )
+
+    # ----------------------------------------------------------- the tick
+    def step(self) -> List[Completion]:
+        """One fleet tick: probe dead replicas, step the live ones
+        (crashes trigger failover), consume completions (errors retry),
+        drain due retries, update brown-out. Returns the client
+        completions finalized during this tick."""
+        before = len(self.completions)
+        t_start = self.clock.now()
+        self._probe_dead()
+        for h in self.handles:
+            if not h.health.alive:
+                continue
+            try:
+                h.scheduler.step()
+            except ReplicaCrashed:
+                self._kill(h)
+        for h in self.handles:
+            self._consume(h)
+        self._drain_retries()
+        self._update_brownout()
+        if self.clock.now() == t_start:
+            # nothing decoded this tick (fleet idle/dead): advance
+            # virtual time anyway so retry backoffs and probe timers can
+            # ever come due under FakeClock (no-op on the real clock)
+            self.clock.tick()
+        return self.completions[before:]
+
+    def _probe_dead(self) -> None:
+        now = self.clock.now()
+        for h in self.handles:
+            if h.health.alive or not h.health.probe_due(now):
+                continue
+            ok = h.probe_ok(now)
+            h.health.on_probe(ok, now)
+            if ok:
+                h.restart()
+            self.metrics.on_replica_state(h.id, h.health.state.value)
+
+    def _kill(self, h: ReplicaHandle) -> None:
+        """Replica death: trip the breaker and migrate everything it
+        held — in-flight requests resume from their salvaged tokens."""
+        now = self.clock.now()
+        h.health.mark_dead(now)
+        self.metrics.breaker_trips.inc()
+        self.metrics.on_replica_state(h.id, h.health.state.value)
+        for req, tokens, ftt in h.scheduler.evacuate():
+            tr = self.tracked.get(req.rid)
+            if tr is None or tr.done:
+                continue
+            tr.prefix.extend(tokens)
+            if tr.first_token_time is None:
+                tr.first_token_time = ftt
+            tr.failovers += 1
+            self.metrics.failovers.inc()
+            if not self._dispatch(tr):
+                self._park_or_shed(tr)
+
+    def _consume(self, h: ReplicaHandle) -> None:
+        comps = h.scheduler.completions
+        new, h.consumed = comps[h.consumed:], len(comps)
+        now = self.clock.now()
+        for c in new:
+            tr = self.tracked.get(c.rid)
+            if tr is None or tr.done:
+                continue  # e.g. brown-out sheds already finalized
+            if tr.first_token_time is None and c.ttft is not None:
+                tr.first_token_time = tr.req.arrival + c.ttft
+            if c.status in ("eos", "length"):
+                h.health.mark_success()
+                self._finalize(tr, tr.prefix + c.tokens, c.status,
+                               tr.first_token_time)
+            elif c.status == "timeout":
+                self._finalize(tr, tr.prefix + c.tokens, "timeout",
+                               tr.first_token_time)
+            elif c.status == "rejected":
+                # malformed for this engine config (prompt over every
+                # bucket / budget over the pool): identical replicas
+                # would all reject it — not retryable
+                self._finalize(tr, list(tr.prefix), "rejected")
+            else:  # "error" (and the defensive "shed" path): retryable
+                if h.health.mark_failure(now):
+                    self._kill(h)  # trip: migrate the rest of its work
+                self.metrics.on_replica_state(h.id, h.health.state.value)
+                tr.prefix.extend(c.tokens)
+                if tr.retries >= self.config.max_retries:
+                    self._finalize(tr, list(tr.prefix), "error",
+                                   tr.first_token_time)
+                    continue
+                tr.retries += 1
+                self.metrics.retries.inc()
+                cfg = self.config
+                self._requeue(tr, backoff_delay(
+                    tr.retries - 1, base_s=cfg.retry_base_s,
+                    factor=cfg.retry_factor, max_s=cfg.retry_max_s,
+                    jitter=cfg.retry_jitter, seed=cfg.seed + c.rid,
+                ))
+
+    def _drain_retries(self) -> None:
+        now = self.clock.now()
+        while self._retry_q and self._retry_q[0][0] <= now:
+            _, _, rid = heapq.heappop(self._retry_q)
+            tr = self.tracked.get(rid)
+            if tr is None or tr.done:
+                continue
+            deadline = tr.req.deadline
+            if deadline is not None and now > deadline:
+                self._finalize(tr, list(tr.prefix), "timeout",
+                               tr.first_token_time)
+                continue
+            if not self._dispatch(tr):
+                # still nowhere to go: shed or park, then stop draining
+                # (the fleet state won't change within this tick)
+                self._park_or_shed(tr)
+                break
+
+    def _park_or_shed(self, tr: _Tracked) -> None:
+        """A request with nowhere to run: queues full on a live fleet is
+        TRANSIENT (they drain as decode proceeds — park it for one
+        backoff), but a fleet with no alive replica gets the same answer
+        the front door gives (submit): an immediate terminal shed. The
+        fast no keeps the none-lost invariant even when every replica is
+        permanently dead — parking there would cycle the retry heap
+        forever and hang run_until_idle / the bench loop."""
+        if not self._alive():
+            self._finalize(tr, list(tr.prefix), "shed")
+            self.metrics.on_shed("no_replica")
+        else:
+            self._requeue(tr, self.config.retry_base_s)
+
+    # --------------------------------------------------------- brown-out
+    def _update_brownout(self) -> None:
+        cfg = self.config
+        alive = self._alive()
+        slots = sum(h.engine.config.max_slots for h in alive)
+        work = sum(
+            len(h.scheduler.queue) + h.engine.num_active for h in alive
+        )
+        pressure = (work / slots) if slots else float("inf")
+        self.metrics.fleet_pressure.set(min(pressure, 1e9))
+        if not self.brownout and pressure >= cfg.brownout_on:
+            self.brownout = True
+            self.metrics.brownout_active.set(1)
+            # shed low-priority WAITERS too, not just new arrivals — the
+            # queue backlog is exactly the overload being answered
+            for h in alive:
+                for req in h.scheduler.shed_queued(
+                    lambda r: r.priority >= cfg.shed_priority
+                ):
+                    tr = self.tracked.get(req.rid)
+                    if tr is not None and not tr.done:
+                        self._finalize(tr, list(tr.prefix), "shed")
+                        self.metrics.on_shed("brownout")
+                # the sheds just appended sub-completions we have already
+                # accounted for — advance the watermark NOW, or next
+                # tick's _consume would replay them against whatever
+                # request is tracked under the rid by then (the rid may
+                # have been reused after _finalize dropped it)
+                h.consumed = len(h.scheduler.completions)
+        elif self.brownout and pressure <= cfg.brownout_off:
+            self.brownout = False
+            self.metrics.brownout_active.set(0)
+
+    # ---------------------------------------------------------- finalize
+    def _finalize(self, tr: _Tracked, tokens: List[int], status: str,
+                  first_token_time: Optional[float] = None) -> Completion:
+        now = self.clock.now()
+        req = tr.req
+        ttft = tpot = None
+        if first_token_time is not None:
+            ttft = first_token_time - req.arrival
+            if len(tokens) > 1:
+                tpot = (now - first_token_time) / (len(tokens) - 1)
+        c = Completion(
+            rid=req.rid, tokens=tokens, status=status,
+            arrival=req.arrival, finish=now, ttft=ttft, tpot=tpot,
+        )
+        tr.done = True
+        self._pending -= 1
+        # drop the tracking entry so live state stays O(in-flight) and
+        # rids may be reused; late sub-completions for this rid just miss
+        # the lookup and are skipped. (self.completions keeps the result
+        # history — the same accumulate-and-consume contract as
+        # Scheduler.completions; a drain API is recorded follow-up.)
+        self.tracked.pop(req.rid, None)
+        self.completions.append(c)
+        self.metrics.on_finalize(c)
+        return c
+
+    # ------------------------------------------------------------- misc
+    @property
+    def idle(self) -> bool:
+        return self._pending == 0
+
+    def run_until_idle(self, max_ticks: int = 100_000) -> List[Completion]:
+        for _ in range(max_ticks):
+            if self.idle:
+                return self.completions
+            self.step()
+        raise RuntimeError(f"not idle after {max_ticks} ticks")
+
+    def warmup(self, widths: Optional[Sequence[int]] = None) -> None:
+        """Compile each replica's programs outside any timed/traced
+        window: one admit per bucket width in play + one decode burst.
+        After this, request churn (and failover re-prefills, which land
+        in the same buckets) causes zero new compiles — the chaos tests
+        pin that via compile_stats()."""
+        for h in self.handles:
+            eng = h.engine
+            for w in widths or eng.buckets:
+                slot = eng.admit([1] * w)
+                eng.step_burst()
+                eng.release(slot)
+            eng.reset_epoch()
+
+    def compile_stats(self) -> Dict[int, dict]:
+        return {h.id: h.engine.compile_stats() for h in self.handles}
+
+    def states(self) -> Dict[int, str]:
+        return {h.id: h.health.state.value for h in self.handles}
+
+
+def make_router(
+    model,
+    params,
+    n_replicas: int,
+    engine_config: EngineConfig,
+    *,
+    clock=None,
+    max_queue: int = 64,
+    config: RouterConfig = RouterConfig(),
+    fault_plan: Optional[FaultPlan] = None,
+    registry: Optional[MetricsRegistry] = None,
+    batch_stats=None,
+) -> Router:
+    """Build a fleet of identical replicas (replicated params — the
+    sharded-params variant is ROADMAP follow-up) on one shared clock,
+    each with its own ServeMetrics (the routing gauges) and, when a
+    FaultPlan targets it, its own deterministic injector."""
+    if n_replicas < 1:
+        raise ValueError("n_replicas must be >= 1")
+    clock = clock or MonotonicClock()
+    schedulers = []
+    for i in range(n_replicas):
+        engine = SlotEngine(
+            model, params, engine_config, batch_stats=batch_stats
+        )
+        schedulers.append(Scheduler(
+            engine, clock=clock, max_queue=max_queue,
+            metrics=ServeMetrics(),
+            fault_hook=fault_plan.injector(i) if fault_plan else None,
+        ))
+    return Router(
+        schedulers, clock=clock, config=config,
+        metrics=RouterMetrics(registry),
+    )

@@ -79,13 +79,21 @@ class Request:
     # trace and may poll arrivals a tick late; latency must not quietly
     # exclude that wait
     arrival: Optional[float] = None
+    # priority class: 0 = interactive (never brown-out shed), larger =
+    # more sheddable. The single-replica scheduler serves FIFO regardless
+    # — priority is the ROUTER's degradation signal (serve/router.py
+    # sheds priority >= its threshold while browned out).
+    priority: int = 0
 
 
 @dataclasses.dataclass
 class Completion:
     rid: int
     tokens: List[int]
-    # "eos" | "length" | "timeout" | "shed" | "rejected"
+    # "eos" | "length" | "timeout" | "shed" | "rejected" | "error"
+    # ("error" = non-finite logits or an injected/transient engine
+    # failure: the tokens already produced are VALID — they were sampled
+    # from finite logits — so a router can re-admit prompt+tokens)
     status: str
     arrival: float
     finish: float
@@ -105,11 +113,14 @@ class Scheduler:
     """FIFO continuous-batching scheduler over one SlotEngine."""
 
     def __init__(self, engine: SlotEngine, *, clock=None, max_queue: int = 64,
-                 metrics=None) -> None:
+                 metrics=None, fault_hook=None) -> None:
         self.engine = engine
         self.clock = clock or MonotonicClock()
         self.max_queue = max_queue
         self.metrics = metrics
+        # optional chaos hook (serve/faults.py FaultInjector): None in
+        # production — the only cost then is one `is not None` per tick
+        self.fault_hook = fault_hook
         self.queue: Deque[Request] = deque()
         self.running: Dict[int, _Running] = {}  # slot -> state
         self.completions: List[Completion] = []
@@ -189,23 +200,46 @@ class Scheduler:
                 else:
                     break  # drain the running batch first
             self.queue.popleft()
+            if self.fault_hook is not None \
+                    and self.fault_hook.take_admit_fault():
+                # injected transient admission failure (OOM-at-admit
+                # class): an "error" completion, so a router retries it
+                # on another replica instead of the client seeing silence
+                self._finish(req, [], "error")
+                continue
             slot = eng.admit(req.prompt, seed=req.seed)
             self.running[slot] = _Running(req=req, slot=slot)
 
     # ------------------------------------------------------------ the tick
     def step(self) -> List[Completion]:
         """One tick: expire -> admit -> decode -> release. Returns the
-        completions finalized during this tick."""
+        completions finalized during this tick. May raise
+        faults.ReplicaCrashed when a chaos plan kills this replica."""
+        if self.fault_hook is not None:
+            self.fault_hook.on_tick(self)
         before = len(self.completions)
         self._expire_queue()
         self._admit()
         if self.running:
             burst = self.engine.step_burst()  # (K, max_slots)
+            finite = self.engine.last_finite  # (K, max_slots)
             eos = self.engine.config.eos_id
-            for row in burst:
+            for k, row in enumerate(burst):
                 self.clock.tick()
                 now = self.clock.now()
                 for slot, st in list(self.running.items()):
+                    if not finite[k, slot]:
+                        # this row's token was sampled from non-finite
+                        # logits: poison ONE request, not the batch — the
+                        # tokens produced so far are valid (finite when
+                        # sampled), so a router can resume from them
+                        del self.running[slot]
+                        self.engine.release(slot)
+                        self._finish(
+                            st.req, st.tokens, "error",
+                            st.first_token_time,
+                        )
+                        continue
                     tok = int(row[slot])
                     st.tokens.append(tok)
                     if st.first_token_time is None:
@@ -233,6 +267,42 @@ class Scheduler:
         if self.metrics:
             self.metrics.on_tick(self)
         return self.completions[before:]
+
+    # ------------------------------------------------- fleet operations
+    def shed_queued(self, predicate) -> List[Request]:
+        """Shed queued (not yet admitted) requests matching `predicate`
+        — the brown-out lever: the router drops low-priority waiters
+        when fleet occupancy crosses its threshold. Each shed is a
+        normal "shed" completion (fast negative, not silence); the shed
+        requests are returned so the router can finalize them with the
+        right reason."""
+        kept: Deque[Request] = deque()
+        shed: List[Request] = []
+        for req in self.queue:
+            if predicate(req):
+                self._finish(req, [], "shed")
+                shed.append(req)
+            else:
+                kept.append(req)
+        self.queue = kept
+        return shed
+
+    def evacuate(self) -> List[tuple]:
+        """Pull every queued and in-flight request off this scheduler —
+        the failover harvest after a crash. Returns (request,
+        tokens_so_far, first_token_time) triples; tokens_so_far were
+        already read back to the host before the crash, so the router
+        can re-admit prompt+tokens on a surviving replica. Touches no
+        device state (the replica may be gone); `restart()` on the
+        handle resets the engine when the replica comes back."""
+        out = []
+        for st in self.running.values():
+            out.append((st.req, st.tokens, st.first_token_time))
+        for req in self.queue:
+            out.append((req, [], None))
+        self.running.clear()
+        self.queue.clear()
+        return out
 
     @property
     def idle(self) -> bool:

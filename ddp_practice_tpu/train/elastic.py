@@ -159,6 +159,12 @@ def run_with_restarts(
     *,
     max_restarts: int = 0,
     restart_delay_s: float = 0.0,
+    backoff_factor: float = 2.0,
+    max_delay_s: float = 300.0,
+    jitter: float = 0.5,
+    seed: int = 0,
+    metrics=None,
+    sleep: Callable[[float], None] = time.sleep,
 ):
     """Run `trainer.fit()` with checkpoint-based recovery.
 
@@ -166,7 +172,24 @@ def run_with_restarts(
     next one is built with resume=True so it restores the last checkpoint
     (requires a checkpoint_dir for recovery to actually shorten rework).
     Returns fit()'s summary. Re-raises after max_restarts failures.
+
+    The wait before attempt k is exponential with deterministic jitter —
+    `backoff_delay(k-1, base_s=restart_delay_s, ...)`, the same helper
+    the serving router's retry budget and the replica circuit breaker
+    use (utils/backoff.py) — so a fleet-wide failure does not restart
+    every host in lockstep against the same struggling storage or
+    rendezvous endpoint, yet a seeded test replays the exact schedule.
+    restart_delay_s=0 keeps the legacy immediate-restart behavior.
+    Restarts are counted in the metrics registry
+    (``train_restarts_total``), so a supervisor can tell one bad step
+    from a crash loop.
     """
+    from ddp_practice_tpu.utils.backoff import backoff_delay
+    from ddp_practice_tpu.utils.metrics import default_registry
+
+    restarts = (metrics or default_registry()).counter(
+        "train_restarts_total"
+    )
     attempt = 0
     while True:
         try:
@@ -178,10 +201,16 @@ def run_with_restarts(
             attempt += 1
             if attempt > max_restarts:
                 raise
+            restarts.inc()
+            delay = backoff_delay(
+                attempt - 1, base_s=restart_delay_s,
+                factor=backoff_factor, max_s=max_delay_s,
+                jitter=jitter, seed=seed,
+            ) if restart_delay_s else 0.0
             log.error(
                 "training attempt %d failed (%s: %s); restarting from last "
-                "checkpoint (%d/%d)",
-                attempt, type(e).__name__, e, attempt, max_restarts,
+                "checkpoint in %.2fs (%d/%d)",
+                attempt, type(e).__name__, e, delay, attempt, max_restarts,
             )
-            if restart_delay_s:
-                time.sleep(restart_delay_s)
+            if delay:
+                sleep(delay)

@@ -1,20 +1,25 @@
-"""Utilities: process-0 logging, metrics registry, timing, profiling."""
+"""Utilities: process-0 logging, metrics registry, backoff, timing,
+profiling."""
 
 from ddp_practice_tpu.utils.logging import (
     emit_metrics,
     get_logger,
     main_process_only,
 )
+from ddp_practice_tpu.utils.backoff import backoff_delay
 from ddp_practice_tpu.utils.metrics import (
     MetricsRegistry,
     default_registry,
+    labelled,
 )
 from ddp_practice_tpu.utils.timing import Timer
 from ddp_practice_tpu.utils.profiling import profile_region
 
 __all__ = [
+    "backoff_delay",
     "emit_metrics",
     "get_logger",
+    "labelled",
     "main_process_only",
     "MetricsRegistry",
     "default_registry",

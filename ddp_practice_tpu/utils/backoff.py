@@ -1,0 +1,47 @@
+"""Deterministic exponential backoff with jitter — one helper, three users.
+
+Retry loops live in three layers of the stack (train/elastic.py restart
+driver, serve/health.py circuit-breaker probes, serve/router.py request
+retries) and the failure mode of hand-rolled backoff is always the same:
+either no jitter (a killed fleet retries in lockstep — the thundering
+herd the jitter literature exists for) or non-reproducible jitter (a
+chaos test that passes or fails by the RNG's mood). This helper fixes
+both: delays grow geometrically and cap, and the jitter is a pure
+function of (seed, attempt) — same arguments, same delay, so a seeded
+fault-injection replay is bit-identical while distinct seeds (one per
+replica / per request) still de-synchronize the fleet.
+"""
+
+from __future__ import annotations
+
+import random
+
+
+def backoff_delay(
+    attempt: int,
+    *,
+    base_s: float,
+    factor: float = 2.0,
+    max_s: float = 60.0,
+    jitter: float = 0.5,
+    seed: int = 0,
+) -> float:
+    """Delay before retry number `attempt` (0-based): min(max_s, base_s *
+    factor**attempt) stretched by up to `jitter` fraction.
+
+    The jitter draw comes from a Random seeded with an integer mix of
+    (seed, attempt) — pure arithmetic, immune to PYTHONHASHSEED — so the
+    schedule is reproducible across processes and runs. jitter=0 gives
+    the bare geometric schedule.
+    """
+    if attempt < 0:
+        raise ValueError("attempt must be >= 0")
+    if base_s < 0 or factor < 1.0 or jitter < 0:
+        raise ValueError("need base_s >= 0, factor >= 1, jitter >= 0")
+    delay = min(max_s, base_s * factor ** attempt)
+    if jitter and delay:
+        # Knuth multiplicative mix keeps nearby (seed, attempt) pairs
+        # from drawing correlated jitter
+        mix = seed * 2_654_435_761 + attempt
+        delay *= 1.0 + jitter * random.Random(mix).random()
+    return min(delay, max_s * (1.0 + jitter))

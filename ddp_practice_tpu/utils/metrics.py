@@ -21,6 +21,20 @@ import threading
 from typing import Dict, Iterable, Optional
 
 
+def labelled(name: str, **labels) -> str:
+    """Render a labelled metric name: ``labelled("x", r="a")`` -> ``x{r=a}``.
+
+    The registry keys metrics by flat string name; per-replica and
+    per-reason families (router breaker state, sheds-by-reason) need one
+    metric per label value. Labels render sorted, so the same label set
+    always produces the same name however the caller spells the kwargs.
+    """
+    if not labels:
+        return name
+    inner = ",".join(f"{k}={labels[k]}" for k in sorted(labels))
+    return f"{name}{{{inner}}}"
+
+
 class Counter:
     """Monotonic count (requests served, tokens emitted, sheds)."""
 

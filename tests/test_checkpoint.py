@@ -116,6 +116,38 @@ def test_retention_keeps_last_k(tmp_path):
     assert man["extra"]["step"] == 5
 
 
+def test_restore_falls_back_past_truncated_manifest(tmp_path):
+    """A manifest torn mid-write (exists but parse-fails — e.g. power
+    loss after a rename of an older layout) must not strand the run:
+    restore and latest_manifest fall back to the previous COMPLETE
+    checkpoint instead of dying on the corrupt newest one."""
+    state = _state()
+    d = str(tmp_path / "ck")
+    ckpt.save(d, state, step=1)
+    import dataclasses
+
+    bumped = dataclasses.replace(state, step=state.step + 1)
+    ckpt.save(d, bumped, step=2)
+    # truncate step-2's manifest mid-stream: present, but invalid JSON
+    man2 = tmp_path / "ck" / "step_2" / "manifest.json"
+    man2.write_bytes(man2.read_bytes()[: len(man2.read_bytes()) // 2])
+    assert ckpt.exists(d)
+    assert ckpt.latest_manifest(d)["extra"]["step"] == 1
+    restored = ckpt.restore(d, jax.eval_shape(lambda: state))
+    np.testing.assert_array_equal(
+        np.asarray(restored.step), np.asarray(state.step)
+    )
+    # an EMPTY manifest (0 bytes flushed) is the same failure class
+    man2.write_bytes(b"")
+    assert ckpt.latest_manifest(d)["extra"]["step"] == 1
+    # and with every manifest corrupt there is no checkpoint — loud, not
+    # a half-parsed resume
+    man1 = tmp_path / "ck" / "step_1" / "manifest.json"
+    man1.write_bytes(b'{"schema_version": 2, "paths": [')
+    with pytest.raises(FileNotFoundError):
+        ckpt.restore(d, jax.eval_shape(lambda: state))
+
+
 def test_restore_ignores_torn_dir(tmp_path):
     """A directory from a crashed rename-less writer (leaves without
     manifest) is never selected."""

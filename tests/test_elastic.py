@@ -100,6 +100,58 @@ def test_run_with_restarts_retries_then_succeeds():
     assert calls == [False, True, True]  # first cold, retries resume
 
 
+def test_run_with_restarts_backoff_and_counter():
+    """Restart delays follow the shared deterministic backoff schedule
+    (utils/backoff.py) and each restart bumps train_restarts_total."""
+    from ddp_practice_tpu.utils.backoff import backoff_delay
+    from ddp_practice_tpu.utils.metrics import MetricsRegistry
+
+    calls, slept = [], []
+    registry = MetricsRegistry()
+
+    class Flaky:
+        def __init__(self, resume):
+            pass
+
+        def fit(self):
+            calls.append(1)
+            if len(calls) < 4:
+                raise RuntimeError("injected")
+            return {"ok": True}
+
+    out = run_with_restarts(
+        Flaky, max_restarts=3, restart_delay_s=0.1, jitter=0.5, seed=5,
+        metrics=registry, sleep=slept.append,
+    )
+    assert out["ok"] and len(calls) == 4
+    want = [
+        backoff_delay(i, base_s=0.1, factor=2.0, max_s=300.0,
+                      jitter=0.5, seed=5)
+        for i in range(3)
+    ]
+    assert slept == want          # deterministic schedule, replayable
+    assert want[0] < want[1] < want[2]  # and actually growing
+    assert registry.counter("train_restarts_total").value == 3
+
+
+def test_run_with_restarts_zero_delay_never_sleeps():
+    """restart_delay_s=0 keeps the legacy immediate-restart path."""
+    calls, slept = [], []
+
+    class FailOnce:
+        def __init__(self, resume):
+            pass
+
+        def fit(self):
+            calls.append(1)
+            if len(calls) < 2:
+                raise RuntimeError("injected")
+            return {"ok": True}
+
+    out = run_with_restarts(FailOnce, max_restarts=1, sleep=slept.append)
+    assert out["ok"] and slept == []
+
+
 def test_run_with_restarts_exhausts():
     class AlwaysFails:
         def __init__(self, resume):

@@ -1,0 +1,179 @@
+"""Host-pure fault-tolerance machinery: backoff, breaker, fault plans.
+
+No model, no engine — these pin the deterministic substrate the chaos
+tests (test_serve_router.py) build on: the shared backoff helper is a
+pure function of (seed, attempt), the circuit breaker trips/probes on
+an injected clock, and a FaultPlan round-trips through JSON and fires
+its specs at exactly the planned ticks.
+"""
+
+import math
+
+import pytest
+
+from ddp_practice_tpu.serve.faults import (
+    FaultInjector,
+    FaultPlan,
+    FaultSpec,
+    ReplicaCrashed,
+)
+from ddp_practice_tpu.serve.health import (
+    BreakerConfig,
+    CircuitBreaker,
+    HealthState,
+    ReplicaHealth,
+)
+from ddp_practice_tpu.serve.scheduler import FakeClock
+from ddp_practice_tpu.utils.backoff import backoff_delay
+from ddp_practice_tpu.utils.metrics import labelled
+
+
+# ---------------------------------------------------------------- backoff
+@pytest.mark.fast
+def test_backoff_deterministic_and_capped():
+    a = [backoff_delay(i, base_s=0.1, factor=2.0, max_s=1.0, jitter=0.5,
+                       seed=7) for i in range(8)]
+    b = [backoff_delay(i, base_s=0.1, factor=2.0, max_s=1.0, jitter=0.5,
+                       seed=7) for i in range(8)]
+    assert a == b  # same (seed, attempt) -> same delay, always
+    # geometric growth below the cap: the un-jittered floor doubles
+    for i in range(3):
+        assert a[i + 1] > a[i]
+    # cap holds (jitter may stretch at most (1 + jitter) * max_s)
+    assert all(d <= 1.0 * 1.5 for d in a)
+    # different seeds de-synchronize (the thundering-herd fix)
+    c = backoff_delay(3, base_s=0.1, jitter=0.5, seed=8)
+    assert c != a[3]
+
+
+def test_backoff_no_jitter_is_exact():
+    assert backoff_delay(0, base_s=0.5, jitter=0.0) == 0.5
+    assert backoff_delay(2, base_s=0.5, factor=2.0, jitter=0.0) == 2.0
+    assert backoff_delay(10, base_s=0.5, max_s=3.0, jitter=0.0) == 3.0
+    with pytest.raises(ValueError):
+        backoff_delay(-1, base_s=0.5)
+
+
+# ---------------------------------------------------------------- breaker
+@pytest.mark.fast
+def test_breaker_trips_after_consecutive_failures():
+    br = CircuitBreaker(BreakerConfig(trip_after=3, probe_base_s=0.1,
+                                      probe_jitter=0.0))
+    assert not br.record_failure(0.0)
+    br.record_success()  # reset: failures must be CONSECUTIVE
+    assert not br.record_failure(1.0)
+    assert not br.record_failure(2.0)
+    assert br.record_failure(3.0)  # third consecutive -> trip
+    assert br.open and br.trips == 1
+    # probe schedule: not before base backoff, due after
+    assert not br.probe_due(3.05)
+    assert br.probe_due(3.1)
+
+
+def test_breaker_probe_backoff_doubles_then_closes():
+    br = CircuitBreaker(BreakerConfig(trip_after=1, probe_base_s=0.1,
+                                      probe_factor=2.0, probe_jitter=0.0))
+    br.record_failure(0.0)
+    assert br.probe_due(0.1)
+    br.on_probe(False, 0.1)        # failed probe: wait doubles
+    assert not br.probe_due(0.25)  # next probe at 0.1 + 0.2
+    assert br.probe_due(0.31)
+    br.on_probe(True, 0.31)        # half-open success closes
+    assert not br.open and br.consecutive_failures == 0
+
+
+@pytest.mark.fast
+def test_health_state_transitions():
+    h = ReplicaHealth(BreakerConfig(trip_after=2, probe_base_s=0.1,
+                                    probe_jitter=0.0))
+    assert h.state is HealthState.HEALTHY and h.alive
+    h.mark_failure(0.0)
+    assert h.state is HealthState.DEGRADED and h.alive
+    h.mark_success()
+    assert h.state is HealthState.HEALTHY
+    h.mark_dead(1.0)  # crash path: instant DEAD, no failure count needed
+    assert h.state is HealthState.DEAD and not h.alive
+    h.on_probe(True, 2.0)
+    assert h.state is HealthState.HEALTHY
+
+
+# ------------------------------------------------------------ fault plans
+@pytest.mark.fast
+def test_fault_plan_json_roundtrip():
+    plan = FaultPlan([
+        FaultSpec(kind="crash", tick=5, replica=0, down_s=0.5),
+        FaultSpec(kind="nan_logits", tick=3, replica=1, slot=2),
+        FaultSpec(kind="latency", tick=2, replica=1, delay_s=0.25),
+        FaultSpec(kind="admit_fail", tick=4, replica=0),
+    ])
+    plan2 = FaultPlan.from_json(plan.to_json())
+    assert plan2.faults == plan.faults
+    # bare-list schema also accepted
+    plan3 = FaultPlan.from_json('[{"kind": "crash", "tick": 1}]')
+    assert plan3.faults == [FaultSpec(kind="crash", tick=1)]
+    with pytest.raises(ValueError):
+        FaultSpec(kind="meteor", tick=1)
+    with pytest.raises(ValueError):
+        FaultSpec(kind="crash", tick=0)  # ticks are 1-based
+    # replicas without faults get no injector (zero scheduler overhead)
+    assert plan.injector(2) is None
+    assert plan.injector(0) is not None
+
+
+class _StubEngine:
+    def __init__(self):
+        self.poisoned = []
+
+    def poison_slot(self, slot):
+        self.poisoned.append(slot)
+
+
+class _StubScheduler:
+    def __init__(self, clock):
+        self.clock = clock
+        self.engine = _StubEngine()
+
+
+def test_injector_fires_specs_at_planned_ticks():
+    clock = FakeClock(step_s=0.01)
+    sched = _StubScheduler(clock)
+    inj = FaultPlan([
+        FaultSpec(kind="latency", tick=2, delay_s=1.0),
+        FaultSpec(kind="nan_logits", tick=3, slot=1),
+        FaultSpec(kind="admit_fail", tick=4),
+        FaultSpec(kind="crash", tick=5, down_s=2.0),
+    ]).injector(0)
+    inj.on_tick(sched)                      # tick 1: nothing
+    assert clock.now() == 0.0 and not sched.engine.poisoned
+    inj.on_tick(sched)                      # tick 2: virtual stall
+    assert clock.now() == 1.0
+    inj.on_tick(sched)                      # tick 3: poison slot 1
+    assert sched.engine.poisoned == [1]
+    assert not inj.take_admit_fault()       # not scheduled yet
+    inj.on_tick(sched)                      # tick 4: one admit failure
+    assert inj.take_admit_fault()
+    assert not inj.take_admit_fault()       # consumed
+    with pytest.raises(ReplicaCrashed):
+        inj.on_tick(sched)                  # tick 5: crash, down 2s
+    assert not inj.alive(clock.now())
+    assert inj.alive(clock.now() + 2.0)     # probeable after the window
+    inj.revive()
+    assert inj.alive(clock.now())
+
+
+def test_injector_permanent_crash():
+    inj = FaultPlan([FaultSpec(kind="crash", tick=1)]).injector(0)
+    with pytest.raises(ReplicaCrashed):
+        inj.on_tick(_StubScheduler(FakeClock()))
+    assert inj.crashed_until == math.inf
+    assert not inj.alive(1e12)
+
+
+# ----------------------------------------------------------- metric names
+@pytest.mark.fast
+def test_labelled_metric_names():
+    assert labelled("x") == "x"
+    assert labelled("serve_sheds_total", reason="brownout") == \
+        "serve_sheds_total{reason=brownout}"
+    # label order is canonical however kwargs are spelled
+    assert labelled("m", b=1, a=2) == labelled("m", a=2, b=1) == "m{a=2,b=1}"

@@ -73,11 +73,16 @@ def test_emit_metrics_process0_gate(devices, monkeypatch, caplog):
     """Process 0 emits one line; any other process index emits nothing."""
     import jax
 
-    logger = get_logger("ddp_practice_tpu.serve.test_gate")
+    # a name OUTSIDE the package hierarchy: get_logger("ddp_practice_tpu")
+    # (created at import by train/elastic.py and friends) sets
+    # propagate=False, so a child like ddp_practice_tpu.serve.* would
+    # have its records swallowed at that parent before caplog's root
+    # handler — whenever any train test is merely COLLECTED in the same
+    # session, this test would flake on hierarchy, not on the gate
+    logger = get_logger("serve_test_gate")
     logger.propagate = True  # let caplog's root handler see it
 
-    with caplog.at_level(logging.INFO,
-                         logger="ddp_practice_tpu.serve.test_gate"):
+    with caplog.at_level(logging.INFO, logger="serve_test_gate"):
         monkeypatch.setattr(jax, "process_index", lambda: 0)
         line = emit_metrics({"serve_tokens_total": 5}, logger)
         assert line.startswith("metrics ")
