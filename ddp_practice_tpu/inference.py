@@ -79,6 +79,8 @@ def decode_apply(
     *,
     attn_start=None,
     batch_stats: Any = None,
+    page_table=None,
+    kv_lengths=None,
 ) -> tuple:
     """One decode-mode model application: `(new_cache, logits)`.
 
@@ -89,16 +91,26 @@ def decode_apply(
     share the exact apply (and therefore the exact logits): the cache
     collection threads through functionally, the write cursor advances by
     `tokens.shape[1]`, and `attn_start` masks left padding per sequence.
+
+    `page_table` + `kv_lengths` switch the cache to the PAGED layout
+    (serve/kv_pages.py): `cache` holds block pools instead of per-row
+    buffers, each sequence writes/attends at its own slot-local position
+    (kv_lengths), and there is no shared cursor — `attn_start` then masks
+    in slot-local coordinates.
     """
     variables = {"params": params, "cache": cache}
     if batch_stats is not None:
         variables["batch_stats"] = batch_stats
+    kwargs = {}
+    if page_table is not None:
+        kwargs = {"page_table": page_table, "kv_lengths": kv_lengths}
     logits, mut = model.apply(
         variables,
         tokens,
         decode=True,
         mutable=["cache"],
         attn_start=attn_start,
+        **kwargs,
     )
     return mut["cache"], logits
 

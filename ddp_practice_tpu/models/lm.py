@@ -101,7 +101,7 @@ class TransformerLM(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, *, train: bool = False, decode: bool = False,
-                 attn_start=None):
+                 attn_start=None, page_table=None, kv_lengths=None):
         """tokens (batch, seq) int32 -> logits (batch, seq, vocab) in the
         policy compute dtype (consumers upcast — see the return comment).
 
@@ -118,7 +118,21 @@ class TransformerLM(nn.Module):
         rotary scores depend only on relative offsets, so a uniform left
         shift is invisible; a learned absolute table would silently
         misplace every real token, so that combination raises.
+
+        `page_table` (b, max_blocks_per_slot) + `kv_lengths` (b,) int32,
+        decode-only: paged KV-cache mode (serve/kv_pages.py) — the cache
+        collection holds a pool of fixed-size blocks, each sequence
+        writes/attends at its OWN slot-local position through its page
+        table row, and attn_start/positions are slot-local. Requires
+        pos_emb="rope" (per-slot offsets) and s == 1.
         """
+        if page_table is not None and self.pos_emb != "rope":
+            raise ValueError(
+                "paged decode needs pos_emb='rope' — per-slot positions "
+                "require relative position encoding"
+            )
+        if page_table is not None and not decode:
+            raise ValueError("page_table is a KV-cache decode feature")
         if attn_start is not None and self.pos_emb != "rope":
             raise ValueError(
                 "variable-length (left-padded) prompts need pos_emb='rope' "
@@ -225,8 +239,9 @@ class TransformerLM(nn.Module):
             # generation is deterministic whatever the caller passes.
             # attn_start only rides the decode path (remat never applies
             # there, so the array kwarg never meets jax.checkpoint).
-            if decode and attn_start is not None:
-                x = block(x, True, False, attn_start=attn_start)
+            if decode and (attn_start is not None or page_table is not None):
+                x = block(x, True, False, attn_start=attn_start,
+                          page_table=page_table, kv_lengths=kv_lengths)
             else:
                 x = block(x, decode, train and not decode)
         x = nn.LayerNorm(

@@ -113,7 +113,8 @@ class SelfAttention(nn.Module):
     kv_cache_dtype: object = None  # None | jnp.dtype | "int8"
 
     @nn.compact
-    def __call__(self, x, *, decode: bool = False, attn_start=None):
+    def __call__(self, x, *, decode: bool = False, attn_start=None,
+                 page_table=None, kv_lengths=None):
         b, s, d = x.shape
         assert d % self.num_heads == 0, (d, self.num_heads)
         head_dim = d // self.num_heads
@@ -177,6 +178,14 @@ class SelfAttention(nn.Module):
                     "decode (KV-cache) mode does not compose with sequence "
                     "parallelism — generate on a data/tensor-sharded mesh"
                 )
+            if page_table is not None:
+                # paged KV cache (serve/kv_pages.py): block-pool leaves,
+                # per-slot page tables and write positions — no shared
+                # cursor. Declares its own cache variables, so it must
+                # branch before the flat-cache declarations below.
+                return self._out_proj(self._paged_decode(
+                    q, k, v, page_table, kv_lengths, attn_start
+                ))
             # "int8": quantized cache — 1 byte/element plus per-(batch,
             # head, position) fp32 scales. Decode is HBM-bound and the
             # cache is ~40% of its traffic at batched sizes, so this is
@@ -311,6 +320,93 @@ class SelfAttention(nn.Module):
             )
         return self._out_proj(out)
 
+    def _paged_decode(self, q, k, v, page_table, kv_lengths, attn_start):
+        """Paged KV-cache decode step (serve/kv_pages.py layout).
+
+        The "cache" collection leaves are a POOL of fixed-size blocks
+        (num_blocks, block_size, h*hd) shared by every slot; `page_table`
+        (b, max_blocks_per_slot) int32 maps each slot's block list and
+        `kv_lengths` (b,) int32 is each slot's write position — slot-LOCAL
+        coordinates starting at 0, so RoPE rotates each slot at its own
+        offset and there is no shared cursor to run out. The incoming
+        token's K/V scatters into pool block
+        `page_table[b, pos // block_size]` row `pos % block_size`;
+        attention gathers through the same table
+        (ops/decode_attention.paged_decode_attention) and masks
+        [attn_start[b], pos[b]] in slot-local positions.
+        """
+        from ddp_practice_tpu.ops.decode_attention import (
+            paged_decode_attention,
+        )
+
+        if kv_lengths is None:
+            raise ValueError(
+                "paged decode needs kv_lengths (per-slot write positions)"
+            )
+        if not self.rope:
+            raise ValueError(
+                "paged decode needs rope=True — slot-local positions "
+                "require relative position encoding"
+            )
+        if self.kv_cache_dtype == "int8":
+            raise ValueError(
+                "paged KV cache does not compose with kv_cache_dtype="
+                "'int8' yet (the scales would need their own page pool)"
+            )
+        b_, s_, h_, hd_ = k.shape
+        if s_ != 1:
+            raise ValueError(
+                f"paged decode is single-token (got s={s_}); prompt "
+                "prefill runs through a contiguous scratch cache that "
+                "serve/kv_pages.py scatters into blocks"
+            )
+        if self.is_initializing():
+            raise ValueError(
+                "paged cache pools are allocated by serve/kv_pages.py "
+                "make_paged_cache, not by model.init"
+            )
+        cache_dtype = (
+            self.kv_cache_dtype if self.kv_cache_dtype is not None
+            else k.dtype
+        )
+        cached_key = self.variable(
+            "cache", "cached_key", jnp.zeros, (b_, s_, h_ * hd_), cache_dtype
+        )
+        cached_value = self.variable(
+            "cache", "cached_value", jnp.zeros, (b_, s_, h_ * hd_),
+            cache_dtype,
+        )
+        # declared for tree parity with the flat cache (make_paged_cache
+        # mirrors make_cache's structure); a block pool has no global
+        # clock, so the scalar stays untouched
+        self.variable(
+            "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
+        )
+        block_size = cached_key.value.shape[1]
+        pool_dtype = cached_key.value.dtype
+        pos = jnp.asarray(kv_lengths, jnp.int32)
+        q = apply_rope(q, pos[:, None])   # (b, 1): per-slot offsets
+        k = apply_rope(k, pos[:, None])
+        # clamp keeps a retired slot (page row 0, length pinned) writing
+        # inside the table; active slots never reach the clamp — the
+        # engine pre-allocates blocks for every position it dispatches
+        blk_col = jnp.minimum(pos // block_size, page_table.shape[1] - 1)
+        blk = jnp.take_along_axis(page_table, blk_col[:, None], axis=1)[:, 0]
+        off = pos % block_size
+        kc = cached_key.value.at[blk, off].set(
+            k.reshape(b_, -1).astype(pool_dtype)
+        )
+        vc = cached_value.value.at[blk, off].set(
+            v.reshape(b_, -1).astype(pool_dtype)
+        )
+        cached_key.value = kc
+        cached_value.value = vc
+        out = paged_decode_attention(
+            q.reshape(b_, 1, -1), kc, vc, page_table, pos, attn_start,
+            n_heads=h_,
+        )
+        return out.reshape(b_, 1, h_, hd_)
+
     def _out_proj(self, out):
         """Shared output projection over (b, s, h, hd) attention output —
         one definition for the fused-QKV and sliced/decode paths (they
@@ -378,11 +474,12 @@ class EncoderBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x, decode: bool = False, train: bool = False, *,
-                 attn_start=None):
+                 attn_start=None, page_table=None, kv_lengths=None):
         # decode/train are positional-friendly: the LM's remat path wraps
         # this module in nn.remat(static_argnums=(2, 3)), and jax.checkpoint
         # only accepts non-array arguments at static positions. attn_start
-        # (an array) is decode-only, where remat never applies.
+        # / page_table / kv_lengths (arrays) are decode-only, where remat
+        # never applies.
         fused = self.fused
         if fused == "auto":
             fused = not self.is_initializing() and self._auto_fuse(
@@ -407,7 +504,8 @@ class EncoderBlock(nn.Module):
                 causal=self.causal,
             )
         return self._unfused(x, decode=decode, train=train,
-                             attn_start=attn_start)
+                             attn_start=attn_start, page_table=page_table,
+                             kv_lengths=kv_lengths)
 
     def _plain_block(self, decode) -> bool:
         """The ONE definition of 'plain block' — what the fused kernels
@@ -442,7 +540,8 @@ class EncoderBlock(nn.Module):
             num_heads=self.num_heads, compute_dtype=self.dtype,
         )
 
-    def _unfused(self, x, *, decode, train, attn_start):
+    def _unfused(self, x, *, decode, train, attn_start,
+                 page_table=None, kv_lengths=None):
         y = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype, name="ln1")(x)
         y = SelfAttention(
             self.num_heads,
@@ -455,7 +554,8 @@ class EncoderBlock(nn.Module):
             rope=self.rope,
             kv_cache_dtype=self.kv_cache_dtype,
             name="attn",
-        )(y, decode=decode, attn_start=attn_start)
+        )(y, decode=decode, attn_start=attn_start, page_table=page_table,
+          kv_lengths=kv_lengths)
         y = nn.Dropout(self.dropout_rate, deterministic=not train)(y)
         x = x + y
         y = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype, name="ln2")(x)

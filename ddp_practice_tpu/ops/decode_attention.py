@@ -61,6 +61,49 @@ from ddp_practice_tpu.ops.flash_attention import (
 )
 
 
+def _online_softmax_cell(
+    cur, start, j, n_j,
+    q_ref, k_ref, v_ref, o_ref,
+    m_scr, l_scr, acc_scr,
+    *, sm_scale, block, n_heads, d,
+):
+    """One grid cell of the multi-block online-softmax decode walk,
+    shared by the flat (`_kernel`) and paged (`_paged_kernel`) kernels —
+    the only thing that differs between them is where `cur` comes from
+    (pool-global scalar vs per-slot length) and how the kv tile was
+    addressed (contiguous vs page table), both settled by the caller.
+    `cur`/`start` are this cell's cursor scalars (start None = no
+    left-padding mask); key positions are `j * block + offset`."""
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full(m_scr.shape, -jnp.inf, jnp.float32)
+        l_scr[:] = jnp.zeros(l_scr.shape, jnp.float32)
+        acc_scr[:] = jnp.zeros(acc_scr.shape, jnp.float32)
+
+    @pl.when(j * block <= cur)
+    def _compute():
+        k_pos = j * block + jax.lax.broadcasted_iota(
+            jnp.int32, (8, block), 1
+        )
+        valid = k_pos <= cur
+        if start is not None:
+            valid &= k_pos >= start
+        penalty = jnp.where(valid, 0.0, _NEG_INF)
+        for hh in range(n_heads):
+            lo, hi = hh * d, (hh + 1) * d
+            qs = (q_ref[:, lo:hi] * sm_scale).astype(q_ref.dtype)  # (1, d)
+            q8 = jnp.broadcast_to(qs, (8, d))
+            s = _dot_tb(q8, k_ref[:, lo:hi]) + penalty    # (8, block) f32
+            m_scr[hh], l_scr[hh], acc_scr[:, lo:hi] = _softmax_accumulate(
+                s, v_ref[:, lo:hi], m_scr[hh], l_scr[hh], acc_scr[:, lo:hi]
+            )
+
+    @pl.when(j == n_j - 1)
+    def _finalize():
+        o_ref[:] = acc_scr[:1].astype(o_ref.dtype)
+
+
 def _kernel(
     cur_ref, start_ref,              # scalar prefetch (SMEM)
     q_ref, k_ref, v_ref, o_ref,      # blocks
@@ -69,36 +112,12 @@ def _kernel(
 ):
     b_idx = pl.program_id(0)
     j = pl.program_id(1)
-    n_j = pl.num_programs(1)
-    cur = cur_ref[0]
-
-    @pl.when(j == 0)
-    def _init():
-        m_scr[:] = jnp.full(m_scr.shape, -jnp.inf, jnp.float32)
-        l_scr[:] = jnp.zeros(l_scr.shape, jnp.float32)
-        acc_scr[:] = jnp.zeros(acc_scr.shape, jnp.float32)
-
-    @pl.when(j * block_l <= cur)
-    def _compute():
-        k_pos = j * block_l + jax.lax.broadcasted_iota(
-            jnp.int32, (8, block_l), 1
-        )
-        valid = k_pos <= cur
-        if has_start:
-            valid &= k_pos >= start_ref[b_idx]
-        penalty = jnp.where(valid, 0.0, _NEG_INF)
-        for hh in range(n_heads):
-            lo, hi = hh * d, (hh + 1) * d
-            qs = (q_ref[:, lo:hi] * sm_scale).astype(q_ref.dtype)  # (1, d)
-            q8 = jnp.broadcast_to(qs, (8, d))
-            s = _dot_tb(q8, k_ref[:, lo:hi]) + penalty   # (8, block_l) f32
-            m_scr[hh], l_scr[hh], acc_scr[:, lo:hi] = _softmax_accumulate(
-                s, v_ref[:, lo:hi], m_scr[hh], l_scr[hh], acc_scr[:, lo:hi]
-            )
-
-    @pl.when(j == n_j - 1)
-    def _finalize():
-        o_ref[:] = acc_scr[:1].astype(o_ref.dtype)
+    _online_softmax_cell(
+        cur_ref[0], start_ref[b_idx] if has_start else None,
+        j, pl.num_programs(1),
+        q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
+        sm_scale=sm_scale, block=block_l, n_heads=n_heads, d=d,
+    )
 
 
 def _kernel_single(
@@ -339,3 +358,167 @@ def decode_attention_packed(
         interpret=interpret,
     )(cur1, start, q, k_cache, v_cache)
     return out
+
+
+# --------------------------------------------------------------------- paged
+# PagedAttention-style decode (serve/kv_pages.py): K/V live in a pool of
+# fixed-size blocks shared by all slots, and each slot reaches its own
+# history through a per-slot PAGE TABLE of block indices. Positions are
+# slot-local — position p of slot b lives in pool block
+# `page_table[b, p // block_size]` at row `p % block_size` — so there is
+# no shared cursor and a step's attention span is the slot's own
+# occupied pages, not a pool-global [0, max_len).
+
+
+def _paged_kernel(
+    len_ref, start_ref, pt_ref,          # scalar prefetch (SMEM)
+    q_ref, k_ref, v_ref, o_ref,          # blocks
+    m_scr, l_scr, acc_scr,
+    *, sm_scale, block_size, n_heads, d, has_start,
+):
+    """Grid (batch, blocks-per-slot); the kv tile of cell (b, j) is pool
+    block `pt_ref[b, j]` — the page-table indirection happens in the
+    BlockSpec index map, so the body is `_online_softmax_cell` with a
+    per-SLOT cursor (`len_ref[b]`) instead of the pool-global scalar.
+    Blocks past the slot's length are skipped: `@pl.when` gates the
+    compute and the index map pins their DMA to the slot's block 0
+    (unchanged index -> Pallas elides the copy), so a slot with `p`
+    occupied positions pays O(p) cache reads however large the pool or
+    the per-slot capacity."""
+    b_idx = pl.program_id(0)
+    j = pl.program_id(1)
+    _online_softmax_cell(
+        len_ref[b_idx], start_ref[b_idx] if has_start else None,
+        j, pl.num_programs(1),
+        q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
+        sm_scale=sm_scale, block=block_size, n_heads=n_heads, d=d,
+    )
+
+
+def paged_attention_reference(
+    q: jnp.ndarray,           # (b, 1, h*hd)
+    k_pages: jnp.ndarray,     # (num_blocks, block_size, h*hd) pool
+    v_pages: jnp.ndarray,
+    page_table: jnp.ndarray,  # (b, max_blocks_per_slot) int32
+    lengths: jnp.ndarray,     # (b,) int32: slot-local position of the
+                              # current token (attends itself — inclusive)
+    attn_start=None,          # optional (b,) int32 slot-local first key
+    *,
+    n_heads: int,
+) -> jnp.ndarray:
+    """XLA gather path: materialize each slot's pages as a contiguous
+    (b, max_blocks_per_slot * block_size) span and run masked attention.
+
+    The span is the PER-SLOT capacity (sized to the request's own
+    context budget), not the pool — the slot engine's cost driver was
+    the pool-global [0, max_len) scan, which this path already removes.
+    It is also the correctness oracle for `_paged_kernel` and the
+    serving path on backends without the kernel (CPU tests; unpackable
+    head shapes)."""
+    from ddp_practice_tpu.ops.attention import attention_with_mask
+
+    b = q.shape[0]
+    bs, hh = k_pages.shape[1], k_pages.shape[2]
+    d = hh // n_heads
+    mb = page_table.shape[1]
+    span = mb * bs
+    k = jnp.take(k_pages, page_table, axis=0).reshape(b, span, n_heads, d)
+    v = jnp.take(v_pages, page_table, axis=0).reshape(b, span, n_heads, d)
+    pos = jnp.arange(span, dtype=jnp.int32)[None, :]
+    valid = pos <= lengths[:, None]
+    if attn_start is not None:
+        valid &= pos >= attn_start[:, None]
+    out = attention_with_mask(
+        q.reshape(b, 1, n_heads, d).astype(k.dtype),
+        k, v, valid[:, None, None, :],
+    )
+    return out.reshape(b, 1, hh).astype(q.dtype)
+
+
+def paged_decode_attention(
+    q: jnp.ndarray,
+    k_pages: jnp.ndarray,
+    v_pages: jnp.ndarray,
+    page_table: jnp.ndarray,
+    lengths: jnp.ndarray,
+    attn_start=None,
+    *,
+    n_heads: int,
+    impl: str = "auto",
+) -> jnp.ndarray:
+    """One paged decode step; returns (b, 1, h*hd). See the module-level
+    paged section for the layout.
+
+    impl: "auto" runs the Pallas kernel on TPU when the heads pack into
+    128-lane tiles and the gather reference otherwise (on CPU the
+    reference IS the fast path — interpret-mode pays python emulation
+    per grid cell, and the reference's gather is one fused XLA op);
+    "kernel" forces the kernel (interpret-mode on CPU — the numerics-
+    test hook); "reference" forces the gather path.
+    """
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, sq, hd_total = q.shape
+    if sq != 1:
+        raise ValueError(
+            f"paged_decode_attention is the single-token step (got {sq} "
+            f"query rows); prefill runs through a contiguous scratch "
+            f"cache and scatters whole blocks (serve/kv_pages.py)"
+        )
+    bs = k_pages.shape[1]
+    d = hd_total // n_heads
+    packable = _heads_per_pack(n_heads, d) is not None and bs % 8 == 0
+    if impl == "reference" or (impl == "auto" and (
+            not packable or jax.default_backend() == "cpu")):
+        return paged_attention_reference(
+            q, k_pages, v_pages, page_table, lengths, attn_start,
+            n_heads=n_heads,
+        )
+    if not packable:
+        raise ValueError(
+            f"impl='kernel' needs packable heads (h={n_heads}, d={d}) "
+            f"and a block_size multiple of 8 (got {bs})"
+        )
+    sm_scale = 1.0 / (d ** 0.5)
+    has_start = attn_start is not None
+    mb = page_table.shape[1]
+    lens = jnp.asarray(lengths, jnp.int32)
+    start = (
+        jnp.asarray(attn_start, jnp.int32)
+        if has_start else jnp.zeros((b,), jnp.int32)
+    )
+    pt = jnp.asarray(page_table, jnp.int32)
+
+    def kv_map(b_, j, len_ref, start_ref, pt_ref):
+        j_sel = lax.select(j * bs <= len_ref[b_], j, 0)
+        return (pt_ref[b_, j_sel], 0, 0)
+
+    kernel = functools.partial(
+        _paged_kernel, sm_scale=sm_scale, block_size=bs,
+        n_heads=n_heads, d=d, has_start=has_start,
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=3,
+            grid=(b, mb),
+            in_specs=[
+                pl.BlockSpec((None, 1, hd_total),
+                             lambda b_, j, *_: (b_, 0, 0)),
+                pl.BlockSpec((None, bs, hd_total), kv_map),
+                pl.BlockSpec((None, bs, hd_total), kv_map),
+            ],
+            out_specs=pl.BlockSpec((None, 1, hd_total),
+                                   lambda b_, j, *_: (b_, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((n_heads, 8, _LANES), jnp.float32),
+                pltpu.VMEM((n_heads, 8, _LANES), jnp.float32),
+                pltpu.VMEM((8, hd_total), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, 1, hd_total), q.dtype),
+        compiler_params=tpu_compiler_params(
+            dimension_semantics=("parallel", "arbitrary")
+        ),
+        interpret=jax.default_backend() == "cpu",
+    )(lens, start, pt, q, k_pages, v_pages)

@@ -28,7 +28,10 @@ def apply_rope(
     *,
     theta: float = 10000.0,
 ) -> jnp.ndarray:
-    """Rotate (b, s, h, d) by per-position angles; positions is (s,) int.
+    """Rotate (b, s, h, d) by per-position angles; positions is (s,) int,
+    or (b, s) int when sequences sit at different absolute offsets (the
+    paged KV cache decodes every slot at its OWN write position —
+    serve/kv_pages.py — so the batch no longer shares one cursor).
 
     GPT-NeoX rotate-half convention: channel pairs are (i, i + d/2).
     Under GSPMD jit the model sees the GLOBAL sequence, so callers pass
@@ -40,9 +43,17 @@ def apply_rope(
         raise ValueError(f"RoPE needs an even head_dim, got {d}")
     half = d // 2
     freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
-    angles = positions.astype(jnp.float32)[:, None] * freqs[None, :]  # (s, half)
-    cos = jnp.cos(angles)[None, :, None, :]
-    sin = jnp.sin(angles)[None, :, None, :]
+    angles = positions.astype(jnp.float32)[..., None] * freqs  # (..., half)
+    if angles.ndim == 2:        # (s, half): shared across the batch
+        cos = jnp.cos(angles)[None, :, None, :]
+        sin = jnp.sin(angles)[None, :, None, :]
+    elif angles.ndim == 3:      # (b, s, half): per-sequence offsets
+        cos = jnp.cos(angles)[:, :, None, :]
+        sin = jnp.sin(angles)[:, :, None, :]
+    else:
+        raise ValueError(
+            f"positions must be (s,) or (b, s), got ndim {positions.ndim}"
+        )
     x1 = x[..., :half].astype(jnp.float32)
     x2 = x[..., half:].astype(jnp.float32)
     out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)

@@ -5,10 +5,14 @@ Layers (each its own module, composable and separately testable):
 - kv_slots.py  — slot-based KV-cache pool: fixed `(max_slots, max_len)`
   cache, left-aligned admission at a shared write cursor, whole-row
   scatter on admit, free-list slot reuse;
-- engine.py    — SlotEngine: bucketed jitted prefill-admit + one jitted
-  batched decode step; static shapes, so batch composition churns with
-  zero recompiles; per-slot finite-logits flag contains a NaN to one
-  request;
+- kv_pages.py  — PAGED KV-cache pool (vLLM-style): fixed-size blocks,
+  host block allocator + per-slot device page tables, slot-local
+  positions — no shared clock, per-page release, contexts past max_len;
+- engine.py    — SlotEngine/PagedEngine: bucketed jitted prefill-admit
+  + one jitted batched decode step; static shapes, so batch composition
+  churns with zero recompiles; per-slot finite-logits flag contains a
+  NaN to one request; one interface (admit_gate/admit/step_burst/
+  release) over both memory layouts;
 - scheduler.py — FIFO queue, admission control (bounded queue sheds),
   per-request deadlines, EOS/length release, injectable clock
   (FakeClock for deterministic CPU tests) and fault hook;
@@ -30,7 +34,11 @@ Layers (each its own module, composable and separately testable):
   curves); also the `cli.py serve` entry point.
 """
 
-from ddp_practice_tpu.serve.engine import EngineConfig, SlotEngine
+from ddp_practice_tpu.serve.engine import (
+    EngineConfig,
+    PagedEngine,
+    SlotEngine,
+)
 from ddp_practice_tpu.serve.faults import (
     FaultInjector,
     FaultPlan,
@@ -43,6 +51,7 @@ from ddp_practice_tpu.serve.health import (
     HealthState,
     ReplicaHealth,
 )
+from ddp_practice_tpu.serve.kv_pages import BlockAllocator
 from ddp_practice_tpu.serve.kv_slots import SlotAllocator
 from ddp_practice_tpu.serve.metrics import RouterMetrics, ServeMetrics
 from ddp_practice_tpu.serve.router import (
@@ -59,6 +68,7 @@ from ddp_practice_tpu.serve.scheduler import (
 )
 
 __all__ = [
+    "BlockAllocator",
     "BreakerConfig",
     "CircuitBreaker",
     "Completion",
@@ -69,6 +79,7 @@ __all__ = [
     "FaultSpec",
     "HealthState",
     "MonotonicClock",
+    "PagedEngine",
     "ReplicaCrashed",
     "ReplicaHealth",
     "Request",

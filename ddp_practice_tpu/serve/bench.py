@@ -102,30 +102,61 @@ def _percentiles(xs) -> dict:
 
 
 def _run_continuous(model, params, trace, *, max_slots, prompt_buckets,
-                    max_len, decode_burst, eos_id) -> dict:
-    from ddp_practice_tpu.serve.engine import EngineConfig, SlotEngine
+                    max_len, decode_burst, eos_id, paged: bool = False,
+                    block_size: int = 16) -> dict:
+    from ddp_practice_tpu.serve.engine import (
+        EngineConfig,
+        PagedEngine,
+        SlotEngine,
+    )
     from ddp_practice_tpu.serve.scheduler import Request, Scheduler
 
-    engine = SlotEngine(
-        model, params,
-        EngineConfig(
-            max_slots=max_slots, max_len=max_len,
-            prompt_buckets=prompt_buckets, temperature=0.0,
-            decode_burst=decode_burst, eos_id=eos_id,
-        ),
-    )
+    if paged:
+        # per-slot capacity sized to the WORKLOAD's worst context
+        # (bucket + burst-rounded max_new), not to max_len — this is the
+        # paged decoupling: attention span follows the request, while
+        # the POOL carries max_len-equivalent memory per slot so both
+        # engines hold the same HBM
+        worst_new = max(t["max_new_tokens"] for t in trace)
+        worst_new = -(-worst_new // decode_burst) * decode_burst
+        cap_blocks = -(-(max(prompt_buckets) + worst_new) // block_size)
+        engine = PagedEngine(
+            model, params,
+            EngineConfig(
+                max_slots=max_slots, max_len=max_len,
+                prompt_buckets=prompt_buckets, temperature=0.0,
+                decode_burst=decode_burst, eos_id=eos_id,
+                block_size=block_size, max_blocks_per_slot=cap_blocks,
+                num_blocks=1 + max_slots * (-(-max_len // block_size)),
+            ),
+        )
+    else:
+        engine = SlotEngine(
+            model, params,
+            EngineConfig(
+                max_slots=max_slots, max_len=max_len,
+                prompt_buckets=prompt_buckets, temperature=0.0,
+                decode_burst=decode_burst, eos_id=eos_id,
+            ),
+        )
     # no ServeMetrics inside the timed window: the bench computes its own
     # percentiles from completions, and the static baseline carries no
     # per-tick bookkeeping — keep the measured loops symmetric
     sched = Scheduler(engine, max_queue=len(trace))
     # warmup compiles outside the timed window: one admit per bucket in
-    # play + one decode dispatch, then rewind
+    # play + one decode dispatch, then rewind (slot pool only — paged
+    # blocks free individually at release, nothing to rewind)
     widths = sorted({engine.bucket_for(len(t["prompt"])) for t in trace})
     for w in widths:
-        slot = engine.admit(list(range(1, w + 1))[:w])
+        # budget only the one warmup burst: a default (reserve-the-cap)
+        # paged admit could outsize a small pool that the gated
+        # scheduler path would happily serve
+        slot = engine.admit(list(range(1, w + 1))[:w],
+                            max_positions=decode_burst)
         engine.step_burst()
         engine.release(slot)
-    engine.reset_epoch()
+    if not paged:
+        engine.reset_epoch()
 
     t0 = time.monotonic()
     i = 0
@@ -153,7 +184,15 @@ def _run_continuous(model, params, trace, *, max_slots, prompt_buckets,
     tokens = sum(len(c.tokens) for c in sched.completions)
     lat = [c.finish - c.arrival for c in sched.completions]
     return {
-        "mode": "continuous",
+        "mode": "paged" if paged else "continuous",
+        # largest total context one request can reach: the slot pool is
+        # hard-capped by its shared clock (a request can never span more
+        # than max_len - max_bucket decode positions from base), the
+        # paged engine by its per-slot page-table width — which is free
+        # to exceed max_len
+        "max_servable_context": (
+            engine.max_context if paged else max_len
+        ),
         "elapsed_s": elapsed,
         "useful_tokens": tokens,
         "tokens_per_sec": tokens / elapsed,
@@ -349,6 +388,13 @@ def serve_bench(
     # router's overhead against the direct continuous path)
     replicas: int = 0,
     fault_plan=None,
+    # also run the trace through the paged-KV engine (serve/kv_pages.py)
+    # — the span-decoupling measurement: the slot engine's decode
+    # attention scans [0, max_len) every step, the paged engine only
+    # each request's own pages, so growing max_len taxes the slot row
+    # and leaves the paged row flat (BENCHMARKS.md)
+    paged: bool = False,
+    block_size: int = 16,
 ) -> dict:
     """Replay one Poisson trace through both servers; return the report."""
     model, params = _build_model(
@@ -376,6 +422,7 @@ def serve_bench(
             "prompt_len_range": list(prompt_len_range),
             "max_new_range": list(max_new_range),
         },
+        "max_len": max_len,
         "continuous": cont,
         "static": static,
         "throughput_ratio": (
@@ -383,6 +430,21 @@ def serve_bench(
             if static["tokens_per_sec"] else float("inf")
         ),
     }
+    if paged:
+        report["paged"] = _run_continuous(
+            model, params, trace, max_slots=max_slots,
+            prompt_buckets=tuple(prompt_buckets), max_len=max_len,
+            decode_burst=decode_burst, eos_id=eos_id,
+            paged=True, block_size=block_size,
+        )
+        report["paged_vs_static"] = (
+            report["paged"]["tokens_per_sec"] / static["tokens_per_sec"]
+            if static["tokens_per_sec"] else float("inf")
+        )
+        report["paged_vs_continuous"] = (
+            report["paged"]["tokens_per_sec"] / cont["tokens_per_sec"]
+            if cont["tokens_per_sec"] else float("inf")
+        )
     if replicas >= 1:
         report["router"] = _run_router(
             model, params, trace, replicas=replicas, max_slots=max_slots,
@@ -437,6 +499,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "the router run — a JSON string or a path to a "
                         "JSON file; the router row then reports GOODPUT "
                         "under those faults (requires --replicas)")
+    p.add_argument("--paged", action="store_true",
+                   help="bench: also run the trace through the paged-KV "
+                        "engine (serve/kv_pages.py) — adds a 'paged' row; "
+                        "compare against 'continuous' at large --max-len "
+                        "to see the span decoupling")
+    p.add_argument("--block-size", dest="block_size", type=int, default=16,
+                   help="paged engine: positions per KV block")
+    p.add_argument("--max-len", dest="max_len", type=int, default=None,
+                   help="bench: slot-pool span / paged pool sizing "
+                        "(default 128); the slot engine's decode cost "
+                        "scales with this, the paged engine's does not")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--json", action="store_true")
     return p
@@ -501,6 +574,11 @@ def main(argv=None) -> int:
     bench_kw = {}
     if args.decode_burst is not None:
         bench_kw["decode_burst"] = args.decode_burst
+    if args.paged:
+        bench_kw["paged"] = True
+        bench_kw["block_size"] = args.block_size
+    if args.max_len is not None:
+        bench_kw["max_len"] = args.max_len
     if args.replicas:
         from ddp_practice_tpu.serve.faults import FaultPlan
 
@@ -519,7 +597,8 @@ def main(argv=None) -> int:
             f"[serve_bench] {args.requests} requests @ {args.rate}/s, "
             f"{args.max_slots} slots"
         )
-        rows = [c, s] + ([report["router"]] if "router" in report else [])
+        rows = [c, s] + ([report["paged"]] if "paged" in report else []) \
+            + ([report["router"]] if "router" in report else [])
         for r in rows:
             print(
                 f"  {r['mode']:>10}: {r['tokens_per_sec']:8.1f} tok/s  "
@@ -529,6 +608,15 @@ def main(argv=None) -> int:
             )
         print(f"  continuous/static throughput: "
               f"{report['throughput_ratio']:.2f}x")
+        if "paged" in report:
+            print(
+                f"  paged/continuous throughput: "
+                f"{report['paged_vs_continuous']:.2f}x  "
+                f"(max servable context: paged "
+                f"{report['paged']['max_servable_context']} vs slot "
+                f"{report['continuous']['max_servable_context']} "
+                f"@ max_len {report['max_len']})"
+            )
         if "router" in report:
             r = report["router"]
             faults = " under injected faults" if args.fault_plan else ""

@@ -32,6 +32,12 @@ batch — the property that makes continuous batching transparent to
 clients. Greedy decode is bit-identical to the one-shot generator
 (tests/test_serve_equivalence.py) because both paths run the same
 `decode_apply` and the same `sample_logits`.
+
+Two engines share this contract behind one interface (`admit_gate` /
+`admit` / `step_burst` / `release` / `compile_stats`): SlotEngine over
+the shared-cursor slot pool (kv_slots.py) and PagedEngine over the
+block-granular paged pool (kv_pages.py — per-slot page tables, no
+global clock, contexts past max_len). The scheduler drives either.
 """
 
 from __future__ import annotations
@@ -45,6 +51,11 @@ import numpy as np
 from jax import lax
 
 from ddp_practice_tpu.inference import decode_apply, make_cache, sample_logits
+from ddp_practice_tpu.serve.kv_pages import (
+    BlockAllocator,
+    make_paged_cache,
+    scatter_prompt_blocks,
+)
 from ddp_practice_tpu.serve.kv_slots import (
     SlotAllocator,
     set_cursor,
@@ -57,7 +68,11 @@ class EngineConfig:
     """Compile-time serving knobs (all closed over by the jitted fns)."""
 
     max_slots: int = 4
-    # pool positions per slot; 0 = the model's max_len
+    # pool positions per slot; 0 = the model's max_len. For PagedEngine
+    # this sizes the DEFAULTS of the block pool (num_blocks /
+    # max_blocks_per_slot below), not a hard span — per-slot capacity is
+    # max_blocks_per_slot * block_size and may exceed the model's
+    # max_len (RoPE positions are unbounded).
     max_len: int = 0
     # LEFT-pad prompt widths for the bucketed prefill compile cache; the
     # largest bucket is also the base cursor (admission always has room
@@ -76,9 +91,98 @@ class EngineConfig:
     # request vs the static baseline's E[max - asked]. K=1 is exact
     # token-granularity scheduling (the deterministic-test setting).
     decode_burst: int = 1
+    # ---- PagedEngine knobs (ignored by SlotEngine) ----
+    # positions per pool block; the allocation granule. Multiples of 8
+    # keep the TPU kernel's sublane tiling happy (ops/decode_attention).
+    block_size: int = 16
+    # pool blocks; 0 = 1 garbage block + max_slots * max_blocks_per_slot
+    # (full backing — every slot can reach its capacity simultaneously).
+    # Set smaller to oversubscribe (admission then gates on blocks).
+    num_blocks: int = 0
+    # per-slot page-table width = context cap in blocks; 0 =
+    # ceil(max_len / block_size). THIS is a slot's attention span — size
+    # it to the workload's real contexts, not the pool.
+    max_blocks_per_slot: int = 0
 
 
-class SlotEngine:
+def _sample_step(cfg: EngineConfig, last_logits, active, keys):
+    """One sampling step shared by both engines: per-slot PRNG chains,
+    greedy fast path, pad tokens for free slots. Returns
+    (tokens int32, new_keys)."""
+    if cfg.temperature == 0.0:
+        toks = sample_logits(last_logits, None, temperature=0.0)
+        new_keys = keys
+    else:
+        split = jax.vmap(lambda k: jax.random.split(k, 2))(keys)
+        subs, new_keys = split[:, 0], split[:, 1]
+        toks = jax.vmap(
+            lambda lg, k: sample_logits(
+                lg[None], k, temperature=cfg.temperature,
+                top_k=cfg.top_k, top_p=cfg.top_p,
+            )[0]
+        )(last_logits, subs)
+    toks = jnp.where(
+        active, toks.astype(jnp.int32), jnp.int32(cfg.pad_id)
+    )
+    return toks, new_keys
+
+
+def _decode_donate() -> tuple:
+    """donate_argnums for the decode dispatch: the cache pool (arg 1
+    after params) is donated on TPU so XLA reuses its HBM in place —
+    with a paged pool the buffer is the whole serving memory, big enough
+    to care (ROADMAP engine-level item). Gated off on CPU, where
+    donation is unimplemented and every dispatch would warn."""
+    return (1,) if jax.default_backend() == "tpu" else ()
+
+
+class _EngineBase:
+    """What the two memory layouts share: the prompt-bucket map, slot
+    accounting over a SlotAllocator at `self.allocator`, the
+    token-granular `step()` veneer over `step_burst`, and the
+    two-jitted-programs observable (`self._prefill_jit` /
+    `self._decode_jit` set by each subclass __init__)."""
+
+    def bucket_for(self, prompt_len: int) -> int:
+        """Smallest bucket width holding `prompt_len` (raises if none)."""
+        for w in self.buckets:
+            if prompt_len <= w:
+                return w
+        raise ValueError(
+            f"prompt length {prompt_len} exceeds the largest bucket "
+            f"{self.buckets[-1]}"
+        )
+
+    @property
+    def num_active(self) -> int:
+        return self.allocator.num_used
+
+    @property
+    def num_free(self) -> int:
+        return self.allocator.num_free
+
+    def step(self) -> np.ndarray:
+        """One decode step for the whole pool; tokens (max_slots,).
+        Token-granular stepping — requires decode_burst=1 (use
+        step_burst for the amortized path)."""
+        if self.config.decode_burst != 1:
+            raise RuntimeError("step() needs decode_burst=1")
+        return self.step_burst()[0]
+
+    def compile_stats(self) -> dict:
+        """Jit cache sizes — the no-recompilation-churn observable.
+
+        After warmup (one admit per bucket width in play, one decode
+        dispatch), these counts must stay CONSTANT however many requests
+        churn through (pinned via the conftest `compile_guard` helper
+        and tests/test_serve_scheduler.py)."""
+        return {
+            "prefill_compiles": self._prefill_jit._cache_size(),
+            "decode_compiles": self._decode_jit._cache_size(),
+        }
+
+
+class SlotEngine(_EngineBase):
     """Slot-granular admission + batched single-token decode.
 
     Pure mechanism: WHAT to admit/release and WHEN is the scheduler's
@@ -124,7 +228,9 @@ class SlotEngine:
         if config.decode_burst < 1:
             raise ValueError("decode_burst must be >= 1")
         self._prefill_jit = jax.jit(self._prefill_admit)
-        self._decode_jit = jax.jit(self._decode_burst)
+        self._decode_jit = jax.jit(
+            self._decode_burst, donate_argnums=_decode_donate()
+        )
 
     # ---------------------------------------------------------------- jitted
     def _prefill_admit(self, params, pool, last_logits, attn_starts,
@@ -153,21 +259,7 @@ class SlotEngine:
         # and this flag is what lets the scheduler finish ONE request
         # with status "error" instead of serving garbage batch-wide
         finite = jnp.isfinite(last_logits).all(axis=-1)
-        if cfg.temperature == 0.0:
-            toks = sample_logits(last_logits, None, temperature=0.0)
-            new_keys = keys
-        else:
-            split = jax.vmap(lambda k: jax.random.split(k, 2))(keys)
-            subs, new_keys = split[:, 0], split[:, 1]
-            toks = jax.vmap(
-                lambda lg, k: sample_logits(
-                    lg[None], k, temperature=cfg.temperature,
-                    top_k=cfg.top_k, top_p=cfg.top_p,
-                )[0]
-            )(last_logits, subs)
-        toks = jnp.where(
-            active, toks.astype(jnp.int32), jnp.int32(cfg.pad_id)
-        )
+        toks, new_keys = _sample_step(cfg, last_logits, active, keys)
         pool, logits = decode_apply(
             self.model, params, pool, toks[:, None],
             attn_start=attn_starts, batch_stats=self.batch_stats,
@@ -194,30 +286,41 @@ class SlotEngine:
         return pool, last_logits, toks, keys, finite
 
     # ----------------------------------------------------------------- host
-    def bucket_for(self, prompt_len: int) -> int:
-        """Smallest bucket width holding `prompt_len` (raises if none)."""
-        for w in self.buckets:
-            if prompt_len <= w:
-                return w
-        raise ValueError(
-            f"prompt length {prompt_len} exceeds the largest bucket "
-            f"{self.buckets[-1]}"
-        )
-
     @property
     def headroom(self) -> int:
         """Decode positions left before the pool cursor hits max_len."""
         return self.max_len - self.cursor
 
-    @property
-    def num_active(self) -> int:
-        return self.allocator.num_used
+    def admit_gate(self, prompt_len: int, needed_positions: int) -> str:
+        """Admission verdict for a request needing `needed_positions`
+        decode positions (burst-rounded by the scheduler):
+        "ok" = admit now; "later" = cannot yet (positions will free —
+        here, after a drain + `make_room` rewind); "never" = can never
+        run on this engine (prompt outgrows every bucket, or more
+        positions than a fresh pool holds)."""
+        try:
+            self.bucket_for(prompt_len)
+        except ValueError:
+            return "never"
+        if needed_positions > self.max_len - self.base_cursor:
+            return "never"
+        if self.headroom < needed_positions:
+            return "later"
+        return "ok"
 
-    @property
-    def num_free(self) -> int:
-        return self.allocator.num_free
+    def make_room(self) -> bool:
+        """Try to create admission headroom; True if anything changed.
+        Positions are a global resource under the shared cursor — the
+        only lever is rewinding the pool clock once every slot is free
+        (the scheduler drains, then calls this). The paged engine has no
+        equivalent: its blocks free individually at release."""
+        if self.allocator.num_used == 0 and self.cursor != self.base_cursor:
+            self.reset_epoch()
+            return True
+        return False
 
-    def admit(self, prompt: Sequence[int], *, seed: int = 0) -> int:
+    def admit(self, prompt: Sequence[int], *, seed: int = 0,
+              max_positions: Optional[int] = None) -> int:
         """Prefill `prompt` into a free slot; returns the slot index.
 
         The prompt joins exactly where the running batch is: its last
@@ -225,7 +328,9 @@ class SlotEngine:
         produces its first generated token together with everyone
         else's. Raises if no slot is free or the prompt outgrows the
         buckets — admission POLICY (queueing, shedding) lives in the
-        scheduler.
+        scheduler. `max_positions` is accepted for engine-interface
+        parity with PagedEngine (which reserves blocks per request) and
+        ignored here: slot-pool positions are a global resource.
         """
         p = len(prompt)
         if p == 0:
@@ -277,14 +382,6 @@ class SlotEngine:
         self.last_finite = np.asarray(finite)
         return np.asarray(toks)
 
-    def step(self) -> np.ndarray:
-        """One decode step for the whole pool; tokens (max_slots,).
-        Token-granular stepping — requires decode_burst=1 (use
-        step_burst for the amortized path)."""
-        if self.config.decode_burst != 1:
-            raise RuntimeError("step() needs decode_burst=1")
-        return self.step_burst()[0]
-
     def poison_slot(self, slot: int) -> None:
         """Overwrite one slot's pending sampling input with NaN — the
         deterministic stand-in for a numerical blow-up (serve/faults.py
@@ -313,14 +410,306 @@ class SlotEngine:
         self._attn_starts = jnp.zeros_like(self._attn_starts)
         self.cursor = self.base_cursor
 
-    def compile_stats(self) -> dict:
-        """Jit cache sizes — the no-recompilation-churn observable.
 
-        After warmup (one admit per bucket width in play, one decode
-        step), these counts must stay CONSTANT however many requests
-        churn through (tests/test_serve_scheduler.py pins this).
+class PagedEngine(_EngineBase):
+    """Paged-KV continuous batching: per-slot page tables, no shared clock.
+
+    Same two-jitted-programs contract and public surface as SlotEngine
+    (the scheduler drives either through `admit_gate` / `admit` /
+    `step_burst` / `release`), but the cache is a pool of fixed-size
+    blocks (serve/kv_pages.py) and every slot decodes at its OWN
+    slot-local write position:
+
+    - `admit` prefills the bucketed prompt into a batch-1 contiguous
+      scratch cache at positions [0, w) and scatters it into freshly
+      allocated blocks (one compile per bucket width, as before);
+    - `step_burst` appends each active slot's token at `lengths[slot]`
+      through the page table and attends only that slot's occupied
+      pages (ops/decode_attention.paged_decode_attention) — a step's
+      attention span is the request's own context, not a pool-global
+      [0, max_len);
+    - `release` returns the slot's blocks to the free list individually;
+      nothing ever drains and nothing rewinds (no reset_epoch here);
+    - a request may decode past the model's / slot engine's max_len:
+      per-slot capacity is `max_blocks_per_slot * block_size` and RoPE
+      positions are unbounded.
+
+    Block accounting is LAZY with a worst-case reservation: admission
+    reserves `ceil((bucket + max_positions) / block_size)` blocks (so a
+    running request can never starve mid-decode — the deadlock-freedom
+    the slot engine got from headroom gating), allocates only the prompt
+    blocks up front, and draws the rest from its reservation at burst
+    granularity as the context actually grows.
+    """
+
+    def __init__(self, model, params, config: EngineConfig = EngineConfig(),
+                 *, batch_stats: Any = None) -> None:
+        if getattr(model, "pos_emb", None) != "rope":
+            raise ValueError(
+                "PagedEngine needs pos_emb='rope' — slots decode at "
+                "slot-local positions, which only relative positions "
+                "survive (models/lm.py)"
+            )
+        if not config.prompt_buckets:
+            raise ValueError("prompt_buckets must be non-empty")
+        if config.decode_burst < 1:
+            raise ValueError("decode_burst must be >= 1")
+        if config.block_size < 1:
+            raise ValueError("block_size must be positive")
+        self.model = model
+        self.params = params
+        self.batch_stats = batch_stats
+        self.config = config
+        self.max_len = config.max_len or model.max_len
+        self.buckets = tuple(sorted(set(config.prompt_buckets)))
+        bs = config.block_size
+        self.max_blocks_per_slot = (
+            config.max_blocks_per_slot or -(-self.max_len // bs)
+        )
+        self.max_context = self.max_blocks_per_slot * bs
+        if self.buckets[-1] > min(self.max_context - 1, model.max_len):
+            raise ValueError(
+                f"largest prompt bucket {self.buckets[-1]} must fit the "
+                f"scratch prefill (model max_len {model.max_len}) and "
+                f"leave decode room in the per-slot capacity "
+                f"{self.max_context}"
+            )
+        s = config.max_slots
+        num_blocks = (
+            config.num_blocks or 1 + s * self.max_blocks_per_slot
+        )
+        self.allocator = SlotAllocator(s)     # slot ids (metrics reads it)
+        self.blocks = BlockAllocator(num_blocks)
+        self._cache = make_paged_cache(model, num_blocks, bs)
+        self._last_logits = jnp.zeros((s, model.vocab_size), model.dtype)
+        self._keys = jnp.zeros((s, 2), jnp.uint32)
+        self._active = np.zeros((s,), bool)
+        # host-side per-slot state; tiny, shipped to device per dispatch
+        self._pt = np.zeros((s, self.max_blocks_per_slot), np.int32)
+        self._len = np.zeros((s,), np.int32)
+        self._attn = np.zeros((s,), np.int32)
+        self._nblk = np.zeros((s,), np.int64)   # blocks allocated
+        self._resv = np.zeros((s,), np.int64)   # blocks still reserved
+        self.last_finite = np.ones((1, s), bool)
+        self._prefill_jit = jax.jit(self._prefill_admit)
+        self._decode_jit = jax.jit(
+            self._decode_burst, donate_argnums=_decode_donate()
+        )
+
+    # ---------------------------------------------------------------- jitted
+    def _prefill_admit(self, params, pool, last_logits, tokens,
+                       attn_start, block_ids, slot):
+        """tokens (1, w) left-padded; one compile per bucket width w.
+
+        The scratch cache starts at cursor 0 — slot-local coordinates —
+        so admission is placement-free: no alignment to anyone else's
+        cursor, just a scatter of the w prefilled rows into this slot's
+        blocks."""
+        w = tokens.shape[1]
+        scratch = make_cache(self.model, 1, w)
+        scratch, logits = decode_apply(
+            self.model, params, scratch, tokens,
+            attn_start=attn_start[None], batch_stats=self.batch_stats,
+        )
+        pool = scatter_prompt_blocks(
+            pool, scratch, block_ids, w, self.config.block_size
+        )
+        last_logits = lax.dynamic_update_slice(
+            last_logits, logits[:, -1].astype(last_logits.dtype), (slot, 0)
+        )
+        return pool, last_logits
+
+    def _decode_burst(self, params, pool, last_logits, attn_starts,
+                      active, keys, page_table, lengths):
+        """lax.scan of `decode_burst` paged single-token steps. Each step
+        writes active slots' K/V at their own `lengths` position and
+        advances only active lengths; retired slots keep scattering into
+        the garbage block (kv_pages.GARBAGE_BLOCK) so shapes stay static."""
+
+        def body(carry, _):
+            pool, last_logits, keys, lengths = carry
+            finite = jnp.isfinite(last_logits).all(axis=-1)
+            toks, keys = _sample_step(self.config, last_logits, active, keys)
+            pool, logits = decode_apply(
+                self.model, params, pool, toks[:, None],
+                attn_start=attn_starts, batch_stats=self.batch_stats,
+                page_table=page_table, kv_lengths=lengths,
+            )
+            lengths = lengths + active.astype(lengths.dtype)
+            return (pool, logits[:, -1], keys, lengths), (toks, finite)
+
+        (pool, last_logits, keys, _), (toks, finite) = lax.scan(
+            body, (pool, last_logits, keys, lengths), None,
+            length=self.config.decode_burst,
+        )
+        return pool, last_logits, toks, keys, finite
+
+    # ----------------------------------------------------------------- host
+    def _blocks_for(self, positions: int) -> int:
+        return -(-positions // self.config.block_size)
+
+    @property
+    def blocks_available(self) -> int:
+        """Free blocks not spoken for by running requests' reservations —
+        what admission can actually promise to a new request."""
+        return self.blocks.num_free - int(self._resv.sum())
+
+    @property
+    def headroom(self) -> int:
+        """Unreserved pool positions (informational — admission gates on
+        blocks per request, not on a global span)."""
+        return self.blocks_available * self.config.block_size
+
+    def admit_gate(self, prompt_len: int, needed_positions: int) -> str:
+        """"ok" | "later" (blocks free as running requests release) |
+        "never" (outgrows every bucket or the per-slot capacity)."""
+        try:
+            w = self.bucket_for(prompt_len)
+        except ValueError:
+            return "never"
+        if w + needed_positions > self.max_context:
+            return "never"
+        worst = self._blocks_for(w + needed_positions)
+        if worst > self.blocks.num_blocks - 1:
+            return "never"  # outgrows the whole pool, even empty
+        if worst > self.blocks_available:
+            return "later"
+        return "ok"
+
+    def make_room(self) -> bool:
+        """Nothing to do: pages free individually at release — there is
+        no epoch to rewind (the scheduler's drain path never triggers)."""
+        return False
+
+    def admit(self, prompt: Sequence[int], *, seed: int = 0,
+              max_positions: Optional[int] = None) -> int:
+        """Prefill `prompt` into a free slot + fresh blocks; the slot id.
+
+        `max_positions` is the request's decode-position budget
+        (burst-rounded max_new_tokens from the scheduler) — it sizes the
+        block reservation that guarantees the request can always finish.
+        None reserves up to the per-slot capacity (direct engine users:
+        fine for tests, wasteful under concurrency).
         """
-        return {
-            "prefill_compiles": self._prefill_jit._cache_size(),
-            "decode_compiles": self._decode_jit._cache_size(),
-        }
+        p = len(prompt)
+        if p == 0:
+            raise ValueError("prompt must contain at least one token")
+        w = self.bucket_for(p)
+        if max_positions is None:
+            max_positions = self.max_context - w
+        if w + max_positions > self.max_context:
+            raise ValueError(
+                f"prompt bucket {w} + max_positions {max_positions} "
+                f"exceeds the per-slot capacity {self.max_context} "
+                f"(= max_blocks_per_slot * block_size)"
+            )
+        worst = self._blocks_for(w + max_positions)
+        if worst > self.blocks_available:
+            raise RuntimeError(
+                "not enough free blocks — scheduler must gate admits"
+            )
+        slot = self.allocator.alloc()
+        if slot is None:
+            raise RuntimeError("no free slot — scheduler must gate admits")
+        n_prompt = self._blocks_for(w)
+        ids = self.blocks.alloc(n_prompt)
+        assert ids is not None  # worst >= n_prompt <= blocks_available
+        self._pt[slot, :] = 0
+        self._pt[slot, :n_prompt] = ids
+        self._nblk[slot] = n_prompt
+        self._resv[slot] = worst - n_prompt
+        self._len[slot] = w
+        self._attn[slot] = w - p
+        padded = np.full((1, w), self.config.pad_id, np.int32)
+        padded[0, w - p:] = np.asarray(prompt, np.int32)
+        self._cache, self._last_logits = self._prefill_jit(
+            self.params, self._cache, self._last_logits,
+            jnp.asarray(padded), jnp.int32(w - p),
+            jnp.asarray(ids, jnp.int32), jnp.int32(slot),
+        )
+        # keyed by the REQUEST's seed alone, as in SlotEngine: placement
+        # must stay invisible to the sample stream
+        self._keys = self._keys.at[slot].set(jax.random.PRNGKey(seed))
+        self._active[slot] = True
+        return slot
+
+    def _grow_tables(self, k: int) -> None:
+        """Allocate the blocks the next k decode positions need, per
+        active slot, drawing from each slot's reservation (so allocation
+        cannot fail mid-decode — exhaustion was settled at admission).
+        Stepping a slot past what its admission reserved raises BEFORE
+        touching the allocator (the analogue of SlotEngine's
+        positions-exhausted guard; the scheduler's burst-rounded
+        max_positions never trips it)."""
+        for slot in np.flatnonzero(self._active):
+            need = self._blocks_for(int(self._len[slot]) + k)
+            grow = need - int(self._nblk[slot])
+            if grow <= 0:
+                continue
+            if grow > int(self._resv[slot]) or need > self.max_blocks_per_slot:
+                raise RuntimeError(
+                    f"slot {slot} stepped past its admit-time block "
+                    f"reservation (needs {need} blocks, has "
+                    f"{int(self._nblk[slot])} + {int(self._resv[slot])} "
+                    f"reserved) — admit with a larger max_positions"
+                )
+            ids = self.blocks.alloc(grow)
+            # cannot fail: sum(_resv) <= blocks.num_free is the admission
+            # invariant, and grow <= _resv[slot] was just checked
+            assert ids is not None, "reservation accounting broke"
+            self._pt[slot, self._nblk[slot]:need] = ids
+            self._nblk[slot] = need
+            self._resv[slot] -= grow
+
+    def step_burst(self) -> np.ndarray:
+        """One dispatch of `decode_burst` steps; tokens (K, max_slots).
+        Per-slot lengths advance by K for active slots; free slots emit
+        pad_id and write only the garbage block."""
+        k = self.config.decode_burst
+        self._grow_tables(k)
+        (self._cache, self._last_logits, toks,
+         self._keys, finite) = self._decode_jit(
+            self.params, self._cache, self._last_logits,
+            jnp.asarray(self._attn), jnp.asarray(self._active),
+            self._keys, jnp.asarray(self._pt), jnp.asarray(self._len),
+        )
+        self._len[self._active] += k
+        toks, finite = jax.device_get((toks, finite))
+        self.last_finite = np.asarray(finite)
+        return np.asarray(toks)
+
+    def context_len(self, slot: int) -> int:
+        """The slot's current context length (bucket width + decoded
+        tokens) — can exceed the model's max_len, the paged headline."""
+        return int(self._len[slot])
+
+    def poison_slot(self, slot: int) -> None:
+        """NaN one slot's pending sampling input (serve/faults.py) —
+        identical contract to SlotEngine.poison_slot."""
+        self._last_logits = self._last_logits.at[slot].set(jnp.nan)
+
+    def release(self, slot: int) -> None:
+        """Free the slot and return its blocks to the pool individually.
+        The page-table row is pointed back at the garbage block so the
+        batched decode keeps static shapes; stale K/V in the freed
+        blocks is invisible to the next occupant (masked to its own
+        written positions — pinned in tests/test_kv_pages.py)."""
+        n = int(self._nblk[slot])
+        if n:
+            self.blocks.free([int(b) for b in self._pt[slot, :n]])
+        self.allocator.free(slot)
+        self._pt[slot, :] = 0
+        self._nblk[slot] = 0
+        self._resv[slot] = 0
+        self._len[slot] = 0
+        self._attn[slot] = 0
+        self._active[slot] = False
+
+    def reset_epoch(self) -> None:
+        """Interface parity with SlotEngine (the router calls this in
+        warmup() and replica restart()): there is no pool clock to
+        rewind — every release already returned its pages — so with all
+        slots free this is a no-op; with active slots it raises, same
+        contract as the slot pool."""
+        if self.allocator.num_used:
+            raise RuntimeError("reset_epoch with active slots")

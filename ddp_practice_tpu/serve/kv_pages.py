@@ -1,0 +1,147 @@
+"""Paged KV-cache pool: block-granular memory for continuous batching.
+
+The slot pool (kv_slots.py) shares ONE write cursor: every decode step
+consumes a position for all slots, the pool drains in
+`max_len - max_bucket` steps between epoch rewinds, and decode attention
+scans the whole `[0, max_len)` span every step — BENCHMARKS.md measured
+the span cost directly (halving max_len moved continuous/static
+throughput 0.54x -> ~1.0x). This module replaces positions-as-a-global-
+resource with vLLM-style paging:
+
+- the flax "cache" collection of a decode-mode model is allocated as a
+  POOL of fixed-size blocks: every `cached_key`/`cached_value` leaf is
+  `(num_blocks, block_size, h*hd)` (same flat minor layout as the slot
+  pool — in-place TPU updates, ops/decode_attention.py);
+- each slot owns a host-side list of blocks plus a device-side PAGE
+  TABLE row (`[max_slots, max_blocks_per_slot]` int32): position `p` of
+  a slot lives in pool block `page_table[slot, p // block_size]` at row
+  `p % block_size`. Positions are SLOT-LOCAL, starting at 0 — there is
+  no shared clock, so nothing drains and nothing rewinds;
+- admission scatters the bucketed scratch prefill into freshly allocated
+  blocks (`scatter_prompt_blocks`), decode appends at each slot's own
+  write position, release returns the slot's blocks to the free list
+  individually, and a request's context can outgrow the slot engine's
+  `max_len` as long as blocks exist.
+
+Block 0 is the pool's designated GARBAGE block: it is never handed out
+by the allocator, and retired slots' page-table rows point at it, so the
+batched decode step can keep scattering for every batch row (static
+shapes, zero recompiles) without a freed slot ever touching a live
+request's pages. Stale K/V inside a reused block is never visible: a new
+occupant's attention is masked to `[attn_start, length]` in its own
+slot-local coordinates, and every position it does attend was written by
+its own prefill/decode (tests/test_kv_pages.py pins this).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ddp_practice_tpu.inference import make_cache
+
+# pool block index reserved as the write target of retired slots; the
+# allocator never hands it out
+GARBAGE_BLOCK = 0
+
+
+class BlockAllocator:
+    """Host-side free-list over the pool's block indices.
+
+    Pure bookkeeping, same idiom as kv_slots.SlotAllocator: freed blocks
+    go to the BACK of the free list, so allocation order is deterministic
+    and reuse is observable in tests. `alloc(n)` is all-or-nothing —
+    a request either gets its blocks or None (the scheduler's admission
+    gate turns None into queueing, never a crash).
+    """
+
+    def __init__(self, num_blocks: int) -> None:
+        if num_blocks <= 1:
+            raise ValueError(
+                f"need at least 2 blocks (block {GARBAGE_BLOCK} is the "
+                f"garbage block), got {num_blocks}"
+            )
+        self.num_blocks = num_blocks
+        self._free: List[int] = list(range(1, num_blocks))
+        self._used: set = set()
+
+    def alloc(self, n: int = 1) -> Optional[List[int]]:
+        """n blocks, or None if fewer than n are free (all-or-nothing)."""
+        if n < 0:
+            raise ValueError(f"cannot allocate {n} blocks")
+        if n > len(self._free):
+            return None
+        blocks = self._free[:n]
+        del self._free[:n]
+        self._used.update(blocks)
+        return blocks
+
+    def free(self, blocks: Sequence[int]) -> None:
+        for b in blocks:
+            if b not in self._used:
+                raise ValueError(f"block {b} is not allocated")
+            self._used.remove(b)
+            self._free.append(b)
+
+    @property
+    def num_used(self) -> int:
+        return len(self._used)
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+
+def make_paged_cache(model, num_blocks: int, block_size: int) -> Any:
+    """Block-pool cache collection for `model` (decode mode).
+
+    Mirrors the tree structure of `inference.make_cache` — same variable
+    names per attention block, so `decode_apply` threads it unchanged —
+    but every K/V leaf is `(num_blocks, block_size, h*hd)` instead of
+    `(batch, max_len, h*hd)`. Scalar leaves (the flat layout's write
+    cursors) stay for tree parity; the paged path never advances them.
+    """
+    if getattr(model, "kv_cache_dtype", None) == "int8":
+        raise ValueError(
+            "paged KV cache does not compose with kv_cache_dtype='int8' "
+            "yet (the scales would need their own page pool)"
+        )
+    shapes = jax.eval_shape(lambda: make_cache(model, 1, block_size))
+    return jax.tree.map(
+        lambda a: jnp.zeros(a.shape, a.dtype) if a.ndim == 0
+        else jnp.zeros((num_blocks,) + a.shape[1:], a.dtype),
+        shapes,
+    )
+
+
+def scatter_prompt_blocks(pool: Any, scratch: Any, block_ids,
+                          width: int, block_size: int) -> Any:
+    """Scatter a batch-1 contiguous scratch cache into pool blocks.
+
+    `scratch` holds a freshly prefilled prompt at positions `[0, width)`
+    of a `(1, width, h*hd)` flat cache; `block_ids` is the
+    `(ceil(width / block_size),)` int32 list of destination blocks (may
+    be traced — admission happens inside jit). Chunk `i` of the scratch
+    lands in pool block `block_ids[i]`; a trailing partial chunk writes
+    only its real rows, so whatever the rest of that block held stays —
+    and stays invisible, because attention is masked to the slot's own
+    positions. Scalar leaves keep the POOL's value (no global clock).
+    """
+    n_chunks = -(-width // block_size)
+
+    def per_leaf(p, s):
+        if p.ndim == 0:
+            return p
+        for i in range(n_chunks):
+            lo = i * block_size
+            rows = min(block_size, width - lo)
+            chunk = lax.dynamic_slice(
+                s, (0, lo, 0), (1, rows, s.shape[2])
+            ).astype(p.dtype)
+            p = lax.dynamic_update_slice(p, chunk, (block_ids[i], 0, 0))
+        return p
+
+    return jax.tree.map(per_leaf, pool, scratch)

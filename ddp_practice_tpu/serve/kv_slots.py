@@ -25,13 +25,17 @@ are relative, so the uniform shift is invisible (models/lm.py requires
 pos_emb="rope" for attn_start).
 
 The cost of the shared cursor is that pool POSITIONS are a global
-resource: every decode step consumes one position for all slots. When
-headroom runs out the scheduler drains active requests and calls
-`reset_cursor` (a per-slot ring/paged layout is the follow-up recorded
-in ROADMAP.md). Stale K/V from a previous occupant is never visible:
-`write_slot` overwrites the slot's ENTIRE row (the scratch cache is
-zeros outside the prompt window), and attention only reads
-`[attn_start, cur]`.
+resource: every decode step consumes one position for all slots, the
+pool drains in `max_len - max_bucket` steps between epoch rewinds
+(engine.reset_epoch via make_room), decode attention pays for the whole
+`[0, max_len)` span every step, and no request can ever span more than
+`max_len` positions. The PAGED layout (kv_pages.py + engine.PagedEngine)
+removes all four costs with per-slot block page tables — this module
+stays as the simpler layout and the equivalence oracle
+(tests/test_serve_equivalence.py drives one trace through both). Stale
+K/V from a previous occupant is never visible: `write_slot` overwrites
+the slot's ENTIRE row (the scratch cache is zeros outside the prompt
+window), and attention only reads `[attn_start, cur]`.
 """
 
 from __future__ import annotations

@@ -36,6 +36,12 @@ class ServeMetrics:
         self.tpot = r.histogram("serve_tpot_s")
         self.queue_depth = r.gauge("serve_queue_depth")
         self.slot_occupancy = r.gauge("serve_slot_occupancy")
+        # paged-engine pool gauges (serve/kv_pages.py): block occupancy
+        # is the paged saturation signal — slots can be free while
+        # blocks are the binding constraint (long contexts) and vice
+        # versa (many short requests). Stay 0 for the slot engine.
+        self.block_occupancy = r.gauge("serve_block_occupancy")
+        self.blocks_free = r.gauge("serve_blocks_free")
         self.tokens_total = r.counter("serve_tokens_total")
         self.submitted = r.counter("serve_requests_submitted")
 
@@ -48,6 +54,18 @@ class ServeMetrics:
         self.queue_depth.set(len(scheduler.queue))
         eng = scheduler.engine
         self.slot_occupancy.set(eng.num_active / eng.allocator.max_slots)
+        blocks = getattr(eng, "blocks", None)  # PagedEngine only
+        if blocks is not None:
+            # count RESERVED blocks as occupied: admission gates on
+            # blocks_available (free minus reservations), so a gauge
+            # built from the raw allocator would show an idle pool
+            # while every new request queues
+            allocatable = blocks.num_blocks - 1  # minus the garbage block
+            available = eng.blocks_available
+            self.block_occupancy.set(
+                (allocatable - available) / allocatable
+            )
+            self.blocks_free.set(available)
 
     def on_complete(self, completion, scheduler) -> None:
         self.registry.counter(f"serve_requests_{completion.status}").inc()

@@ -492,7 +492,12 @@ class Router:
         for h in self.handles:
             eng = h.engine
             for w in widths or eng.buckets:
-                slot = eng.admit([1] * w)
+                # budget only the one warmup burst: a paged replica's
+                # default admit reserves its whole per-slot capacity,
+                # which an oversubscribed block pool can't cover even
+                # though the gated scheduler path serves it fine
+                slot = eng.admit([1] * w,
+                                 max_positions=eng.config.decode_burst)
                 eng.step_burst()
                 eng.release(slot)
             eng.reset_epoch()

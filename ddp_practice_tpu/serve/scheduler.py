@@ -15,12 +15,15 @@ scheduler tick:
 Admission control is two-tier: `submit()` SHEDS when the bounded queue
 is full (backpressure at the door — the overload answer for "heavy
 traffic from millions of users" is a fast no, not an unbounded queue),
-and the admit loop REJECTS requests that can never run (prompt larger
-than every bucket, or more new tokens than a fresh pool has positions).
-When the shared cursor runs out of headroom for the next request the
-scheduler drains active slots, then rewinds the pool clock
-(engine.reset_epoch) and continues — see kv_slots.py for why positions
-are a global resource.
+and the admit loop asks the ENGINE's `admit_gate` for everything
+memory-shaped: "never" (prompt outgrows every bucket, or the request
+can never fit even an empty pool) is a fast reject, "later" waits for
+running requests to release. Memory policy lives behind that gate —
+the slot engine answers from its shared-cursor headroom and frees
+positions only via `make_room` (drain + epoch rewind, kv_slots.py);
+the paged engine answers from unreserved free blocks, which release
+per-request (kv_pages.py), so nothing ever drains and its make_room is
+a no-op. This file carries no epoch logic at all.
 
 Time is injected: the real server uses the monotonic clock, tests use
 `FakeClock` (a fixed virtual step per engine tick), so a 20-request
@@ -176,29 +179,26 @@ class Scheduler:
 
     def _admit(self) -> None:
         eng = self.engine
-        fresh_headroom = eng.max_len - eng.base_cursor
         burst = eng.config.decode_burst
         while self.queue and eng.num_free > 0:
             req = self.queue[0]
-            try:
-                eng.bucket_for(len(req.prompt))
-            except ValueError:
-                self.queue.popleft()
-                self._finish(req, [], "rejected")
-                continue
             # positions consumed are burst-granular: a request finishing
             # mid-burst still rides to the burst boundary
             needed = -(-req.max_new_tokens // burst) * burst
-            if needed > fresh_headroom:
-                # can never fit, even in an empty pool
+            # memory policy is the ENGINE's: the slot engine gates on
+            # global cursor headroom (make_room = drain + epoch rewind),
+            # the paged engine on unreserved free blocks (make_room is a
+            # no-op — pages free individually at release). The scheduler
+            # only distinguishes can't-yet from can't-ever.
+            gate = eng.admit_gate(len(req.prompt), needed)
+            if gate == "later" and eng.make_room():
+                gate = eng.admit_gate(len(req.prompt), needed)
+            if gate == "never":
                 self.queue.popleft()
                 self._finish(req, [], "rejected")
                 continue
-            if eng.headroom < needed:
-                if eng.num_active == 0:
-                    eng.reset_epoch()  # empty pool: rewind the clock
-                else:
-                    break  # drain the running batch first
+            if gate == "later":
+                break  # memory frees as running requests release
             self.queue.popleft()
             if self.fault_hook is not None \
                     and self.fault_hook.take_admit_fault():
@@ -207,7 +207,8 @@ class Scheduler:
                 # on another replica instead of the client seeing silence
                 self._finish(req, [], "error")
                 continue
-            slot = eng.admit(req.prompt, seed=req.seed)
+            slot = eng.admit(req.prompt, seed=req.seed,
+                             max_positions=needed)
             self.running[slot] = _Running(req=req, slot=slot)
 
     # ------------------------------------------------------------ the tick

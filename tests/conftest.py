@@ -50,6 +50,34 @@ def devices():
     return devs
 
 
+@pytest.fixture
+def compile_guard():
+    """Assert-no-new-compiles context manager over serving engines.
+
+    Wraps the engines' jit-cache-size counters (SlotEngine/PagedEngine
+    `compile_stats()`): any XLA compile inside the `with` block — a new
+    prompt bucket, a leaked dynamic shape, a paged-table shape change —
+    fails loudly with the before/after counter diff. The
+    zero-recompiles-under-churn property every serving test pins, as a
+    reusable fixture::
+
+        with compile_guard(engine):
+            ...  # arbitrary admit/step/release churn
+    """
+    from contextlib import contextmanager
+
+    @contextmanager
+    def guard(*engines):
+        before = [e.compile_stats() for e in engines]
+        yield
+        after = [e.compile_stats() for e in engines]
+        assert after == before, (
+            f"new XLA compiles inside compile_guard: {before} -> {after}"
+        )
+
+    return guard
+
+
 @pytest.fixture(autouse=True)
 def _reset_mesh_registry():
     """Tests that set the framework's current mesh (directly or via
