@@ -144,6 +144,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="cap steps per epoch (smoke runs; 0 = full epoch)")
     p.add_argument("--log_every", type=int, default=100)
     p.add_argument("--profile_dir", default=None)
+    p.add_argument("--trace-out", "--trace_out", dest="trace_out",
+                   default=None, metavar="PATH",
+                   help="write a Chrome trace-event JSON of host-side "
+                        "step phases (data/dispatch/block/checkpoint "
+                        "spans) at fit end — open in Perfetto; "
+                        "validate with tools/check_traces.py")
     p.add_argument("--metrics_file", default=None, metavar="PATH",
                    help="append one JSON record per logged step / eval / "
                         "summary (training curves; process 0 only)")
@@ -244,6 +250,7 @@ def config_from_args(args) -> TrainConfig:
         max_steps_per_epoch=args.max_steps,
         log_every_steps=args.log_every,
         profile_dir=args.profile_dir,
+        trace_out=args.trace_out,
         metrics_file=args.metrics_file,
         loader_backend=args.loader,
         steps_per_call=args.steps_per_call,

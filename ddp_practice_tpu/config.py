@@ -257,6 +257,11 @@ class TrainConfig:
     eval_every_epochs: int = 0         # 0 = only at end (reference behavior)
     log_every_steps: int = 100
     profile_dir: Optional[str] = None
+    # write a Chrome trace-event JSON of the host-side step phases
+    # (data / dispatch / block / checkpoint spans, utils/trace.py) at
+    # fit end — open in Perfetto; process 0 only. Complements
+    # profile_dir: that traces the DEVICE, this traces the driver.
+    trace_out: Optional[str] = None
     # append one JSON record per logged train step / eval / run summary
     # (process 0 only) — machine-readable training curves next to the
     # human stdout logs; records carry the global step, so resumed runs

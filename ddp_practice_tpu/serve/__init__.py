@@ -27,7 +27,12 @@ Layers (each its own module, composable and separately testable):
   token-identical under greedy), brown-out degradation;
 - metrics.py   — TTFT/TPOT/queue-depth/occupancy per replica plus the
   fleet counters (retries, failovers, sheds-by-reason, breaker state,
-  brown-out), emitted through the process-0 gate;
+  brown-out), emitted through the process-0 gate (utils/metrics.py
+  render_text() serves the same registry as Prometheus exposition);
+  request-lifecycle SPANS live in utils/trace.py: scheduler/engines/
+  router all take an optional TraceRecorder (`--trace-out` exports
+  Chrome trace JSON; tools/check_traces.py validates it), and every
+  Completion carries a queue/prefill/decode/stall flight record;
 - bench.py     — serve_bench: one Poisson trace through the continuous
   engine, the static-batch baseline, and (--replicas) the router fleet
   with optional --fault-plan goodput runs (BENCHMARKS.md records the

@@ -33,6 +33,12 @@ delivered while a seeded FaultPlan crashes replicas, stalls ticks, or
 poisons logits. replicas=1 with no plan measures the router's own
 overhead against the direct continuous path (should be within noise —
 the router adds host-side bookkeeping only).
+
+Every row (except static, which has no phases) reports a per-phase
+latency breakdown — queue/prefill/decode/stall p50/p99 from the
+completions' flight records — and `--trace-out` writes the run's
+request-lifecycle spans as Chrome trace JSON (utils/trace.py; warmup
+excluded; tracing overhead measured < 1%, BENCHMARKS.md).
 """
 
 from __future__ import annotations
@@ -101,9 +107,27 @@ def _percentiles(xs) -> dict:
     }
 
 
+def _phase_breakdown(completions) -> dict:
+    """Per-phase latency percentiles from the completions' flight
+    records (scheduler/router attach them): WHERE the latency percentile
+    rows' time actually went — queue wait vs prefill vs decode vs
+    stalled (parked between retries / not on any replica)."""
+    out = {}
+    flights = [c.flight for c in completions if c.flight is not None]
+    for key in ("queue_s", "prefill_s", "decode_s", "stall_s"):
+        out[key] = _percentiles([f[key] for f in flights])
+    return out
+
+
+def _make_tracer():
+    from ddp_practice_tpu.utils.trace import TraceRecorder
+
+    return TraceRecorder()
+
+
 def _run_continuous(model, params, trace, *, max_slots, prompt_buckets,
                     max_len, decode_burst, eos_id, paged: bool = False,
-                    block_size: int = 16) -> dict:
+                    block_size: int = 16, tracer=None) -> dict:
     from ddp_practice_tpu.serve.engine import (
         EngineConfig,
         PagedEngine,
@@ -142,7 +166,7 @@ def _run_continuous(model, params, trace, *, max_slots, prompt_buckets,
     # no ServeMetrics inside the timed window: the bench computes its own
     # percentiles from completions, and the static baseline carries no
     # per-tick bookkeeping — keep the measured loops symmetric
-    sched = Scheduler(engine, max_queue=len(trace))
+    sched = Scheduler(engine, max_queue=len(trace), tracer=tracer)
     # warmup compiles outside the timed window: one admit per bucket in
     # play + one decode dispatch, then rewind (slot pool only — paged
     # blocks free individually at release, nothing to rewind)
@@ -157,6 +181,14 @@ def _run_continuous(model, params, trace, *, max_slots, prompt_buckets,
         engine.release(slot)
     if not paged:
         engine.reset_epoch()
+    if tracer is not None:
+        # attach the engine lanes only after warmup, and drop anything
+        # recorded so far: compile-time spans would dwarf the workload
+        from ddp_practice_tpu.utils.trace import label_replica
+
+        engine.set_tracer(tracer, 0)
+        label_replica(tracer, 0, max_slots)
+        tracer.clear()
 
     t0 = time.monotonic()
     i = 0
@@ -203,6 +235,9 @@ def _run_continuous(model, params, trace, *, max_slots, prompt_buckets,
             [c.tpot for c in sched.completions if c.tpot is not None]
         ),
         "latency_s": _percentiles(lat),
+        # per-phase breakdown of the same latency population (flight
+        # records: queue wait / prefill / decode / stall percentiles)
+        "phases": _phase_breakdown(sched.completions),
         "completions": len(sched.completions),
         "compile_stats": engine.compile_stats(),
     }
@@ -210,7 +245,7 @@ def _run_continuous(model, params, trace, *, max_slots, prompt_buckets,
 
 def _run_router(model, params, trace, *, replicas, max_slots,
                 prompt_buckets, max_len, decode_burst, eos_id,
-                fault_plan=None) -> dict:
+                fault_plan=None, tracer=None) -> dict:
     """The fleet path: N identical replicas behind the fault-tolerant
     router (serve/router.py). Scored like the continuous server — useful
     tokens of requests that finished ok — which under an injected
@@ -230,12 +265,15 @@ def _run_router(model, params, trace, *, replicas, max_slots,
         max_queue=len(trace),
         config=RouterConfig(),
         fault_plan=fault_plan,
+        tracer=tracer,
     )
     # warm EVERY configured bucket, not just the trace prompts' widths:
     # failover re-prefills carry prompt+salvaged-tokens and can land in
     # a larger bucket — its compile must happen out here, not inside the
     # timed goodput window
     router.warmup()
+    if tracer is not None:
+        tracer.clear()  # drop warmup spans; keep the workload timeline
 
     t0 = time.monotonic()
     i = 0
@@ -274,6 +312,9 @@ def _run_router(model, params, trace, *, replicas, max_slots,
         "ttft_s": _percentiles([c.ttft for c in ok if c.ttft is not None]),
         "tpot_s": _percentiles([c.tpot for c in ok if c.tpot is not None]),
         "latency_s": _percentiles([c.finish - c.arrival for c in ok]),
+        # phase breakdown over the same ok population as latency_s;
+        # stall_s here includes retry parking + dead-replica gaps
+        "phases": _phase_breakdown(ok),
         "completions": len(router.completions),
         "statuses": statuses,
         "retries": m.retries.value,
@@ -395,6 +436,11 @@ def serve_bench(
     # and leaves the paged row flat (BENCHMARKS.md)
     paged: bool = False,
     block_size: int = 16,
+    # Chrome trace-event JSON output (utils/trace.py): the recorder
+    # rides the ROUTER run when replicas >= 1, else the continuous run
+    # (warmup spans excluded either way). Validate/eyeball with
+    # tools/check_traces.py; None = tracing fully off.
+    trace_out: Optional[str] = None,
 ) -> dict:
     """Replay one Poisson trace through both servers; return the report."""
     model, params = _build_model(
@@ -406,10 +452,12 @@ def serve_bench(
         prompt_len_range=prompt_len_range, max_new_range=max_new_range,
         seed=seed,
     )
+    tracer = _make_tracer() if trace_out else None
     cont = _run_continuous(
         model, params, trace, max_slots=max_slots,
         prompt_buckets=tuple(prompt_buckets), max_len=max_len,
         decode_burst=decode_burst, eos_id=eos_id,
+        tracer=None if replicas >= 1 else tracer,
     )
     static = _run_static(
         model, params, trace, max_slots=max_slots,
@@ -450,7 +498,7 @@ def serve_bench(
             model, params, trace, replicas=replicas, max_slots=max_slots,
             prompt_buckets=tuple(prompt_buckets), max_len=max_len,
             decode_burst=decode_burst, eos_id=eos_id,
-            fault_plan=fault_plan,
+            fault_plan=fault_plan, tracer=tracer,
         )
         if fault_plan is not None:
             report["fault_plan"] = fault_plan.to_json()
@@ -458,6 +506,10 @@ def serve_bench(
             report["router"]["tokens_per_sec"] / cont["tokens_per_sec"]
             if cont["tokens_per_sec"] else float("inf")
         )
+    if tracer is not None:
+        tracer.save(trace_out)
+        report["trace_out"] = trace_out
+        report["trace_events"] = len(tracer)
     return report
 
 
@@ -506,6 +558,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "to see the span decoupling")
     p.add_argument("--block-size", dest="block_size", type=int, default=16,
                    help="paged engine: positions per KV block")
+    p.add_argument("--trace-out", "--trace_out", dest="trace_out",
+                   default=None, metavar="PATH",
+                   help="write a Chrome trace-event JSON of the request "
+                        "lifecycle (queued/prefill/decode-burst spans, "
+                        "retry/failover instants; pid=replica, tid=slot) "
+                        "— the router run when --replicas, else the "
+                        "continuous run; open in Perfetto, validate with "
+                        "tools/check_traces.py")
     p.add_argument("--max-len", dest="max_len", type=int, default=None,
                    help="bench: slot-pool span / paged pool sizing "
                         "(default 128); the slot engine's decode cost "
@@ -541,8 +601,15 @@ def _serve_checkpoint(args) -> int:
         ),
         batch_stats=batch_stats,
     )
+    tracer = None
+    if args.trace_out:
+        from ddp_practice_tpu.utils.trace import label_replica
+
+        tracer = _make_tracer()
+        engine.set_tracer(tracer, 0)
+        label_replica(tracer, 0, args.max_slots)
     metrics = ServeMetrics()
-    sched = Scheduler(engine, metrics=metrics)
+    sched = Scheduler(engine, metrics=metrics, tracer=tracer)
     t0 = time.monotonic()
     for i, text in enumerate(prompts):
         toks = encode_bytes(text)[0].tolist()
@@ -561,6 +628,9 @@ def _serve_checkpoint(args) -> int:
               else f"--- request {c.rid} [{c.status}] ---")
         print(prompts[c.rid] + decode_bytes(jnp.asarray(toks)))
     metrics.emit(elapsed)
+    if tracer is not None:
+        tracer.save(args.trace_out)
+        print(f"wrote trace to {args.trace_out} ({len(tracer)} events)")
     return 0
 
 
@@ -579,6 +649,8 @@ def main(argv=None) -> int:
         bench_kw["block_size"] = args.block_size
     if args.max_len is not None:
         bench_kw["max_len"] = args.max_len
+    if args.trace_out:
+        bench_kw["trace_out"] = args.trace_out
     if args.replicas:
         from ddp_practice_tpu.serve.faults import FaultPlan
 
@@ -606,6 +678,17 @@ def main(argv=None) -> int:
                 f"p99 {r['ttft_s']['p99'] * 1e3:7.1f} ms  "
                 f"latency p50 {r['latency_s']['p50'] * 1e3:7.1f} ms"
             )
+            ph = r.get("phases")
+            if ph:
+                print(
+                    "              phases p50/p99 ms:  "
+                    + "  ".join(
+                        f"{k[:-2]} {ph[k]['p50'] * 1e3:.1f}/"
+                        f"{ph[k]['p99'] * 1e3:.1f}"
+                        for k in ("queue_s", "prefill_s", "decode_s",
+                                  "stall_s")
+                    )
+                )
         print(f"  continuous/static throughput: "
               f"{report['throughput_ratio']:.2f}x")
         if "paged" in report:
@@ -629,6 +712,10 @@ def main(argv=None) -> int:
             )
             print(f"  router/continuous throughput: "
                   f"{report['router_vs_continuous']:.2f}x")
+        if "trace_out" in report:
+            print(f"  wrote trace to {report['trace_out']} "
+                  f"({report['trace_events']} events) — validate with "
+                  f"tools/check_traces.py")
     return 0
 
 

@@ -61,6 +61,11 @@ from ddp_practice_tpu.serve.kv_slots import (
     set_cursor,
     write_slot,
 )
+from ddp_practice_tpu.utils.trace import (
+    ENGINE_LANE,
+    NULL_SPAN as _NULL,
+    SLOT_LANE_BASE,
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -139,9 +144,30 @@ def _decode_donate() -> tuple:
 class _EngineBase:
     """What the two memory layouts share: the prompt-bucket map, slot
     accounting over a SlotAllocator at `self.allocator`, the
-    token-granular `step()` veneer over `step_burst`, and the
+    token-granular `step()` veneer over `step_burst`, the
     two-jitted-programs observable (`self._prefill_jit` /
-    `self._decode_jit` set by each subclass __init__)."""
+    `self._decode_jit` set by each subclass __init__), and the optional
+    tracer (`set_tracer`): per-dispatch prefill / decode-burst lane
+    spans plus `jax.profiler.TraceAnnotation` regions NAMED with the
+    dispatch's trace-ids, so a device trace (utils/profiling.py ->
+    utils/xprof.py) lines up with the host spans. tracer=None (default)
+    keeps the dispatch path annotation-free."""
+
+    # set by each subclass __init__ via set_tracer defaults
+    tracer = None
+    replica = 0
+
+    def set_tracer(self, tracer, replica: int = 0) -> None:
+        """Attach a utils/trace.py TraceRecorder; `replica` is this
+        engine's pid in the exported timeline (lane conventions:
+        trace.label_replica)."""
+        self.tracer = tracer
+        self.replica = replica
+
+    def _dispatch_ids(self) -> list:
+        """Active slots' trace-ids in slot order (decode annotation)."""
+        return [self._slot_trace.get(s, f"slot{s}")
+                for s in np.flatnonzero(self._active)]
 
     def bucket_for(self, prompt_len: int) -> int:
         """Smallest bucket width holding `prompt_len` (raises if none)."""
@@ -225,6 +251,7 @@ class SlotEngine(_EngineBase):
         self._keys = jnp.zeros((s, 2), jnp.uint32)
         self._active = np.zeros((s,), bool)
         self.last_finite = np.ones((1, s), bool)  # updated per step_burst
+        self._slot_trace: dict = {}  # slot -> trace_id (tracer attached)
         if config.decode_burst < 1:
             raise ValueError("decode_burst must be >= 1")
         self._prefill_jit = jax.jit(self._prefill_admit)
@@ -320,7 +347,8 @@ class SlotEngine(_EngineBase):
         return False
 
     def admit(self, prompt: Sequence[int], *, seed: int = 0,
-              max_positions: Optional[int] = None) -> int:
+              max_positions: Optional[int] = None,
+              trace_id: Optional[str] = None) -> int:
         """Prefill `prompt` into a free slot; returns the slot index.
 
         The prompt joins exactly where the running batch is: its last
@@ -331,6 +359,8 @@ class SlotEngine(_EngineBase):
         scheduler. `max_positions` is accepted for engine-interface
         parity with PagedEngine (which reserves blocks per request) and
         ignored here: slot-pool positions are a global resource.
+        `trace_id` names the prefill span / profiler annotation when a
+        tracer is attached.
         """
         p = len(prompt)
         if p == 0:
@@ -343,12 +373,24 @@ class SlotEngine(_EngineBase):
         assert start >= 0, (self.cursor, w)  # cursor >= base >= every bucket
         padded = np.full((1, w), self.config.pad_id, np.int32)
         padded[0, w - p:] = np.asarray(prompt, np.int32)
-        (self._cache, self._last_logits,
-         self._attn_starts) = self._prefill_jit(
-            self.params, self._cache, self._last_logits, self._attn_starts,
-            jnp.asarray(padded), jnp.int32(start),
-            jnp.int32(self.cursor - p), jnp.int32(slot),
-        )
+        tr = self.tracer
+        if tr is not None and tr.enabled:
+            tid = trace_id or f"slot{slot}"
+            self._slot_trace[slot] = tid
+            span = tr.span("prefill", trace_id=tid, pid=self.replica,
+                           tid=SLOT_LANE_BASE + slot, bucket=w,
+                           prompt_len=p, slot=slot)
+            ann = jax.profiler.TraceAnnotation(f"serve:prefill:{tid}")
+        else:
+            span = ann = _NULL
+        with span, ann:
+            (self._cache, self._last_logits,
+             self._attn_starts) = self._prefill_jit(
+                self.params, self._cache, self._last_logits,
+                self._attn_starts,
+                jnp.asarray(padded), jnp.int32(start),
+                jnp.int32(self.cursor - p), jnp.int32(slot),
+            )
         # keyed by the REQUEST's seed alone (not the slot), so a
         # request's sampled tokens are independent of where admission
         # happened to place it — batch composition stays invisible
@@ -369,13 +411,26 @@ class SlotEngine(_EngineBase):
             raise RuntimeError(
                 "pool positions exhausted — drain and reset_epoch()"
             )
-        (self._cache, self._last_logits, toks,
-         self._keys, finite) = self._decode_jit(
-            self.params, self._cache, self._last_logits, self._attn_starts,
-            jnp.asarray(self._active), self._keys,
-        )
-        self.cursor += k
-        toks, finite = jax.device_get((toks, finite))
+        tr = self.tracer
+        if tr is not None and tr.enabled:
+            ids = self._dispatch_ids()
+            span = tr.span("decode_burst", pid=self.replica,
+                           tid=ENGINE_LANE, burst=k, active=len(ids),
+                           cursor=self.cursor)
+            ann = jax.profiler.TraceAnnotation(
+                "serve:decode[" + ",".join(ids) + "]"
+            )
+        else:
+            span = ann = _NULL
+        with span, ann:
+            (self._cache, self._last_logits, toks,
+             self._keys, finite) = self._decode_jit(
+                self.params, self._cache, self._last_logits,
+                self._attn_starts,
+                jnp.asarray(self._active), self._keys,
+            )
+            self.cursor += k
+            toks, finite = jax.device_get((toks, finite))
         # (K, max_slots) bool: False rows mark slots whose token this
         # burst was sampled from non-finite logits — the scheduler
         # finishes those requests with status "error"
@@ -395,6 +450,7 @@ class SlotEngine(_EngineBase):
         work happens at release time."""
         self.allocator.free(slot)
         self._active[slot] = False
+        self._slot_trace.pop(slot, None)
 
     def reset_epoch(self) -> None:
         """Rewind the shared cursor to the base (all slots must be free).
@@ -491,6 +547,7 @@ class PagedEngine(_EngineBase):
         self._nblk = np.zeros((s,), np.int64)   # blocks allocated
         self._resv = np.zeros((s,), np.int64)   # blocks still reserved
         self.last_finite = np.ones((1, s), bool)
+        self._slot_trace: dict = {}  # slot -> trace_id (tracer attached)
         self._prefill_jit = jax.jit(self._prefill_admit)
         self._decode_jit = jax.jit(
             self._decode_burst, donate_argnums=_decode_donate()
@@ -582,7 +639,8 @@ class PagedEngine(_EngineBase):
         return False
 
     def admit(self, prompt: Sequence[int], *, seed: int = 0,
-              max_positions: Optional[int] = None) -> int:
+              max_positions: Optional[int] = None,
+              trace_id: Optional[str] = None) -> int:
         """Prefill `prompt` into a free slot + fresh blocks; the slot id.
 
         `max_positions` is the request's decode-position budget
@@ -622,25 +680,38 @@ class PagedEngine(_EngineBase):
         self._attn[slot] = w - p
         padded = np.full((1, w), self.config.pad_id, np.int32)
         padded[0, w - p:] = np.asarray(prompt, np.int32)
-        self._cache, self._last_logits = self._prefill_jit(
-            self.params, self._cache, self._last_logits,
-            jnp.asarray(padded), jnp.int32(w - p),
-            jnp.asarray(ids, jnp.int32), jnp.int32(slot),
-        )
+        tr = self.tracer
+        if tr is not None and tr.enabled:
+            tid = trace_id or f"slot{slot}"
+            self._slot_trace[slot] = tid
+            span = tr.span("prefill", trace_id=tid, pid=self.replica,
+                           tid=SLOT_LANE_BASE + slot, bucket=w,
+                           prompt_len=p, slot=slot, blocks=n_prompt)
+            ann = jax.profiler.TraceAnnotation(f"serve:prefill:{tid}")
+        else:
+            span = ann = _NULL
+        with span, ann:
+            self._cache, self._last_logits = self._prefill_jit(
+                self.params, self._cache, self._last_logits,
+                jnp.asarray(padded), jnp.int32(w - p),
+                jnp.asarray(ids, jnp.int32), jnp.int32(slot),
+            )
         # keyed by the REQUEST's seed alone, as in SlotEngine: placement
         # must stay invisible to the sample stream
         self._keys = self._keys.at[slot].set(jax.random.PRNGKey(seed))
         self._active[slot] = True
         return slot
 
-    def _grow_tables(self, k: int) -> None:
+    def _grow_tables(self, k: int) -> int:
         """Allocate the blocks the next k decode positions need, per
         active slot, drawing from each slot's reservation (so allocation
         cannot fail mid-decode — exhaustion was settled at admission).
         Stepping a slot past what its admission reserved raises BEFORE
         touching the allocator (the analogue of SlotEngine's
         positions-exhausted guard; the scheduler's burst-rounded
-        max_positions never trips it)."""
+        max_positions never trips it). Returns the number of blocks
+        grown (the decode-burst span's `blocks_grown` attribute)."""
+        total_grown = 0
         for slot in np.flatnonzero(self._active):
             need = self._blocks_for(int(self._len[slot]) + k)
             grow = need - int(self._nblk[slot])
@@ -660,21 +731,36 @@ class PagedEngine(_EngineBase):
             self._pt[slot, self._nblk[slot]:need] = ids
             self._nblk[slot] = need
             self._resv[slot] -= grow
+            total_grown += grow
+        return total_grown
 
     def step_burst(self) -> np.ndarray:
         """One dispatch of `decode_burst` steps; tokens (K, max_slots).
         Per-slot lengths advance by K for active slots; free slots emit
         pad_id and write only the garbage block."""
         k = self.config.decode_burst
-        self._grow_tables(k)
-        (self._cache, self._last_logits, toks,
-         self._keys, finite) = self._decode_jit(
-            self.params, self._cache, self._last_logits,
-            jnp.asarray(self._attn), jnp.asarray(self._active),
-            self._keys, jnp.asarray(self._pt), jnp.asarray(self._len),
-        )
-        self._len[self._active] += k
-        toks, finite = jax.device_get((toks, finite))
+        grown = self._grow_tables(k)
+        tr = self.tracer
+        if tr is not None and tr.enabled:
+            ids = self._dispatch_ids()
+            span = tr.span("decode_burst", pid=self.replica,
+                           tid=ENGINE_LANE, burst=k, active=len(ids),
+                           blocks_grown=grown,
+                           blocks_free=self.blocks.num_free)
+            ann = jax.profiler.TraceAnnotation(
+                "serve:decode[" + ",".join(ids) + "]"
+            )
+        else:
+            span = ann = _NULL
+        with span, ann:
+            (self._cache, self._last_logits, toks,
+             self._keys, finite) = self._decode_jit(
+                self.params, self._cache, self._last_logits,
+                jnp.asarray(self._attn), jnp.asarray(self._active),
+                self._keys, jnp.asarray(self._pt), jnp.asarray(self._len),
+            )
+            self._len[self._active] += k
+            toks, finite = jax.device_get((toks, finite))
         self.last_finite = np.asarray(finite)
         return np.asarray(toks)
 
@@ -704,6 +790,7 @@ class PagedEngine(_EngineBase):
         self._len[slot] = 0
         self._attn[slot] = 0
         self._active[slot] = False
+        self._slot_trace.pop(slot, None)
 
     def reset_epoch(self) -> None:
         """Interface parity with SlotEngine (the router calls this in

@@ -31,6 +31,16 @@ Every request ends in a defined terminal status — "eos"/"length" (ok),
 (malformed), or "error" (retry budget exhausted) — the chaos tests'
 none-lost invariant. Time is injected (the schedulers' clock), so a
 FaultPlan replay on FakeClock replicas is bit-for-bit deterministic.
+
+Tracing (utils/trace.py, optional): the router stamps each request's
+trace_id ONCE at intake and passes it through every retry/failover
+re-admission, so a crash-migrated request's spans on the survivor join
+the original timeline — the linkage the chaos tests assert. The router's
+own lane (pid ROUTER_PID) records dispatch / retry / failover /
+brown-out instants; per-replica spans come from the schedulers/engines.
+Final completions carry a merged flight record: per-phase time summed
+across attempts, stall_s = latency not spent on any replica (parked in
+the retry heap, dead-replica gaps), plus retry/failover counts.
 """
 
 from __future__ import annotations
@@ -55,6 +65,11 @@ from ddp_practice_tpu.serve.scheduler import (
 )
 from ddp_practice_tpu.utils.backoff import backoff_delay
 from ddp_practice_tpu.utils.metrics import MetricsRegistry
+from ddp_practice_tpu.utils.trace import (
+    ROUTER_PID,
+    label_replica,
+    label_router,
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -98,6 +113,11 @@ class _Tracked:
     retries: int = 0            # error retries consumed (bounded)
     failovers: int = 0          # crash migrations (not budget-bounded)
     done: bool = False
+    # flight-record phase sums across attempts (sub-completion flights
+    # accumulate here; _finalize derives stall_s as the residual)
+    queue_s: float = 0.0
+    prefill_s: float = 0.0
+    decode_s: float = 0.0
 
 
 class ReplicaHandle:
@@ -156,12 +176,16 @@ class Router:
 
     def __init__(self, schedulers: Sequence[Scheduler], *, clock=None,
                  config: RouterConfig = RouterConfig(),
-                 metrics: Optional[RouterMetrics] = None) -> None:
+                 metrics: Optional[RouterMetrics] = None,
+                 tracer=None) -> None:
         if not schedulers:
             raise ValueError("need at least one replica")
         self.clock = clock or schedulers[0].clock
         self.config = config
         self.metrics = metrics or RouterMetrics()
+        self.tracer = tracer
+        if tracer is not None:
+            label_router(tracer)
         self.handles = [
             ReplicaHandle(i, s, BreakerConfig(
                 trip_after=config.trip_after,
@@ -190,6 +214,10 @@ class Router:
             req.arrival = self.clock.now()
         if req.rid in self.tracked:
             raise ValueError(f"duplicate rid {req.rid}")
+        if req.trace_id is None:
+            # stamped ONCE here: every retry/failover re-admission below
+            # reuses it, so a migrated request is one timeline
+            req.trace_id = f"r{req.rid}"
         cfg = self.config
         if req.deadline is None and cfg.request_timeout_s is not None:
             req.deadline = req.arrival + cfg.request_timeout_s
@@ -267,7 +295,17 @@ class Router:
             seed=req.seed,
             arrival=req.arrival,
             priority=req.priority,
+            # the ORIGINAL trace_id: the survivor's spans join the
+            # migrated request's timeline (tests/test_trace.py)
+            trace_id=req.trace_id,
         )
+        rec = self.tracer
+        if rec is not None and rec.enabled:
+            rec.instant(
+                "dispatch", trace_id=req.trace_id, pid=ROUTER_PID,
+                replica=h.id, attempt=tr.retries + tr.failovers,
+                salvaged=len(tr.prefix),
+            )
         h.scheduler.submit(sub)
         return True
 
@@ -319,6 +357,9 @@ class Router:
             h.health.on_probe(ok, now)
             if ok:
                 h.restart()
+                if self.tracer is not None and self.tracer.enabled:
+                    self.tracer.instant("replica_restart", pid=ROUTER_PID,
+                                        replica=h.id)
             self.metrics.on_replica_state(h.id, h.health.state.value)
 
     def _kill(self, h: ReplicaHandle) -> None:
@@ -328,15 +369,29 @@ class Router:
         h.health.mark_dead(now)
         self.metrics.breaker_trips.inc()
         self.metrics.on_replica_state(h.id, h.health.state.value)
-        for req, tokens, ftt in h.scheduler.evacuate():
+        rec = self.tracer
+        if rec is not None and rec.enabled:
+            rec.instant("replica_dead", pid=ROUTER_PID, replica=h.id)
+        for req, tokens, ftt, phases in h.scheduler.evacuate():
             tr = self.tracked.get(req.rid)
             if tr is None or tr.done:
                 continue
+            # fold the dead attempt's on-replica time into the flight
+            # record — no Completion will ever report it (evacuated
+            # attempts don't finish), and without this the pre-crash
+            # decode work would show up as stall_s
+            tr.queue_s += phases["queue_s"]
+            tr.prefill_s += phases["prefill_s"]
+            tr.decode_s += phases["decode_s"]
             tr.prefix.extend(tokens)
             if tr.first_token_time is None:
                 tr.first_token_time = ftt
             tr.failovers += 1
             self.metrics.failovers.inc()
+            if rec is not None and rec.enabled:
+                rec.instant("failover", trace_id=req.trace_id,
+                            pid=ROUTER_PID, from_replica=h.id,
+                            salvaged=len(tokens))
             if not self._dispatch(tr):
                 self._park_or_shed(tr)
 
@@ -348,6 +403,12 @@ class Router:
             tr = self.tracked.get(c.rid)
             if tr is None or tr.done:
                 continue  # e.g. brown-out sheds already finalized
+            if c.flight is not None:
+                # fold this attempt's on-replica phases into the merged
+                # flight record (_finalize derives stall_s as residual)
+                tr.queue_s += c.flight["queue_s"]
+                tr.prefill_s += c.flight["prefill_s"]
+                tr.decode_s += c.flight["decode_s"]
             if tr.first_token_time is None and c.ttft is not None:
                 tr.first_token_time = tr.req.arrival + c.ttft
             if c.status in ("eos", "length"):
@@ -374,11 +435,17 @@ class Router:
                 tr.retries += 1
                 self.metrics.retries.inc()
                 cfg = self.config
-                self._requeue(tr, backoff_delay(
+                delay = backoff_delay(
                     tr.retries - 1, base_s=cfg.retry_base_s,
                     factor=cfg.retry_factor, max_s=cfg.retry_max_s,
                     jitter=cfg.retry_jitter, seed=cfg.seed + c.rid,
-                ))
+                )
+                rec = self.tracer
+                if rec is not None and rec.enabled:
+                    rec.instant("retry", trace_id=tr.req.trace_id,
+                                pid=ROUTER_PID, replica=h.id,
+                                attempt=tr.retries, delay_s=delay)
+                self._requeue(tr, delay)
 
     def _drain_retries(self) -> None:
         now = self.clock.now()
@@ -425,6 +492,9 @@ class Router:
         if not self.brownout and pressure >= cfg.brownout_on:
             self.brownout = True
             self.metrics.brownout_active.set(1)
+            if self.tracer is not None and self.tracer.enabled:
+                self.tracer.instant("brownout_on", pid=ROUTER_PID,
+                                    pressure=round(pressure, 3))
             # shed low-priority WAITERS too, not just new arrivals — the
             # queue backlog is exactly the overload being answered
             for h in alive:
@@ -444,6 +514,9 @@ class Router:
         elif self.brownout and pressure <= cfg.brownout_off:
             self.brownout = False
             self.metrics.brownout_active.set(0)
+            if self.tracer is not None and self.tracer.enabled:
+                self.tracer.instant("brownout_off", pid=ROUTER_PID,
+                                    pressure=round(pressure, 3))
 
     # ---------------------------------------------------------- finalize
     def _finalize(self, tr: _Tracked, tokens: List[int], status: str,
@@ -455,9 +528,21 @@ class Router:
             ttft = first_token_time - req.arrival
             if len(tokens) > 1:
                 tpot = (now - first_token_time) / (len(tokens) - 1)
+        total = now - req.arrival
+        flight = {
+            "queue_s": tr.queue_s, "prefill_s": tr.prefill_s,
+            "decode_s": tr.decode_s,
+            # latency not spent on any replica: parked in the retry
+            # heap, dead-replica gaps, pre-submit trace lateness
+            "stall_s": max(
+                0.0, total - tr.queue_s - tr.prefill_s - tr.decode_s
+            ),
+            "retries": tr.retries, "failovers": tr.failovers,
+        }
         c = Completion(
             rid=req.rid, tokens=tokens, status=status,
             arrival=req.arrival, finish=now, ttft=ttft, tpot=tpot,
+            flight=flight,
         )
         tr.done = True
         self._pending -= 1
@@ -521,11 +606,15 @@ def make_router(
     fault_plan: Optional[FaultPlan] = None,
     registry: Optional[MetricsRegistry] = None,
     batch_stats=None,
+    tracer=None,
 ) -> Router:
     """Build a fleet of identical replicas (replicated params — the
     sharded-params variant is ROADMAP follow-up) on one shared clock,
     each with its own ServeMetrics (the routing gauges) and, when a
-    FaultPlan targets it, its own deterministic injector."""
+    FaultPlan targets it, its own deterministic injector. `tracer`
+    (utils/trace.py TraceRecorder) threads one recorder through the
+    router, every scheduler, and every engine — pid=replica, labelled
+    lanes — for `--trace-out` Chrome-trace export."""
     if n_replicas < 1:
         raise ValueError("n_replicas must be >= 1")
     clock = clock or MonotonicClock()
@@ -534,12 +623,16 @@ def make_router(
         engine = SlotEngine(
             model, params, engine_config, batch_stats=batch_stats
         )
+        if tracer is not None:
+            engine.set_tracer(tracer, i)
+            label_replica(tracer, i, engine_config.max_slots)
         schedulers.append(Scheduler(
             engine, clock=clock, max_queue=max_queue,
             metrics=ServeMetrics(),
             fault_hook=fault_plan.injector(i) if fault_plan else None,
+            tracer=tracer, replica=i,
         ))
     return Router(
         schedulers, clock=clock, config=config,
-        metrics=RouterMetrics(registry),
+        metrics=RouterMetrics(registry), tracer=tracer,
     )

@@ -28,6 +28,14 @@ a no-op. This file carries no epoch logic at all.
 Time is injected: the real server uses the monotonic clock, tests use
 `FakeClock` (a fixed virtual step per engine tick), so a 20-request
 trace with deadlines replays bit-for-bit deterministically on CPU.
+
+Observability rides the same injected clock: an optional TraceRecorder
+(utils/trace.py) gets per-request "queued"/"request" lifecycle spans and
+shed/timeout/error instants from here (the engines record their own
+prefill/decode-burst lane spans), and every Completion carries a flight
+record — queue_s / prefill_s / decode_s / stall_s — computed from the
+admission timestamps whether or not a tracer is attached. `tracer=None`
+(the default) costs one `is not None` test per lifecycle edge.
 """
 
 from __future__ import annotations
@@ -38,6 +46,7 @@ from collections import deque
 from typing import Deque, Dict, List, Optional, Sequence
 
 from ddp_practice_tpu.serve.engine import SlotEngine
+from ddp_practice_tpu.utils.trace import ENGINE_LANE
 
 
 class MonotonicClock:
@@ -87,6 +96,17 @@ class Request:
     # — priority is the ROUTER's degradation signal (serve/router.py
     # sheds priority >= its threshold while browned out).
     priority: int = 0
+    # stable id linking every span this request produces — across retry
+    # and failover re-admissions (the router stamps it once and passes
+    # it through to sub-requests, so a crash-migrated request renders as
+    # ONE timeline). Stamped "r{rid}" by submit() when None.
+    trace_id: Optional[str] = None
+    # when submit() actually ran (clock domain; stamped by submit) —
+    # flight records measure in-queue wait from here. `arrival` may
+    # predate it (trace replays poll late; failover re-admissions keep
+    # the ORIGINAL arrival): that earlier wait lands in stall_s, not
+    # queue_s, so per-replica queue time stays honest under retries.
+    submitted: Optional[float] = None
 
 
 @dataclasses.dataclass
@@ -102,6 +122,30 @@ class Completion:
     finish: float
     ttft: Optional[float] = None   # arrival -> first generated token
     tpot: Optional[float] = None   # mean inter-token latency after the first
+    # flight record: where this request's latency went —
+    # {queue_s, prefill_s, decode_s, stall_s, retries, failovers}.
+    # The scheduler fills the phase keys (retries/failovers stay 0);
+    # the router re-derives them summed across attempts (router.py).
+    flight: Optional[dict] = None
+
+
+def _attempt_phases(req: Request, now: float,
+                    admitted: Optional[tuple]) -> dict:
+    """One attempt's flight-record phases up to the `now` edge.
+
+    The single source of the phase arithmetic — `_finish` (completed
+    attempts) and `evacuate` (crash-harvested attempts) must agree, or
+    the router's merged stall_s residual silently skews. queue_s runs
+    from submit (see Request.submitted); `admitted` is the
+    (admit_t0, admit_t1) window, None while still queued.
+    """
+    sub = req.submitted if req.submitted is not None else req.arrival
+    if admitted is None:
+        return {"queue_s": max(0.0, now - sub),
+                "prefill_s": 0.0, "decode_s": 0.0}
+    a0, a1 = admitted
+    return {"queue_s": max(0.0, a0 - sub),
+            "prefill_s": a1 - a0, "decode_s": now - a1}
 
 
 @dataclasses.dataclass
@@ -110,13 +154,18 @@ class _Running:
     slot: int
     tokens: List[int] = dataclasses.field(default_factory=list)
     first_token_time: Optional[float] = None
+    # admission window (clock domain): prefill_s = admit_t1 - admit_t0,
+    # decode_s runs from admit_t1 to the finish edge
+    admit_t0: float = 0.0
+    admit_t1: float = 0.0
 
 
 class Scheduler:
     """FIFO continuous-batching scheduler over one SlotEngine."""
 
     def __init__(self, engine: SlotEngine, *, clock=None, max_queue: int = 64,
-                 metrics=None, fault_hook=None) -> None:
+                 metrics=None, fault_hook=None, tracer=None,
+                 replica: int = 0) -> None:
         self.engine = engine
         self.clock = clock or MonotonicClock()
         self.max_queue = max_queue
@@ -124,6 +173,11 @@ class Scheduler:
         # optional chaos hook (serve/faults.py FaultInjector): None in
         # production — the only cost then is one `is not None` per tick
         self.fault_hook = fault_hook
+        # optional TraceRecorder (utils/trace.py); `replica` is this
+        # scheduler's pid in the exported timeline. The engine keeps its
+        # own tracer reference (set_tracer) for its dispatch lanes.
+        self.tracer = tracer
+        self.replica = replica
         self.queue: Deque[Request] = deque()
         self.running: Dict[int, _Running] = {}  # slot -> state
         self.completions: List[Completion] = []
@@ -135,6 +189,9 @@ class Scheduler:
         silence."""
         if req.arrival is None:
             req.arrival = self.clock.now()
+        if req.trace_id is None:
+            req.trace_id = f"r{req.rid}"
+        req.submitted = self.clock.now()
         if req.max_new_tokens < 1:
             # needed=0 would slip past every headroom guard and a
             # zero-token request would still emit one token — a fast
@@ -151,17 +208,42 @@ class Scheduler:
 
     # ------------------------------------------------------------ internals
     def _finish(self, req: Request, tokens: List[int], status: str,
-                first_token_time: Optional[float] = None) -> Completion:
+                first_token_time: Optional[float] = None,
+                admitted: Optional[tuple] = None) -> Completion:
         now = self.clock.now()
         ttft = tpot = None
         if first_token_time is not None:
             ttft = first_token_time - req.arrival
             if len(tokens) > 1:
                 tpot = (now - first_token_time) / (len(tokens) - 1)
+        # flight record: phase breakdown of this attempt's latency;
+        # anything before submit, and nothing else, lands in stall_s
+        flight = _attempt_phases(req, now, admitted)
+        total = now - req.arrival
+        flight["stall_s"] = max(0.0, total - sum(flight.values()))
+        flight["retries"] = flight["failovers"] = 0
         c = Completion(
             rid=req.rid, tokens=tokens, status=status,
             arrival=req.arrival, finish=now, ttft=ttft, tpot=tpot,
+            flight=flight,
         )
+        tr = self.tracer
+        if tr is not None and tr.enabled:
+            if admitted is None:
+                # never admitted: its whole life here was the queue
+                sub = (req.submitted if req.submitted is not None
+                       else req.arrival)
+                tr.record_async("queued", sub, now, trace_id=req.trace_id,
+                                pid=self.replica)
+            if status not in ("eos", "length"):
+                tr.instant(status, trace_id=req.trace_id, pid=self.replica,
+                           tid=ENGINE_LANE, rid=req.rid)
+            tr.record_async(
+                "request", req.arrival, now, trace_id=req.trace_id,
+                pid=self.replica,
+                attrs={"rid": req.rid, "status": status,
+                       "tokens": len(tokens)},
+            )
         self.completions.append(c)
         if self.metrics:
             self.metrics.on_complete(c, self)
@@ -180,6 +262,7 @@ class Scheduler:
     def _admit(self) -> None:
         eng = self.engine
         burst = eng.config.decode_burst
+        tr = self.tracer
         while self.queue and eng.num_free > 0:
             req = self.queue[0]
             # positions consumed are burst-granular: a request finishing
@@ -195,10 +278,20 @@ class Scheduler:
                 gate = eng.admit_gate(len(req.prompt), needed)
             if gate == "never":
                 self.queue.popleft()
+                if tr is not None and tr.enabled:
+                    tr.instant("admit_never", trace_id=req.trace_id,
+                               pid=self.replica, tid=ENGINE_LANE,
+                               prompt_len=len(req.prompt), needed=needed)
                 self._finish(req, [], "rejected")
                 continue
             if gate == "later":
-                break  # memory frees as running requests release
+                # memory frees as running requests release; one instant
+                # per blocked tick (the ring buffer bounds the flood)
+                if tr is not None and tr.enabled:
+                    tr.instant("admit_blocked", trace_id=req.trace_id,
+                               pid=self.replica, tid=ENGINE_LANE,
+                               queue=len(self.queue))
+                break
             self.queue.popleft()
             if self.fault_hook is not None \
                     and self.fault_hook.take_admit_fault():
@@ -207,9 +300,19 @@ class Scheduler:
                 # on another replica instead of the client seeing silence
                 self._finish(req, [], "error")
                 continue
+            t_admit0 = self.clock.now()
             slot = eng.admit(req.prompt, seed=req.seed,
-                             max_positions=needed)
-            self.running[slot] = _Running(req=req, slot=slot)
+                             max_positions=needed, trace_id=req.trace_id)
+            t_admit1 = self.clock.now()
+            if tr is not None and tr.enabled:
+                sub = req.submitted if req.submitted is not None \
+                    else req.arrival
+                tr.record_async("queued", sub, t_admit0,
+                                trace_id=req.trace_id, pid=self.replica,
+                                attrs={"slot": slot})
+            self.running[slot] = _Running(
+                req=req, slot=slot, admit_t0=t_admit0, admit_t1=t_admit1,
+            )
 
     # ------------------------------------------------------------ the tick
     def step(self) -> List[Completion]:
@@ -239,6 +342,7 @@ class Scheduler:
                         self._finish(
                             st.req, st.tokens, "error",
                             st.first_token_time,
+                            admitted=(st.admit_t0, st.admit_t1),
                         )
                         continue
                     tok = int(row[slot])
@@ -262,6 +366,7 @@ class Scheduler:
                         self._finish(
                             st.req, st.tokens, done_status,
                             st.first_token_time,
+                            admitted=(st.admit_t0, st.admit_t1),
                         )
                 if not self.running:
                     break  # the rest of the burst is free-slot padding
@@ -291,16 +396,23 @@ class Scheduler:
     def evacuate(self) -> List[tuple]:
         """Pull every queued and in-flight request off this scheduler —
         the failover harvest after a crash. Returns (request,
-        tokens_so_far, first_token_time) triples; tokens_so_far were
-        already read back to the host before the crash, so the router
-        can re-admit prompt+tokens on a surviving replica. Touches no
+        tokens_so_far, first_token_time, phases) tuples; tokens_so_far
+        were already read back to the host before the crash, so the
+        router can re-admit prompt+tokens on a surviving replica.
+        `phases` is the attempt's flight-record fragment (queue_s /
+        prefill_s / decode_s up to the evacuation edge) — no Completion
+        is ever appended for an evacuated attempt, so without this the
+        pre-crash work would be misreported as stall time. Touches no
         device state (the replica may be gone); `restart()` on the
         handle resets the engine when the replica comes back."""
+        now = self.clock.now()
         out = []
         for st in self.running.values():
-            out.append((st.req, st.tokens, st.first_token_time))
+            out.append((st.req, st.tokens, st.first_token_time,
+                        _attempt_phases(st.req, now,
+                                        (st.admit_t0, st.admit_t1))))
         for req in self.queue:
-            out.append((req, [], None))
+            out.append((req, [], None, _attempt_phases(req, now, None)))
         self.running.clear()
         self.queue.clear()
         return out

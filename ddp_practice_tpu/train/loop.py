@@ -33,6 +33,7 @@ from ddp_practice_tpu.train.state import create_state, make_optimizer
 from ddp_practice_tpu.utils.logging import get_logger, main_process_only
 from ddp_practice_tpu.utils.profiling import profile_region, step_annotation
 from ddp_practice_tpu.utils.timing import Timer
+from ddp_practice_tpu.utils.trace import NULL_SPAN as _NULL_SPAN
 
 log = get_logger()
 
@@ -483,6 +484,18 @@ class Trainer:
         self._train_images = 0
         self._train_seconds = 0.0
         self.eval_perplexity = None  # set by _evaluate_lm
+        # host-side step-phase tracing (utils/trace.py): data / dispatch /
+        # block / checkpoint spans into the same recorder family the
+        # serving stack uses, written as Chrome trace JSON at fit end.
+        # Device-side profiles (profile_dir) line up with these by wall
+        # clock; process 0 only, None = zero overhead.
+        self._tracer = None
+        if config.trace_out and dist.process_index() == 0:
+            from ddp_practice_tpu.utils.trace import TraceRecorder
+
+            self._tracer = TraceRecorder()
+            self._tracer.set_process_name(0, "train")
+            self._tracer.set_thread_name(0, 0, "steps")
         # XLA:CPU's in-process collective rendezvous can deadlock when more
         # than one execution of a collective-bearing program is in flight
         # (device threads join different run_ids). On the CPU dev platform,
@@ -505,6 +518,35 @@ class Trainer:
         from collections import deque
 
         self._pending = deque()
+
+    def _tspan(self, name: str, **attrs):
+        """A step-phase span on the train lane, or a no-op without
+        --trace-out (one attribute test on the hot path)."""
+        if self._tracer is None:
+            return _NULL_SPAN
+        return self._tracer.span(name, pid=0, tid=0, **attrs)
+
+    def _traced_batches(self, items):
+        """Wrap the prefetch stream so the time spent WAITING for the
+        next batch (host data stall) shows up as "data" spans."""
+        it = iter(items)
+        while True:
+            with self._tspan("data"):
+                try:
+                    item = next(it)
+                except StopIteration:
+                    return
+            yield item
+
+    def _save_trace(self) -> None:
+        if self._tracer is None:
+            return
+        try:
+            self._tracer.save(self.config.trace_out)
+            info0("wrote host trace to %s (%d events)",
+                  self.config.trace_out, len(self._tracer))
+        except OSError:
+            log.exception("could not write --trace_out")
 
     def _track(self, scalar) -> None:
         """Record one step's scalar metric future on the progress ladder."""
@@ -686,7 +728,8 @@ class Trainer:
         if cfg.log_every_steps and (
             prev // cfg.log_every_steps != steps_done // cfg.log_every_steps
         ):
-            m = jax.device_get(metrics)
+            with self._tspan("block", step=steps_done):
+                m = jax.device_get(metrics)
             if self._watchdog is not None:
                 self._watchdog.beat()  # the device_get confirmed progress
             info0(
@@ -734,12 +777,13 @@ class Trainer:
         ladder rung by rung (beats during the wait), then close timing on
         a scalar readback — the only progress signal that fences on every
         transport (block_until_ready may not — BENCHMARKS.md)."""
-        self._drain_pending()
-        jax.block_until_ready(self.state.params)
-        if final_metrics is not None:
-            jax.device_get(final_metrics["loss"])
-            if self._watchdog is not None:
-                self._watchdog.beat()
+        with self._tspan("block", at="epoch_end"):
+            self._drain_pending()
+            jax.block_until_ready(self.state.params)
+            if final_metrics is not None:
+                jax.device_get(final_metrics["loss"])
+                if self._watchdog is not None:
+                    self._watchdog.beat()
 
     def _train_epoch_resident(self, epoch: int) -> dict:
         """One epoch against the HBM-resident corpus: the only H2D traffic
@@ -774,8 +818,12 @@ class Trainer:
             profiling = True
         try:
             for g0 in range(0, total, g):
-                rows = jax.device_put(idx[g0 : g0 + g], self._grid_sharding)
-                with step_annotation(step_base + steps_done):
+                with self._tspan("data", step=step_base + steps_done):
+                    rows = jax.device_put(
+                        idx[g0 : g0 + g], self._grid_sharding
+                    )
+                with step_annotation(step_base + steps_done), \
+                        self._tspan("dispatch", step=step_base + steps_done):
                     self.state, metrics = self.resident_train_step(
                         self.state, self._train_data, rows
                     )
@@ -854,6 +902,7 @@ class Trainer:
         self.train_loader.set_epoch(epoch)  # ≡ sampler.set_epoch (ddp_main.py:160)
         k = max(1, cfg.steps_per_call if self.chunk_step is not None else 1)
         items = self._tagged_batches(self.train_loader, k)
+        batches = self._traced_batches(items)
         final_metrics = None
         self._pending.clear()
         timer = Timer()
@@ -878,7 +927,7 @@ class Trainer:
         profiling = False
         steps_done = 0
         try:
-            for tag, batch in items:
+            for tag, batch in batches:
                 if cfg.max_steps_per_epoch and steps_done >= cfg.max_steps_per_epoch:
                     break
                 if profiling and steps_done >= profile_window[1]:
@@ -893,7 +942,8 @@ class Trainer:
                 ):
                     jax.profiler.start_trace(cfg.profile_dir)
                     profiling = True
-                with step_annotation(step_base + steps_done):
+                with step_annotation(step_base + steps_done), \
+                        self._tspan("dispatch", step=step_base + steps_done):
                     remaining = (
                         cfg.max_steps_per_epoch - steps_done
                         if cfg.max_steps_per_epoch else None
@@ -1058,6 +1108,10 @@ class Trainer:
         saves are synchronous."""
         if self._watchdog is not None:
             self._watchdog.beat()  # checkpoint IO is progress, not a hang
+        with self._tspan("checkpoint", periodic=periodic):
+            self._save_impl(periodic=periodic)
+
+    def _save_impl(self, *, periodic: bool) -> None:
         if self._pending_save is not None:
             self._pending_save.wait()  # surfaces write errors too
             self._pending_save = None
@@ -1117,6 +1171,9 @@ class Trainer:
                 # append mode; don't leak this fd until GC
                 self._metrics_fh.close()
                 self._metrics_fh = None
+            # written in the finally so a crashed run still leaves its
+            # partial timeline — a flight recorder's whole point
+            self._save_trace()
 
     def _fit_inner(self) -> dict:
         cfg = self.config

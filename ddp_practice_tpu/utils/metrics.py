@@ -13,12 +13,59 @@ Percentiles come from a bounded reservoir: a histogram keeps the most
 recent `max_samples` observations (running count/sum stay exact), so a
 long-lived server's memory stays O(1) while p50/p99 track the current
 traffic rather than the whole history.
+
+Two render paths: `snapshot()` flattens to a dict (JSONL emit, bench
+reports), `render_text()` renders Prometheus text exposition — `labelled`
+names become `name{k="v"}` with escaped, sorted label values, histograms
+become summaries (`name{quantile="0.5"}` + `_count`/`_sum`) — so any
+standard scraper can consume the registry without an adapter.
 """
 
 from __future__ import annotations
 
 import threading
 from typing import Dict, Iterable, Optional
+
+
+def _escape_label_value(v: str) -> str:
+    """Prometheus label-value escaping: backslash, quote, newline."""
+    return (
+        str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+    )
+
+
+def _split_labelled(name: str):
+    """Parse a `labelled()` registry key back into (base, [(k, v)...]).
+
+    Inverse of `labelled` for the exposition renderer. Values are the
+    raw strings labelled() embedded; a value containing "," or "=" is
+    not representable in this key format (labelled's documented limit).
+    """
+    if not name.endswith("}") or "{" not in name:
+        return name, []
+    base, _, inner = name[:-1].partition("{")
+    pairs = []
+    for item in inner.split(","):
+        k, _, v = item.partition("=")
+        pairs.append((k, v))
+    return base, pairs
+
+
+def _render_labels(pairs) -> str:
+    """`[(k, v)...]` -> `{k="v",...}` sorted by key, values escaped."""
+    if not pairs:
+        return ""
+    inner = ",".join(
+        f'{k}="{_escape_label_value(v)}"' for k, v in sorted(pairs)
+    )
+    return "{" + inner + "}"
+
+
+def _fmt_value(v: float) -> str:
+    f = float(v)
+    if f.is_integer() and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
 
 
 def labelled(name: str, **labels) -> str:
@@ -138,6 +185,57 @@ class MetricsRegistry:
             for k, v in h.summary().items():
                 out[f"{name}_{k}"] = v
         return out
+
+    def render_text(self) -> str:
+        """Prometheus text exposition of the registry.
+
+        `labelled()` names render as ``name{k="v"}`` (labels sorted by
+        key, values escaped per the exposition format: backslash, quote,
+        newline); histograms render as summaries — ``name{quantile=
+        "0.5"}`` / ``"0.9"`` / ``"0.99"`` over the reservoir plus exact
+        ``name_count`` and ``name_sum``. One ``# TYPE`` line per metric
+        family, families sorted by name — the output is byte-stable for
+        a given registry state, so a scrape endpoint or a test can diff
+        it. Ends with a trailing newline per the format spec.
+        """
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            histograms = dict(self._histograms)
+        lines = []
+
+        def simple(metrics, kind):
+            fams: Dict[str, list] = {}
+            for name, m in metrics.items():
+                base, pairs = _split_labelled(name)
+                fams.setdefault(base, []).append((pairs, m.value))
+            for base in sorted(fams):
+                lines.append(f"# TYPE {base} {kind}")
+                for pairs, value in sorted(
+                        fams[base], key=lambda pv: _render_labels(pv[0])):
+                    lines.append(
+                        f"{base}{_render_labels(pairs)} {_fmt_value(value)}"
+                    )
+
+        simple(counters, "counter")
+        simple(gauges, "gauge")
+        for name in sorted(histograms):
+            h = histograms[name]
+            base, pairs = _split_labelled(name)
+            lines.append(f"# TYPE {base} summary")
+            for q, p in (("0.5", 50), ("0.9", 90), ("0.99", 99)):
+                qpairs = pairs + [("quantile", q)]
+                lines.append(
+                    f"{base}{_render_labels(qpairs)} "
+                    f"{_fmt_value(h.percentile(p))}"
+                )
+            lines.append(
+                f"{base}_count{_render_labels(pairs)} {_fmt_value(h.count)}"
+            )
+            lines.append(
+                f"{base}_sum{_render_labels(pairs)} {_fmt_value(h.sum)}"
+            )
+        return "\n".join(lines) + "\n"
 
 
 _default: Optional[MetricsRegistry] = None

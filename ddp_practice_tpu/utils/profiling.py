@@ -2,28 +2,91 @@
 
 Wraps `jax.profiler`: traces dump to a directory viewable in
 TensorBoard/Perfetto/XProf; step/epoch regions are annotated with
-`TraceAnnotation` so device timelines line up with the training loop.
+`TraceAnnotation` so device timelines line up with the training loop
+(and, since the serving engines name their annotations with request
+trace-ids, with utils/trace.py host spans too).
+
+`profile_region` is re-entrancy-safe and exception-transparent:
+
+- the jax profiler is a process-global singleton, so only the OUTERMOST
+  region holding a `profile_dir` starts/stops a capture — nested regions
+  (or a region inside a loop-managed `start_trace`) annotate only,
+  instead of crashing with "profiler already started";
+- a `stop_trace()` failure on the way out of a body that already raised
+  is logged and swallowed — the body's real exception propagates, not
+  the secondary teardown error. When the body succeeded, a stop failure
+  is real signal and raises normally.
 """
 
 from __future__ import annotations
 
 import contextlib
+import logging
+import threading
 from typing import Optional
 
 import jax
 
+log = logging.getLogger(__name__)
+
+# process-global: is a trace WE started currently active? (the jax
+# profiler itself is a singleton; this mirrors just enough of its state
+# to make nesting a no-op instead of a crash)
+_lock = threading.Lock()
+_trace_active = False
+
+
+def _try_start(profile_dir: str) -> bool:
+    """Start a capture if no profile_region capture is active; True if
+    THIS call now owns the stop. An externally-started profiler (e.g.
+    train/loop.py's epoch-window start_trace) surfaces as RuntimeError —
+    treated the same as nesting: annotate only."""
+    global _trace_active
+    with _lock:
+        if _trace_active:
+            return False
+        try:
+            jax.profiler.start_trace(profile_dir)
+        except RuntimeError as e:  # profiler already started elsewhere
+            log.warning("profile_region: not starting a trace (%s)", e)
+            return False
+        _trace_active = True
+        return True
+
+
+def _stop(swallow: bool) -> None:
+    """Stop the capture this module started. The active flag drops
+    FIRST, so a failing stop cannot wedge every later region into
+    annotate-only mode against a profiler that is actually stopped."""
+    global _trace_active
+    with _lock:
+        _trace_active = False
+        try:
+            jax.profiler.stop_trace()
+        except Exception:
+            if not swallow:
+                raise
+            log.exception(
+                "profile_region: stop_trace failed (suppressed — the "
+                "body's exception is the one that matters)"
+            )
+
 
 @contextlib.contextmanager
 def profile_region(name: str, profile_dir: Optional[str] = None):
-    """Annotate a region; if profile_dir is set, capture a full trace."""
-    if profile_dir:
-        jax.profiler.start_trace(profile_dir)
+    """Annotate a region; if profile_dir is set, capture a full trace.
+    Nested capture requests annotate only (see module doc)."""
+    owns = bool(profile_dir) and _try_start(profile_dir)
     try:
         with jax.profiler.TraceAnnotation(name):
             yield
-    finally:
-        if profile_dir:
-            jax.profiler.stop_trace()
+    except BaseException:
+        if owns:
+            _stop(swallow=True)
+        raise
+    else:
+        if owns:
+            _stop(swallow=False)
 
 
 @contextlib.contextmanager

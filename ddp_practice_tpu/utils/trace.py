@@ -1,0 +1,318 @@
+"""Request-lifecycle tracing: Dapper-style spans over an injectable clock.
+
+The serving stack (Scheduler -> SlotEngine/PagedEngine -> Router) and the
+training loop both answer "where did the time go?" with aggregate gauges
+only (utils/metrics.py) — a bad TTFT or a failover hop leaves no record
+of queue wait vs bucketed prefill vs decode-burst stalls vs retry hops.
+This module is the missing recorder:
+
+- **Host-pure and thread-safe.** Nothing here touches jax; appends are
+  deque ops under the GIL, snapshots take the lock. A serve loop is
+  single-threaded, but submission may come from another thread.
+- **Injectable clock.** The recorder reads time through the same clock
+  the schedulers use (`MonotonicClock` in production, `FakeClock` in
+  tests), so a chaos replay's trace is bit-for-bit deterministic.
+- **Bounded.** Records live in a ring buffer (`max_events`); a
+  long-lived server's tracing memory is O(1), and the exported timeline
+  is the most recent window — a flight recorder, not an archive.
+- **Zero-overhead when off.** A disabled recorder's `span()` returns a
+  shared no-op context manager and `instant()` returns immediately; the
+  instrumented hot paths additionally gate on `tracer is not None`, so
+  the production default (no tracer) pays a single attribute test.
+
+Three record kinds, three Chrome trace-event encodings
+(`to_chrome_trace()` emits the JSON Perfetto / chrome://tracing /
+vLLM's tooling consume):
+
+- **Lane spans** (`span()` / `record_span()`): synchronous work on one
+  (pid, tid) lane — a prefill dispatch on a slot lane, a decode burst
+  on the engine lane, a train step phase. Exported as matched B/E
+  pairs, properly nested per lane (tools/check_traces.py validates).
+- **Request spans** (`record_async()`): per-request lifecycle intervals
+  ("request", "queued") that overlap freely across requests. Exported
+  as Chrome ASYNC events (ph "b"/"e") keyed by `id=trace_id`, so one
+  request renders as one timeline row however many replicas it crossed.
+- **Instants** (`instant()`): point events (shed, retry, failover,
+  brownout flip) — ph "i".
+
+Lane conventions for serving (shared by both engines and the router):
+pid = replica id (`ROUTER_PID` for the router's own lane), tid 0 =
+`ENGINE_LANE` (decode dispatches + scheduler instants), tid 1+slot =
+the slot's prefill lane. `label_replica()` / `label_router()` stamp the
+matching process/thread-name metadata so traces open pre-labelled.
+
+Trace-id propagation is the router's failover contract: a re-admitted
+request's sub-Request carries the ORIGINAL trace_id, so a crash-migrated
+request's spans on the survivor join the same async track as its spans
+on the dead replica — one request, one timeline (pinned in
+tests/test_trace.py). The engines also name their
+`jax.profiler.TraceAnnotation` regions with the dispatch's trace-ids, so
+a device timeline captured by utils/profiling.py lines up with the host
+spans by name (utils/xprof.py reads the device side back).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import itertools
+import json
+import threading
+import time
+from collections import defaultdict, deque
+from typing import Dict, Optional
+
+# record kinds (internal)
+_DUR, _ASYNC, _INSTANT = 0, 1, 2
+
+# serving lane conventions (see module doc)
+ENGINE_LANE = 0          # tid for decode dispatches + scheduler instants
+SLOT_LANE_BASE = 1       # tid = SLOT_LANE_BASE + slot for prefill spans
+ROUTER_PID = -1          # the router's own pid (replicas are 0..N-1)
+
+# the shared no-op span: what a disabled recorder hands out, and what
+# instrumented hot paths use when no tracer is attached at all
+NULL_SPAN = contextlib.nullcontext()
+_NULL_SPAN = NULL_SPAN
+
+
+def _resolve_clock(clock):
+    """Accept a scheduler-style clock object (has .now()), a plain
+    callable, or None (wall monotonic)."""
+    if clock is None:
+        return time.monotonic
+    now = getattr(clock, "now", None)
+    if callable(now):
+        return now
+    if callable(clock):
+        return clock
+    raise TypeError(f"clock must have .now() or be callable: {clock!r}")
+
+
+class _Rec:
+    __slots__ = ("kind", "name", "t0", "t1", "pid", "tid", "trace_id",
+                 "attrs", "seq")
+
+    def __init__(self, kind, name, t0, t1, pid, tid, trace_id, attrs, seq):
+        self.kind = kind
+        self.name = name
+        self.t0 = t0
+        self.t1 = t1
+        self.pid = pid
+        self.tid = tid
+        self.trace_id = trace_id
+        self.attrs = attrs
+        self.seq = seq
+
+
+class _Span:
+    """Context manager for one lane span; created only when enabled."""
+
+    __slots__ = ("rec", "name", "trace_id", "pid", "tid", "attrs", "t0")
+
+    def __init__(self, rec, name, trace_id, pid, tid, attrs):
+        self.rec = rec
+        self.name = name
+        self.trace_id = trace_id
+        self.pid = pid
+        self.tid = tid
+        self.attrs = attrs
+
+    def __enter__(self):
+        self.t0 = self.rec._now()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.rec.record_span(
+            self.name, self.t0, self.rec._now(), trace_id=self.trace_id,
+            pid=self.pid, tid=self.tid, attrs=self.attrs,
+        )
+        return False
+
+
+class TraceRecorder:
+    """Bounded, clock-injected span/event recorder (see module doc)."""
+
+    def __init__(self, *, clock=None, max_events: int = 65536,
+                 enabled: bool = True) -> None:
+        if max_events < 1:
+            raise ValueError("max_events must be positive")
+        self._now = _resolve_clock(clock)
+        self.enabled = enabled
+        self._records: deque = deque(maxlen=max_events)
+        self._seq = itertools.count()
+        self._lock = threading.Lock()
+        self._process_names: Dict[int, str] = {}
+        self._thread_names: Dict[tuple, str] = {}
+
+    # ------------------------------------------------------------ recording
+    def now(self) -> float:
+        return self._now()
+
+    def span(self, name: str, *, trace_id: Optional[str] = None,
+             pid: int = 0, tid: int = 0, **attrs):
+        """Lane span context manager; a shared no-op when disabled."""
+        if not self.enabled:
+            return _NULL_SPAN
+        return _Span(self, name, trace_id, pid, tid, attrs)
+
+    def record_span(self, name: str, t0: float, t1: float, *,
+                    trace_id: Optional[str] = None, pid: int = 0,
+                    tid: int = 0, attrs: Optional[dict] = None) -> None:
+        """Explicit-timestamp lane span (for intervals the caller timed)."""
+        if not self.enabled:
+            return
+        self._records.append(_Rec(
+            _DUR, name, t0, t1, pid, tid, trace_id, attrs, next(self._seq)
+        ))
+
+    def record_async(self, name: str, t0: float, t1: float, *,
+                     trace_id: str, pid: int = 0,
+                     attrs: Optional[dict] = None) -> None:
+        """Per-request interval: exported as async b/e keyed by trace_id,
+        so overlapping requests never fight over one lane's B/E stack."""
+        if not self.enabled:
+            return
+        self._records.append(_Rec(
+            _ASYNC, name, t0, t1, pid, 0, trace_id, attrs, next(self._seq)
+        ))
+
+    def instant(self, name: str, *, trace_id: Optional[str] = None,
+                pid: int = 0, tid: int = 0, **attrs) -> None:
+        if not self.enabled:
+            return
+        t = self._now()
+        self._records.append(_Rec(
+            _INSTANT, name, t, t, pid, tid, trace_id, attrs or None,
+            next(self._seq)
+        ))
+
+    # ------------------------------------------------------------- metadata
+    def set_process_name(self, pid: int, name: str) -> None:
+        self._process_names[pid] = name
+
+    def set_thread_name(self, pid: int, tid: int, name: str) -> None:
+        self._thread_names[(pid, tid)] = name
+
+    # ------------------------------------------------------------- plumbing
+    def __len__(self) -> int:
+        return len(self._records)
+
+    def clear(self) -> None:
+        """Drop recorded events (lane labels survive) — e.g. after a
+        warmup phase whose compile-time spans would dwarf the workload."""
+        with self._lock:
+            self._records.clear()
+
+    def disable(self) -> None:
+        self.enabled = False
+
+    def enable(self) -> None:
+        self.enabled = True
+
+    # --------------------------------------------------------------- export
+    def to_chrome_trace(self) -> dict:
+        """Render the ring buffer as Chrome trace-event JSON.
+
+        Lane spans become matched B/E pairs, emitted per (pid, tid) in
+        stack order (outer-first at shared starts), so zero-duration
+        spans on a FakeClock still nest cleanly; request spans become
+        async b/e pairs keyed by id=trace_id; instants become ph "i".
+        ts is microseconds of the recorder's clock domain.
+        """
+        with self._lock:
+            records = list(self._records)
+        events = []
+        pids = ({r.pid for r in records} | set(self._process_names))
+        for pid in sorted(pids):
+            events.append({
+                "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+                "args": {"name": self._process_names.get(pid, f"pid{pid}")},
+            })
+        lane_tids = {(r.pid, r.tid) for r in records if r.kind == _DUR}
+        for (pid, tid) in sorted(set(self._thread_names) | lane_tids):
+            events.append({
+                "name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
+                "args": {"name": self._thread_names.get(
+                    (pid, tid), f"tid{tid}")},
+            })
+
+        def us(t: float) -> float:
+            return round(t * 1e6, 3)
+
+        def begin(r: _Rec, ph: str) -> dict:
+            ev = {"name": r.name, "ph": ph, "ts": us(r.t0),
+                  "pid": r.pid, "tid": r.tid}
+            args = dict(r.attrs) if r.attrs else {}
+            if r.trace_id is not None:
+                args["trace_id"] = r.trace_id
+            if args:
+                ev["args"] = args
+            if ph == "b":
+                ev["cat"] = "request"
+                ev["id"] = r.trace_id
+            return ev
+
+        def end(r: _Rec, ph: str) -> dict:
+            ev = {"name": r.name, "ph": ph, "ts": us(r.t1),
+                  "pid": r.pid, "tid": r.tid}
+            if ph == "e":
+                ev["cat"] = "request"
+                ev["id"] = r.trace_id
+            return ev
+
+        def sweep(recs, b_ph, e_ph):
+            """Emit properly nested begin/end pairs for one lane: sort by
+            (start, -end, seq), close every span that ends at-or-before
+            the next span's start, drain at the end. Genuinely crossing
+            intervals come out ts-disordered — the validator flags them
+            rather than this export papering over them."""
+            recs.sort(key=lambda r: (r.t0, -r.t1, r.seq))
+            stack = []
+            for r in recs:
+                while stack and stack[-1].t1 <= r.t0:
+                    events.append(end(stack.pop(), e_ph))
+                events.append(begin(r, b_ph))
+                stack.append(r)
+            while stack:
+                events.append(end(stack.pop(), e_ph))
+
+        lanes = defaultdict(list)
+        asyncs = defaultdict(list)
+        instants = []
+        for r in records:
+            if r.kind == _DUR:
+                lanes[(r.pid, r.tid)].append(r)
+            elif r.kind == _ASYNC:
+                asyncs[(r.pid, r.trace_id)].append(r)
+            else:
+                instants.append(r)
+        for key in sorted(lanes):
+            sweep(lanes[key], "B", "E")
+        for key in sorted(asyncs, key=lambda k: (k[0], str(k[1]))):
+            sweep(asyncs[key], "b", "e")
+        for r in instants:
+            ev = begin(r, "i")
+            ev["s"] = "t"  # thread-scoped instant
+            events.append(ev)
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+    def save(self, path: str) -> None:
+        """Write the Chrome trace JSON (open in Perfetto / chrome://tracing)."""
+        with open(path, "w") as f:
+            json.dump(self.to_chrome_trace(), f)
+
+
+# ------------------------------------------------------- lane label helpers
+def label_replica(recorder: TraceRecorder, replica: int,
+                  max_slots: int) -> None:
+    """Stamp the serving lane names for one replica: pid=replica,
+    tid 0 = engine (decode dispatches), tid 1+slot = prefill lanes."""
+    recorder.set_process_name(replica, f"replica{replica}")
+    recorder.set_thread_name(replica, ENGINE_LANE, "engine")
+    for s in range(max_slots):
+        recorder.set_thread_name(replica, SLOT_LANE_BASE + s, f"slot{s}")
+
+
+def label_router(recorder: TraceRecorder) -> None:
+    recorder.set_process_name(ROUTER_PID, "router")
+    recorder.set_thread_name(ROUTER_PID, 0, "dispatch")

@@ -100,6 +100,20 @@ def op_summary(path: str, *, device_substr: str = "TPU",
             capture_output=True,
             check=True,
         ).stdout.decode("utf-8", errors="replace")
+    return op_summary_text(decoded, device_substr=device_substr,
+                           line_substr=line_substr)
+
+
+def op_summary_text(decoded: str, *, device_substr: str = "TPU",
+                    line_substr: str = "XLA Ops") -> dict:
+    """`op_summary` over already-decoded `protoc --decode_raw` text.
+
+    The seam that makes the field-id parser testable without protoc or
+    a live capture: tests/data/xplane_decode_raw.txt is a checked-in
+    decode_raw snapshot pinned against this function directly
+    (tests/test_xprof.py), so schema drift in the parser fails in tier-1
+    even where the protoc round-trip test has to skip.
+    """
     planes = _parse_decoded(decoded)
 
     def text(v):

@@ -17,6 +17,7 @@ from ddp_practice_tpu.utils.metrics import (
     Gauge,
     Histogram,
     MetricsRegistry,
+    labelled,
 )
 
 
@@ -113,3 +114,50 @@ def test_serve_metrics_report(devices):
     assert rep["serve_requests_eos"] == 1
     assert rep["serve_ttft_s_count"] == 1
     assert rep["serve_tpot_s_p50"] == pytest.approx(0.1)
+
+
+@pytest.mark.fast
+def test_render_text_exposition(devices):
+    """Prometheus text format: TYPE lines per family, labelled() names
+    re-rendered as name{k="v"}, histograms as summaries with exact
+    count/sum. Byte-stable ordering (families and label sets sorted)."""
+    r = MetricsRegistry()
+    r.counter("req_total").inc(7)
+    r.counter(labelled("sheds_total", reason="brownout")).inc(2)
+    r.counter(labelled("sheds_total", reason="queue_full")).inc()
+    r.gauge(labelled("replica_state", replica=1)).set(2)
+    h = r.histogram("ttft_s")
+    for v in (0.1, 0.2, 0.4):
+        h.observe(v)
+    text = r.render_text()
+    lines = text.splitlines()
+    assert text.endswith("\n")
+    assert "# TYPE req_total counter" in lines
+    assert "req_total 7" in lines
+    # one TYPE line per family, not per labelled child
+    assert lines.count("# TYPE sheds_total counter") == 1
+    i = lines.index("# TYPE sheds_total counter")
+    # children sorted by rendered labels, values quoted
+    assert lines[i + 1] == 'sheds_total{reason="brownout"} 2'
+    assert lines[i + 2] == 'sheds_total{reason="queue_full"} 1'
+    assert 'replica_state{replica="1"} 2' in lines
+    assert 'ttft_s{quantile="0.5"} 0.2' in lines
+    assert "ttft_s_count 3" in lines
+    assert any(ln.startswith("ttft_s_sum 0.7") for ln in lines)
+    # deterministic: same registry state -> identical bytes
+    assert r.render_text() == text
+
+
+@pytest.mark.fast
+def test_render_text_escaping_and_label_ordering(devices):
+    """Label values escape backslash/quote/newline; multi-label names
+    render with keys sorted however the caller spelled the kwargs."""
+    r = MetricsRegistry()
+    r.counter(labelled("esc_total", path='say "hi"\nnow', d="a\\b")).inc()
+    # same label SET spelled in the other kwarg order -> same metric
+    r.counter(labelled("esc_total", d="a\\b", path='say "hi"\nnow')).inc()
+    text = r.render_text()
+    assert (
+        'esc_total{d="a\\\\b",path="say \\"hi\\"\\nnow"} 2' in
+        text.splitlines()
+    )

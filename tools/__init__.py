@@ -1,0 +1,1 @@
+# repo tooling (CI validators, artifact checkers) — importable from tests

@@ -1,0 +1,205 @@
+"""Chrome trace-event JSON validator (utils/trace.py exports).
+
+A trace that loads in Perfetto is not necessarily a *correct* trace —
+the viewer silently drops unmatched E events, reorders by ts, and
+invents rows for unknown pids, so a broken exporter can look fine until
+the one debugging session that depends on it. This validator makes the
+schema a checkable contract, used two ways:
+
+- from tests: ``from tools.check_traces import validate`` — returns a
+  list of error strings (empty = clean), asserted empty by
+  tests/test_trace.py on every exported trace;
+- as a CLI for eyeballing bench artifacts::
+
+      python tools/check_traces.py t.json [more.json ...]
+
+  prints a per-file verdict + span summary, exit 1 on any error.
+
+Checks (each one a real corruption mode of the exporter):
+
+- top level is ``{"traceEvents": [...]}``; every event has name/ph/pid/
+  tid, and (except metadata) a finite ts >= 0;
+- **known pids**: every event's pid carries a ``process_name`` metadata
+  record — an undeclared pid means an instrumentation site bypassed the
+  lane conventions (utils/trace.py label_replica/label_router);
+- **matched B/E pairs** per (pid, tid) lane: stack discipline, E names
+  match the open B, nothing left open at EOF;
+- **monotonic ts** within each lane's B/E stream in file order — a
+  violation means the exporter emitted crossing (non-nested) intervals;
+- **matched async b/e** per (pid, id): b before e, same name, ts
+  ordered, nothing left open;
+- only known phases (B E b e i M X C) appear.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from collections import Counter, defaultdict
+from typing import List
+
+_KNOWN_PH = {"B", "E", "b", "e", "i", "M", "X", "C"}
+
+
+def validate(trace) -> List[str]:
+    """Validate a parsed Chrome trace object; return error strings."""
+    errors: List[str] = []
+    if not isinstance(trace, dict) or not isinstance(
+            trace.get("traceEvents"), list):
+        return ["top level must be an object with a 'traceEvents' list"]
+    events = trace["traceEvents"]
+    known_pids = {
+        ev.get("pid") for ev in events
+        if isinstance(ev, dict) and ev.get("ph") == "M"
+        and ev.get("name") == "process_name"
+    }
+    lane_stacks = defaultdict(list)     # (pid, tid) -> [(name, ts)]
+    lane_last_ts = {}                   # (pid, tid) -> last B/E ts seen
+    async_open = defaultdict(list)      # (pid, id) -> [(name, ts)]
+    for i, ev in enumerate(events):
+        where = f"event {i}"
+        if not isinstance(ev, dict):
+            errors.append(f"{where}: not an object")
+            continue
+        ph = ev.get("ph")
+        name = ev.get("name")
+        if not isinstance(name, str) or not name:
+            errors.append(f"{where}: missing/empty name")
+            continue
+        where = f"event {i} ({ph} {name!r})"
+        if ph not in _KNOWN_PH:
+            errors.append(f"{where}: unknown ph {ph!r}")
+            continue
+        if "pid" not in ev or "tid" not in ev:
+            errors.append(f"{where}: missing pid/tid")
+            continue
+        pid, tid = ev["pid"], ev["tid"]
+        if ph == "M":
+            continue
+        ts = ev.get("ts")
+        if not isinstance(ts, (int, float)) or not (
+                ts == ts and abs(ts) != float("inf")):
+            errors.append(f"{where}: ts must be a finite number, got {ts!r}")
+            continue
+        if ts < 0:
+            errors.append(f"{where}: negative ts {ts}")
+        if pid not in known_pids:
+            errors.append(
+                f"{where}: pid {pid!r} has no process_name metadata"
+            )
+        if ph in ("B", "E"):
+            lane = (pid, tid)
+            last = lane_last_ts.get(lane)
+            if last is not None and ts < last:
+                errors.append(
+                    f"{where}: lane {lane} ts went backwards "
+                    f"({last} -> {ts}) — crossing intervals?"
+                )
+            lane_last_ts[lane] = ts
+            if ph == "B":
+                lane_stacks[lane].append((name, ts))
+            else:
+                if not lane_stacks[lane]:
+                    errors.append(f"{where}: E with no open B on {lane}")
+                else:
+                    open_name, open_ts = lane_stacks[lane].pop()
+                    if open_name != name:
+                        errors.append(
+                            f"{where}: E closes {open_name!r} "
+                            f"(B/E name mismatch on {lane})"
+                        )
+                    elif ts < open_ts:
+                        errors.append(
+                            f"{where}: span ends before it starts "
+                            f"({open_ts} -> {ts})"
+                        )
+        elif ph in ("b", "e"):
+            aid = ev.get("id")
+            if aid is None:
+                errors.append(f"{where}: async event without id")
+                continue
+            key = (pid, aid)
+            if ph == "b":
+                async_open[key].append((name, ts))
+            else:
+                if not async_open[key]:
+                    errors.append(
+                        f"{where}: async e with no open b for id {aid!r}"
+                    )
+                else:
+                    open_name, open_ts = async_open[key].pop()
+                    if open_name != name:
+                        errors.append(
+                            f"{where}: async e closes {open_name!r} "
+                            f"(name mismatch for id {aid!r})"
+                        )
+                    elif ts < open_ts:
+                        errors.append(
+                            f"{where}: async span for id {aid!r} ends "
+                            f"before it starts ({open_ts} -> {ts})"
+                        )
+    for lane, stack in lane_stacks.items():
+        if stack:
+            errors.append(
+                f"lane {lane}: {len(stack)} unclosed B "
+                f"(top: {stack[-1][0]!r})"
+            )
+    for key, stack in async_open.items():
+        if stack:
+            errors.append(
+                f"async id {key[1]!r} (pid {key[0]}): "
+                f"{len(stack)} unclosed b"
+            )
+    return errors
+
+
+def summarize(trace) -> dict:
+    """Counts for the CLI report: events by phase, spans by name."""
+    events = trace.get("traceEvents", [])
+    by_ph = Counter(ev.get("ph") for ev in events if isinstance(ev, dict))
+    spans = Counter(
+        ev.get("name") for ev in events
+        if isinstance(ev, dict) and ev.get("ph") in ("B", "b")
+    )
+    pids = sorted({
+        ev.get("pid") for ev in events
+        if isinstance(ev, dict) and "pid" in ev
+    }, key=str)
+    return {"events": len(events), "by_ph": dict(by_ph),
+            "spans": dict(spans), "pids": pids}
+
+
+def main(argv=None) -> int:
+    args = sys.argv[1:] if argv is None else list(argv)
+    if not args or args[0] in ("-h", "--help"):
+        print(__doc__)
+        return 0
+    rc = 0
+    for path in args:
+        try:
+            with open(path) as f:
+                trace = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"{path}: UNREADABLE — {e}")
+            rc = 1
+            continue
+        errors = validate(trace)
+        s = summarize(trace)
+        if errors:
+            rc = 1
+            print(f"{path}: INVALID ({len(errors)} error(s); "
+                  f"{s['events']} events)")
+            for e in errors[:20]:
+                print(f"  - {e}")
+            if len(errors) > 20:
+                print(f"  ... and {len(errors) - 20} more")
+        else:
+            top = sorted(s["spans"].items(), key=lambda kv: -kv[1])[:8]
+            spans = ", ".join(f"{n} x{c}" for n, c in top) or "none"
+            print(f"{path}: OK — {s['events']} events, "
+                  f"pids {s['pids']}, spans: {spans}")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
