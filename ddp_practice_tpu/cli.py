@@ -153,6 +153,23 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--metrics_file", default=None, metavar="PATH",
                    help="append one JSON record per logged step / eval / "
                         "summary (training curves; process 0 only)")
+    p.add_argument("--metrics-port", "--metrics_port", dest="metrics_port",
+                   type=int, default=None, metavar="PORT",
+                   help="serve /metrics (Prometheus), /healthz and "
+                        "/flight (rolling step-time percentiles) over "
+                        "HTTP during the fit (0 = ephemeral port, "
+                        "logged at startup; process 0 only)")
+    p.add_argument("--telemetry-out", "--telemetry_out",
+                   dest="telemetry_out", default=None, metavar="PATH",
+                   help="stream step spans + step records + metrics "
+                        "snapshots as line-delimited JSONL while "
+                        "training (survives a killed run; validate "
+                        "with tools/check_traces.py)")
+    p.add_argument("--slo", default=None, metavar="JSON|PATH",
+                   help="SLO config (serve/slo.py) — arms a burn-rate "
+                        "watchdog over the step-time straggler "
+                        "detector; alerts land in the telemetry "
+                        "stream and the metrics registry")
     p.add_argument("--loader", default="auto", choices=["auto", "native", "python"])
     p.add_argument("--steps_per_call", type=int, default=1,
                    help="K optimizer steps per jitted call (amortizes host "
@@ -252,6 +269,9 @@ def config_from_args(args) -> TrainConfig:
         profile_dir=args.profile_dir,
         trace_out=args.trace_out,
         metrics_file=args.metrics_file,
+        metrics_port=args.metrics_port,
+        telemetry_out=args.telemetry_out,
+        slo=args.slo,
         loader_backend=args.loader,
         steps_per_call=args.steps_per_call,
         data_placement=args.data_placement,

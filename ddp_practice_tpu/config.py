@@ -267,6 +267,20 @@ class TrainConfig:
     # human stdout logs; records carry the global step, so resumed runs
     # append seamlessly
     metrics_file: Optional[str] = None
+    # ---- live telemetry plane (utils/telemetry.py; process 0 only)
+    # bind /metrics (Prometheus exposition of step-time/MFU/anomaly
+    # metrics), /healthz, /flight (rolling step-time percentiles) on
+    # this port for the whole fit (0 = ephemeral, logged at startup)
+    metrics_port: Optional[int] = None
+    # stream trace events + flight/step records + periodic metrics
+    # snapshots as line-delimited JSONL WHILE training — a killed run
+    # still leaves a parseable file (the exit-time trace_out dump
+    # leaves nothing)
+    telemetry_out: Optional[str] = None
+    # SLO config (serve/slo.py SLOConfig JSON or path): a burn-rate
+    # watchdog over the straggler detector's verdicts — sustained
+    # anomalous step times trip an alert into the telemetry stream
+    slo: Optional[str] = None
 
     # input pipeline
     loader_backend: str = "auto"       # auto | native | python

@@ -21,6 +21,11 @@ Layers (each its own module, composable and separately testable):
   goodput-under-faults benches;
 - health.py    — per-replica HEALTHY/DEGRADED/DEAD state machine with a
   consecutive-failure circuit breaker and backoff half-open probes;
+- slo.py       — declarative SLO targets (TTFT/TPOT p99, error rate,
+  availability) evaluated as multi-window burn rates; alerts feed the
+  router's brown-out and the telemetry stream (utils/telemetry.py
+  exports the plane: JSONL streaming + /metrics /healthz /flight HTTP
+  scrape endpoints; tools/check_slo.py is the offline verdict);
 - router.py    — fault-tolerant least-loaded dispatch over N replicas:
   bounded retries with backoff+jitter, crash failover that migrates
   in-flight requests (prompt + tokens-so-far re-prefill,
@@ -71,6 +76,7 @@ from ddp_practice_tpu.serve.scheduler import (
     Request,
     Scheduler,
 )
+from ddp_practice_tpu.serve.slo import SLOConfig, SLOWatchdog
 
 __all__ = [
     "BlockAllocator",
@@ -91,6 +97,8 @@ __all__ = [
     "Router",
     "RouterConfig",
     "RouterMetrics",
+    "SLOConfig",
+    "SLOWatchdog",
     "Scheduler",
     "ServeMetrics",
     "SlotAllocator",

@@ -96,15 +96,12 @@ def _build_model(*, vocab, max_len, hidden, depth, heads, mlp):
 
 
 def _percentiles(xs) -> dict:
-    if not xs:
-        return {"p50": 0.0, "p90": 0.0, "p99": 0.0, "mean": 0.0}
-    arr = np.asarray(xs, np.float64)
-    return {
-        "p50": float(np.percentile(arr, 50)),
-        "p90": float(np.percentile(arr, 90)),
-        "p99": float(np.percentile(arr, 99)),
-        "mean": float(arr.mean()),
-    }
+    # the plane-wide percentile implementation (utils/metrics.py):
+    # bench rows, /flight scrapes, and SLO verdicts all quote the same
+    # nearest-rank quantiles
+    from ddp_practice_tpu.utils.metrics import percentile_summary
+
+    return percentile_summary(xs, (50, 90, 99))
 
 
 def _phase_breakdown(completions) -> dict:
@@ -125,9 +122,53 @@ def _make_tracer():
     return TraceRecorder()
 
 
+class _Scraper:
+    """Background self-scraper: GETs /metrics, /healthz, /flight round-
+    robin at `hz` for the whole bench window, so the plane-on overhead
+    row pays for serving REAL scrape traffic, not an idle listener."""
+
+    def __init__(self, port: int, hz: float = 10.0) -> None:
+        import threading
+
+        self.port = port
+        self.period = 1.0 / hz
+        self.count = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="bench-scraper", daemon=True
+        )
+        self._thread.start()
+
+    def _run(self) -> None:
+        import http.client
+
+        paths = ("/metrics", "/healthz", "/flight")
+        i = 0
+        while not self._stop.wait(self.period):
+            try:
+                conn = http.client.HTTPConnection(
+                    "127.0.0.1", self.port, timeout=1.0
+                )
+                conn.request("GET", paths[i % len(paths)])
+                conn.getresponse().read()
+                conn.close()
+                self.count += 1
+            except Exception:
+                # server mid-shutdown or a torn response: keep scraping
+                # (a dead scraper would quietly measure an idle listener
+                # as "plane on")
+                pass
+            i += 1
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=2.0)
+
+
 def _run_continuous(model, params, trace, *, max_slots, prompt_buckets,
                     max_len, decode_burst, eos_id, paged: bool = False,
-                    block_size: int = 16, tracer=None) -> dict:
+                    block_size: int = 16, tracer=None,
+                    telemetry=None, health_slot=None) -> dict:
     from ddp_practice_tpu.serve.engine import (
         EngineConfig,
         PagedEngine,
@@ -165,8 +206,14 @@ def _run_continuous(model, params, trace, *, max_slots, prompt_buckets,
         )
     # no ServeMetrics inside the timed window: the bench computes its own
     # percentiles from completions, and the static baseline carries no
-    # per-tick bookkeeping — keep the measured loops symmetric
-    sched = Scheduler(engine, max_queue=len(trace), tracer=tracer)
+    # per-tick bookkeeping — keep the measured loops symmetric.
+    # `telemetry` (when the plane is on) IS deliberately inside the
+    # window: its cost is exactly what the overhead row measures.
+    sched = Scheduler(engine, max_queue=len(trace), tracer=tracer,
+                      telemetry=telemetry)
+    if health_slot is not None:
+        # single replica: /healthz reports one always-healthy lane
+        health_slot["fn"] = lambda: {0: "healthy"}
     # warmup compiles outside the timed window: one admit per bucket in
     # play + one decode dispatch, then rewind (slot pool only — paged
     # blocks free individually at release, nothing to rewind)
@@ -189,6 +236,10 @@ def _run_continuous(model, params, trace, *, max_slots, prompt_buckets,
         engine.set_tracer(tracer, 0)
         label_replica(tracer, 0, max_slots)
         tracer.clear()
+        if telemetry is not None and hasattr(telemetry, "attach"):
+            # sink attached only NOW: the stream gets the same
+            # warmup-free timeline as the exit dump (labels replay)
+            telemetry.attach(tracer)
 
     t0 = time.monotonic()
     i = 0
@@ -245,7 +296,9 @@ def _run_continuous(model, params, trace, *, max_slots, prompt_buckets,
 
 def _run_router(model, params, trace, *, replicas, max_slots,
                 prompt_buckets, max_len, decode_burst, eos_id,
-                fault_plan=None, tracer=None) -> dict:
+                fault_plan=None, tracer=None, slo_config=None,
+                telemetry=None, exporter=None, registry=None,
+                health_slot=None) -> dict:
     """The fleet path: N identical replicas behind the fault-tolerant
     router (serve/router.py). Scored like the continuous server — useful
     tokens of requests that finished ok — which under an injected
@@ -253,8 +306,20 @@ def _run_router(model, params, trace, *, replicas, max_slots,
     while replicas crashed, stalled, or emitted NaNs."""
     from ddp_practice_tpu.serve.engine import EngineConfig
     from ddp_practice_tpu.serve.router import RouterConfig, make_router
-    from ddp_practice_tpu.serve.scheduler import Request
+    from ddp_practice_tpu.serve.scheduler import MonotonicClock, Request
 
+    clock = MonotonicClock()
+    watchdog = None
+    if slo_config is not None:
+        from ddp_practice_tpu.serve.slo import SLOWatchdog
+
+        # live burn-rate alerting over the run's completions; alert
+        # instants land in the trace and the JSONL stream, and the
+        # router's brown-out listens (serve/slo.py)
+        watchdog = SLOWatchdog(
+            slo_config, clock=clock, registry=registry,
+            tracer=tracer, telemetry=exporter,
+        )
     router = make_router(
         model, params, replicas,
         EngineConfig(
@@ -262,11 +327,17 @@ def _run_router(model, params, trace, *, replicas, max_slots,
             prompt_buckets=prompt_buckets, temperature=0.0,
             decode_burst=decode_burst, eos_id=eos_id,
         ),
+        clock=clock,
         max_queue=len(trace),
         config=RouterConfig(),
         fault_plan=fault_plan,
+        registry=registry,
         tracer=tracer,
+        slo=watchdog,
+        telemetry=telemetry,
     )
+    if health_slot is not None:
+        health_slot["fn"] = router.states
     # warm EVERY configured bucket, not just the trace prompts' widths:
     # failover re-prefills carry prompt+salvaged-tokens and can land in
     # a larger bucket — its compile must happen out here, not inside the
@@ -274,6 +345,10 @@ def _run_router(model, params, trace, *, replicas, max_slots,
     router.warmup()
     if tracer is not None:
         tracer.clear()  # drop warmup spans; keep the workload timeline
+        if exporter is not None:
+            # sink attached only after the clear: the streamed JSONL is
+            # as warmup-free as the exit dump (lane labels replay)
+            exporter.attach(tracer)
 
     t0 = time.monotonic()
     i = 0
@@ -303,7 +378,7 @@ def _run_router(model, params, trace, *, replicas, max_slots,
     for c in router.completions:
         statuses[c.status] = statuses.get(c.status, 0) + 1
     m = router.metrics
-    return {
+    out = {
         "mode": f"router x{replicas}",
         "elapsed_s": elapsed,
         "useful_tokens": ok_tokens,
@@ -323,6 +398,15 @@ def _run_router(model, params, trace, *, replicas, max_slots,
         "replica_states": router.states(),
         "compile_stats": router.compile_stats(),
     }
+    if watchdog is not None:
+        out["slo"] = {
+            "alerts": [
+                {"t": t, "event": edge, "objective": obj}
+                for t, edge, obj in watchdog.alert_log
+            ],
+            "active": dict(watchdog.alerts),
+        }
+    return out
 
 
 def _run_static(model, params, trace, *, max_slots, width, max_new,
@@ -441,6 +525,19 @@ def serve_bench(
     # (warmup spans excluded either way). Validate/eyeball with
     # tools/check_traces.py; None = tracing fully off.
     trace_out: Optional[str] = None,
+    # ---- live telemetry plane (utils/telemetry.py): all default-off.
+    # telemetry_out streams kind-tagged JSONL (trace events via the
+    # recorder sink, flight records, metrics snapshots) DURING the run;
+    # metrics_port binds the /metrics /healthz /flight scrape server
+    # (0 = ephemeral); scrape_hz self-scrapes all three endpoints from a
+    # background thread — the overhead-measurement methodology, so the
+    # "plane on" bench row pays for serving real scrapes, not an idle
+    # listener. slo (SLOConfig/JSON/path) arms the burn-rate watchdog
+    # on the router run (needs replicas >= 1).
+    telemetry_out: Optional[str] = None,
+    metrics_port: Optional[int] = None,
+    scrape_hz: float = 0.0,
+    slo=None,
 ) -> dict:
     """Replay one Poisson trace through both servers; return the report."""
     model, params = _build_model(
@@ -452,64 +549,147 @@ def serve_bench(
         prompt_len_range=prompt_len_range, max_new_range=max_new_range,
         seed=seed,
     )
-    tracer = _make_tracer() if trace_out else None
-    cont = _run_continuous(
-        model, params, trace, max_slots=max_slots,
-        prompt_buckets=tuple(prompt_buckets), max_len=max_len,
-        decode_burst=decode_burst, eos_id=eos_id,
-        tracer=None if replicas >= 1 else tracer,
-    )
-    static = _run_static(
-        model, params, trace, max_slots=max_slots,
-        width=max(prompt_buckets), max_new=max(max_new_range),
-        eos_id=eos_id,
-    )
-    report = {
-        "trace": {
-            "n_requests": n_requests, "rate_hz": rate_hz, "seed": seed,
-            "prompt_len_range": list(prompt_len_range),
-            "max_new_range": list(max_new_range),
-        },
-        "max_len": max_len,
-        "continuous": cont,
-        "static": static,
-        "throughput_ratio": (
-            cont["tokens_per_sec"] / static["tokens_per_sec"]
-            if static["tokens_per_sec"] else float("inf")
-        ),
-    }
-    if paged:
-        report["paged"] = _run_continuous(
+    # a recorder exists for EITHER output: --trace-out wants the exit
+    # dump, --telemetry-out wants the live stream (the sink) — each is
+    # self-sufficient
+    tracer = _make_tracer() if (trace_out or telemetry_out) else None
+
+    slo_config = None
+    if slo is not None:
+        from ddp_practice_tpu.serve.slo import SLOConfig
+
+        if replicas < 1:
+            raise ValueError("--slo needs --replicas N (the watchdog "
+                             "feeds the router's brown-out hook)")
+        slo_config = SLOConfig.from_json(slo)
+    plane_on = telemetry_out is not None or metrics_port is not None
+    registry = exporter = server = scraper = None
+    health_slot = {"fn": None}
+    if plane_on or slo_config is not None:
+        from ddp_practice_tpu.utils.metrics import MetricsRegistry
+
+        registry = MetricsRegistry()
+    try:
+        if telemetry_out is not None:
+            from ddp_practice_tpu.utils.telemetry import TelemetryExporter
+
+            # NOT attached to the tracer yet: the runs attach the sink
+            # only after their warmup + tracer.clear(), so compile-time
+            # spans stay out of the stream exactly as they stay out of
+            # the trace_out dump
+            exporter = TelemetryExporter(telemetry_out, registry=registry)
+        if metrics_port is not None:
+            from ddp_practice_tpu.utils.telemetry import (
+                FlightStats,
+                TelemetryServer,
+            )
+
+            flight = exporter.flight if exporter else FlightStats()
+            server = TelemetryServer(
+                registry=registry,
+                health_fn=lambda: (health_slot["fn"]()
+                                   if health_slot["fn"] else {}),
+                flight_fn=flight.report,
+                port=metrics_port,
+            )
+            if exporter is None:
+                # no JSONL stream, but /flight still needs feeding
+                exporter_or_flight = flight
+            else:
+                exporter_or_flight = exporter
+        else:
+            exporter_or_flight = exporter
+        if server is not None and scrape_hz > 0:
+            scraper = _Scraper(server.port, hz=scrape_hz)
+    except BaseException:
+        # half-built plane (e.g. the port is taken): drain and close
+        # what already started before surfacing the error
+        if server is not None:
+            server.close()
+        if exporter is not None:
+            exporter.close()
+        raise
+
+    try:
+        cont = _run_continuous(
             model, params, trace, max_slots=max_slots,
             prompt_buckets=tuple(prompt_buckets), max_len=max_len,
             decode_burst=decode_burst, eos_id=eos_id,
-            paged=True, block_size=block_size,
+            tracer=None if replicas >= 1 else tracer,
+            telemetry=None if replicas >= 1 else exporter_or_flight,
+            health_slot=None if replicas >= 1 else health_slot,
         )
-        report["paged_vs_static"] = (
-            report["paged"]["tokens_per_sec"] / static["tokens_per_sec"]
-            if static["tokens_per_sec"] else float("inf")
+        static = _run_static(
+            model, params, trace, max_slots=max_slots,
+            width=max(prompt_buckets), max_new=max(max_new_range),
+            eos_id=eos_id,
         )
-        report["paged_vs_continuous"] = (
-            report["paged"]["tokens_per_sec"] / cont["tokens_per_sec"]
-            if cont["tokens_per_sec"] else float("inf")
-        )
-    if replicas >= 1:
-        report["router"] = _run_router(
-            model, params, trace, replicas=replicas, max_slots=max_slots,
-            prompt_buckets=tuple(prompt_buckets), max_len=max_len,
-            decode_burst=decode_burst, eos_id=eos_id,
-            fault_plan=fault_plan, tracer=tracer,
-        )
-        if fault_plan is not None:
-            report["fault_plan"] = fault_plan.to_json()
-        report["router_vs_continuous"] = (
-            report["router"]["tokens_per_sec"] / cont["tokens_per_sec"]
-            if cont["tokens_per_sec"] else float("inf")
-        )
-    if tracer is not None:
-        tracer.save(trace_out)
-        report["trace_out"] = trace_out
-        report["trace_events"] = len(tracer)
+        report = {
+            "trace": {
+                "n_requests": n_requests, "rate_hz": rate_hz, "seed": seed,
+                "prompt_len_range": list(prompt_len_range),
+                "max_new_range": list(max_new_range),
+            },
+            "max_len": max_len,
+            "continuous": cont,
+            "static": static,
+            "throughput_ratio": (
+                cont["tokens_per_sec"] / static["tokens_per_sec"]
+                if static["tokens_per_sec"] else float("inf")
+            ),
+        }
+        if paged:
+            report["paged"] = _run_continuous(
+                model, params, trace, max_slots=max_slots,
+                prompt_buckets=tuple(prompt_buckets), max_len=max_len,
+                decode_burst=decode_burst, eos_id=eos_id,
+                paged=True, block_size=block_size,
+            )
+            report["paged_vs_static"] = (
+                report["paged"]["tokens_per_sec"] / static["tokens_per_sec"]
+                if static["tokens_per_sec"] else float("inf")
+            )
+            report["paged_vs_continuous"] = (
+                report["paged"]["tokens_per_sec"] / cont["tokens_per_sec"]
+                if cont["tokens_per_sec"] else float("inf")
+            )
+        if replicas >= 1:
+            report["router"] = _run_router(
+                model, params, trace, replicas=replicas,
+                max_slots=max_slots,
+                prompt_buckets=tuple(prompt_buckets), max_len=max_len,
+                decode_burst=decode_burst, eos_id=eos_id,
+                fault_plan=fault_plan, tracer=tracer,
+                slo_config=slo_config, telemetry=exporter_or_flight,
+                exporter=exporter, registry=registry,
+                health_slot=health_slot,
+            )
+            if fault_plan is not None:
+                report["fault_plan"] = fault_plan.to_json()
+            report["router_vs_continuous"] = (
+                report["router"]["tokens_per_sec"] / cont["tokens_per_sec"]
+                if cont["tokens_per_sec"] else float("inf")
+            )
+        if tracer is not None and trace_out:
+            tracer.save(trace_out)
+            report["trace_out"] = trace_out
+            report["trace_events"] = len(tracer)
+    finally:
+        # the plane outlives a crashed run only as a closed, drained
+        # file — that is the flush-on-crash contract
+        if scraper is not None:
+            scraper.stop()
+        if server is not None:
+            server.close()
+        if exporter is not None:
+            exporter.close()
+    if plane_on:
+        report["telemetry"] = {
+            "telemetry_out": telemetry_out,
+            "metrics_port": server.port if server is not None else None,
+            "scrapes": scraper.count if scraper is not None else 0,
+            "dropped": exporter.dropped if exporter is not None else 0,
+        }
     return report
 
 
@@ -566,6 +746,35 @@ def build_parser() -> argparse.ArgumentParser:
                         "— the router run when --replicas, else the "
                         "continuous run; open in Perfetto, validate with "
                         "tools/check_traces.py")
+    p.add_argument("--telemetry-out", "--telemetry_out",
+                   dest="telemetry_out", default=None, metavar="PATH",
+                   help="stream the run's telemetry as line-delimited "
+                        "JSONL WHILE it runs (trace events, flight "
+                        "records, periodic metrics snapshots — "
+                        "utils/telemetry.py): a killed run still leaves "
+                        "a parseable file; validate with "
+                        "tools/check_traces.py, judge with "
+                        "tools/check_slo.py")
+    p.add_argument("--metrics-port", "--metrics_port",
+                   dest="metrics_port", type=int, default=None,
+                   metavar="PORT",
+                   help="serve /metrics (Prometheus exposition), "
+                        "/healthz (per-replica health), /flight "
+                        "(rolling phase percentiles) on this port "
+                        "during the bench (0 = ephemeral; the report "
+                        "records the bound port)")
+    p.add_argument("--scrape-hz", dest="scrape_hz", type=float,
+                   default=0.0,
+                   help="self-scrape the endpoints at this rate during "
+                        "the run (overhead-measurement methodology; "
+                        "needs --metrics-port)")
+    p.add_argument("--slo", default=None, metavar="JSON|PATH",
+                   help="SLO config (serve/slo.py SLOConfig: ttft_p99_s/"
+                        "tpot_p99_s/error_rate/availability + windows) — "
+                        "arms the burn-rate watchdog on the router run; "
+                        "alerts land in the trace/telemetry stream and "
+                        "can trip the router's brown-out (requires "
+                        "--replicas)")
     p.add_argument("--max-len", dest="max_len", type=int, default=None,
                    help="bench: slot-pool span / paged pool sizing "
                         "(default 128); the slot engine's decode cost "
@@ -641,6 +850,12 @@ def main(argv=None) -> int:
     if args.fault_plan and not args.replicas:
         raise SystemExit("--fault-plan needs --replicas N (faults are "
                          "injected into the router fleet run)")
+    if args.slo and not args.replicas:
+        raise SystemExit("--slo needs --replicas N (the watchdog feeds "
+                         "the router's brown-out hook)")
+    if args.scrape_hz and args.metrics_port is None:
+        raise SystemExit("--scrape-hz needs --metrics-port (there is "
+                         "nothing to scrape without the server)")
     bench_kw = {}
     if args.decode_burst is not None:
         bench_kw["decode_burst"] = args.decode_burst
@@ -651,6 +866,13 @@ def main(argv=None) -> int:
         bench_kw["max_len"] = args.max_len
     if args.trace_out:
         bench_kw["trace_out"] = args.trace_out
+    if args.telemetry_out:
+        bench_kw["telemetry_out"] = args.telemetry_out
+    if args.metrics_port is not None:
+        bench_kw["metrics_port"] = args.metrics_port
+        bench_kw["scrape_hz"] = args.scrape_hz
+    if args.slo:
+        bench_kw["slo"] = args.slo
     if args.replicas:
         from ddp_practice_tpu.serve.faults import FaultPlan
 
@@ -716,6 +938,20 @@ def main(argv=None) -> int:
             print(f"  wrote trace to {report['trace_out']} "
                   f"({report['trace_events']} events) — validate with "
                   f"tools/check_traces.py")
+        if "telemetry" in report:
+            t = report["telemetry"]
+            line = (f"  telemetry plane: port {t['metrics_port']}  "
+                    f"scrapes {t['scrapes']}  dropped {t['dropped']}")
+            if t["telemetry_out"]:
+                line += (f"  jsonl {t['telemetry_out']} — judge with "
+                         f"tools/check_slo.py")
+            print(line)
+        slo_rep = report.get("router", {}).get("slo")
+        if slo_rep:
+            trips = sum(a["event"] == "trip" for a in slo_rep["alerts"])
+            print(f"  slo: {trips} alert trip(s), "
+                  f"active at end: "
+                  f"{[k for k, v in slo_rep['active'].items() if v]}")
     return 0
 
 

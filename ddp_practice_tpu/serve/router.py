@@ -19,12 +19,16 @@ DEGRADED ones. Failures are answered in layers:
   jitter (utils/backoff.py), on whichever replica is then least loaded;
 - consecutive failures → breaker trips, replica goes DEAD, in-flight
   work migrates, half-open probes with backoff decide when it returns;
-- fleet overload → brown-out: when fleet pressure ((active + queued) /
-  total slots) crosses `brownout_on`, low-priority requests
-  (Request.priority >= shed_priority) are shed at the door AND out of
-  replica queues, and new admissions get their `max_new_tokens` capped
-  (degraded answers beat no answers); both revert when pressure falls
-  below `brownout_off` (hysteresis, so the mode doesn't flap).
+- fleet overload OR SLO burn → brown-out: when fleet pressure
+  ((active + queued) / total slots) crosses `brownout_on`, or an
+  attached SLO watchdog (serve/slo.py) has a burn-rate alert active —
+  pressure is a proxy; a burning TTFT/error-rate SLO is the measured
+  thing it stands for — low-priority requests (Request.priority >=
+  shed_priority) are shed at the door AND out of replica queues, and
+  new admissions get their `max_new_tokens` capped (degraded answers
+  beat no answers); both revert only when pressure falls below
+  `brownout_off` AND no SLO alert is active (hysteresis on both
+  triggers, so the mode doesn't flap).
 
 Every request ends in a defined terminal status — "eos"/"length" (ok),
 "timeout" (deadline), "shed" (backpressure/brown-out), "rejected"
@@ -177,13 +181,21 @@ class Router:
     def __init__(self, schedulers: Sequence[Scheduler], *, clock=None,
                  config: RouterConfig = RouterConfig(),
                  metrics: Optional[RouterMetrics] = None,
-                 tracer=None) -> None:
+                 tracer=None, slo=None, telemetry=None) -> None:
         if not schedulers:
             raise ValueError("need at least one replica")
         self.clock = clock or schedulers[0].clock
         self.config = config
         self.metrics = metrics or RouterMetrics()
         self.tracer = tracer
+        # optional serve/slo.py SLOWatchdog: fed every finalized
+        # completion, evaluated once per tick; while it alerts, brown-out
+        # engages regardless of fleet pressure (_update_brownout)
+        self.slo = slo
+        # optional utils/telemetry.py TelemetryExporter (or anything with
+        # on_completion): streams one "flight" line per finalization and
+        # feeds the /flight rolling window
+        self.telemetry = telemetry
         if tracer is not None:
             label_router(tracer)
         self.handles = [
@@ -231,7 +243,11 @@ class Router:
         if self.brownout:
             if req.priority >= cfg.shed_priority:
                 tr = self._track(req, budget)
-                self._finalize(tr, [], "shed")
+                # slo_exempt: this shed IS the brown-out response — if
+                # the watchdog counted it as an availability failure,
+                # the controller would feed its own alert and never
+                # disengage (positive-feedback latch)
+                self._finalize(tr, [], "shed", slo_exempt=True)
                 self.metrics.on_shed("brownout")
                 return False
             budget = min(budget, cfg.brownout_max_new)
@@ -340,6 +356,8 @@ class Router:
         for h in self.handles:
             self._consume(h)
         self._drain_retries()
+        if self.slo is not None:
+            self.slo.evaluate(self.clock.now())
         self._update_brownout()
         if self.clock.now() == t_start:
             # nothing decoded this tick (fleet idle/dead): advance
@@ -481,6 +499,13 @@ class Router:
 
     # --------------------------------------------------------- brown-out
     def _update_brownout(self) -> None:
+        """Brown-out has TWO triggers: fleet pressure (the PR-2
+        occupancy heuristic) and SLO burn (serve/slo.py — pressure is a
+        proxy; a burning TTFT/error-rate SLO is the measured thing the
+        proxy stands for). Either engages it; disengage requires BOTH
+        pressure under `brownout_off` and no active SLO alert — the
+        pressure hysteresis band and the watchdog's trip/resolve
+        asymmetry compose, so neither trigger can flap the mode."""
         cfg = self.config
         alive = self._alive()
         slots = sum(h.engine.config.max_slots for h in alive)
@@ -489,12 +514,17 @@ class Router:
         )
         pressure = (work / slots) if slots else float("inf")
         self.metrics.fleet_pressure.set(min(pressure, 1e9))
-        if not self.brownout and pressure >= cfg.brownout_on:
+        slo_burning = self.slo is not None and self.slo.active
+        if not self.brownout and (pressure >= cfg.brownout_on
+                                  or slo_burning):
             self.brownout = True
             self.metrics.brownout_active.set(1)
             if self.tracer is not None and self.tracer.enabled:
                 self.tracer.instant("brownout_on", pid=ROUTER_PID,
-                                    pressure=round(pressure, 3))
+                                    pressure=round(pressure, 3),
+                                    trigger=("pressure"
+                                             if pressure >= cfg.brownout_on
+                                             else "slo"))
             # shed low-priority WAITERS too, not just new arrivals — the
             # queue backlog is exactly the overload being answered
             for h in alive:
@@ -503,7 +533,10 @@ class Router:
                 ):
                     tr = self.tracked.get(req.rid)
                     if tr is not None and not tr.done:
-                        self._finalize(tr, list(tr.prefix), "shed")
+                        # slo_exempt: see submit() — the brown-out's own
+                        # sheds must not burn the SLO that drives it
+                        self._finalize(tr, list(tr.prefix), "shed",
+                                       slo_exempt=True)
                         self.metrics.on_shed("brownout")
                 # the sheds just appended sub-completions we have already
                 # accounted for — advance the watermark NOW, or next
@@ -511,7 +544,8 @@ class Router:
                 # request is tracked under the rid by then (the rid may
                 # have been reused after _finalize dropped it)
                 h.consumed = len(h.scheduler.completions)
-        elif self.brownout and pressure <= cfg.brownout_off:
+        elif self.brownout and pressure <= cfg.brownout_off \
+                and not slo_burning:
             self.brownout = False
             self.metrics.brownout_active.set(0)
             if self.tracer is not None and self.tracer.enabled:
@@ -520,7 +554,8 @@ class Router:
 
     # ---------------------------------------------------------- finalize
     def _finalize(self, tr: _Tracked, tokens: List[int], status: str,
-                  first_token_time: Optional[float] = None) -> Completion:
+                  first_token_time: Optional[float] = None,
+                  slo_exempt: bool = False) -> Completion:
         now = self.clock.now()
         req = tr.req
         ttft = tpot = None
@@ -554,6 +589,16 @@ class Router:
         self.tracked.pop(req.rid, None)
         self.completions.append(c)
         self.metrics.on_finalize(c)
+        if self.telemetry is not None:
+            # the exemption travels with the flight line, so the
+            # offline verdict (tools/check_slo.py) reproduces the
+            # online judgment
+            self.telemetry.on_completion(c, slo_exempt=slo_exempt)
+        if self.slo is not None and not slo_exempt:
+            # brown-out's own sheds are exempt (anti-windup): counting
+            # the degradation response as an SLO failure would hold the
+            # alert — and therefore the brown-out — active forever
+            self.slo.observe(c)
         return c
 
     # ------------------------------------------------------------- misc
@@ -607,6 +652,8 @@ def make_router(
     registry: Optional[MetricsRegistry] = None,
     batch_stats=None,
     tracer=None,
+    slo=None,
+    telemetry=None,
 ) -> Router:
     """Build a fleet of identical replicas (replicated params — the
     sharded-params variant is ROADMAP follow-up) on one shared clock,
@@ -635,4 +682,5 @@ def make_router(
     return Router(
         schedulers, clock=clock, config=config,
         metrics=RouterMetrics(registry), tracer=tracer,
+        slo=slo, telemetry=telemetry,
     )

@@ -165,7 +165,7 @@ class Scheduler:
 
     def __init__(self, engine: SlotEngine, *, clock=None, max_queue: int = 64,
                  metrics=None, fault_hook=None, tracer=None,
-                 replica: int = 0) -> None:
+                 replica: int = 0, telemetry=None) -> None:
         self.engine = engine
         self.clock = clock or MonotonicClock()
         self.max_queue = max_queue
@@ -178,6 +178,11 @@ class Scheduler:
         # own tracer reference (set_tracer) for its dispatch lanes.
         self.tracer = tracer
         self.replica = replica
+        # optional utils/telemetry.py exporter (anything with
+        # on_completion): one streamed "flight" line per completion —
+        # for SINGLE-replica serving. Behind a router, the router is the
+        # telemetry owner (its merged flight records are the real ones).
+        self.telemetry = telemetry
         self.queue: Deque[Request] = deque()
         self.running: Dict[int, _Running] = {}  # slot -> state
         self.completions: List[Completion] = []
@@ -247,6 +252,8 @@ class Scheduler:
         self.completions.append(c)
         if self.metrics:
             self.metrics.on_complete(c, self)
+        if self.telemetry is not None:
+            self.telemetry.on_completion(c)
         return c
 
     def _expire_queue(self) -> None:

@@ -1,0 +1,299 @@
+"""Declarative SLOs with multi-window burn-rate alerting.
+
+The router's brown-out (serve/router.py) trips on instantaneous fleet
+pressure — a PROXY for what actually matters to users: are first tokens
+late (TTFT), are streams stuttering (TPOT), are requests erroring, are
+answers arriving at all. This module watches the real thing, Google-SRE
+style:
+
+- **Everything is a bad-event rate.** Each objective classifies every
+  completion as good or bad — TTFT over target, TPOT over target,
+  status "error", not-served — and carries an error BUDGET (for a p99
+  latency target the budget is 1%: up to 1% of requests may exceed the
+  target and the SLO still holds). Burn rate = observed bad fraction /
+  budget: 1.0 means consuming budget exactly as fast as allowed,
+  10 means ten times too fast.
+- **Two windows, asymmetric edges.** An alert TRIPS when burn exceeds
+  `trip_burn` in BOTH the fast and the slow window (the fast window
+  makes detection quick, the slow window stops a two-request blip from
+  paging), and RESOLVES only when the SLOW window's burn falls to
+  `resolve_burn` (< trip_burn). Trip fast, resolve slow, and the gap
+  between the thresholds is the hysteresis band — no flapping when burn
+  hovers at the boundary (pinned in tests/test_slo.py).
+- **Clock-injected and host-pure.** Time comes from the same clock the
+  scheduler uses, so a FakeClock chaos replay produces bit-identical
+  alert timelines; nothing here imports jax.
+
+Alert/resolve edges are emitted three ways so every consumer of the
+telemetry plane sees them: tracer instants (``slo_alert`` /
+``slo_resolve``, streamed through the TelemetryExporter sink), an
+``alert`` JSONL line (kind="alert"), and registry metrics
+(``slo_alerts_total``, per-objective ``slo_burn_rate`` /
+``slo_alert_active`` gauges). The router consumes `active` as a
+brown-out trigger: degradation driven by measured SLO violation, not
+just occupancy (serve/router.py _update_brownout).
+
+tools/check_slo.py evaluates the same objectives OFFLINE over a
+telemetry JSONL (bench artifacts, post-mortems), sharing
+`SLOConfig` and the percentile implementation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+from ddp_practice_tpu.utils.metrics import labelled
+from ddp_practice_tpu.utils.trace import ROUTER_PID, _resolve_clock
+
+# statuses that count as "served" for the availability objective;
+# everything else (timeout/shed/rejected/error) spent the user's
+# patience without an answer
+OK_STATUSES = ("eos", "length")
+
+# latency objectives are p99-shaped: the budget is the 1% of requests
+# allowed over the target
+_LATENCY_BUDGET = 0.01
+
+
+@dataclasses.dataclass(frozen=True)
+class SLOConfig:
+    """Targets (None = objective off) + window/threshold tuning.
+
+    JSON-round-trippable so `--slo` takes a literal or a file path:
+    ``{"ttft_p99_s": 0.5, "error_rate": 0.01, "availability": 0.99}``.
+    """
+
+    ttft_p99_s: Optional[float] = None   # p99 TTFT target (seconds)
+    tpot_p99_s: Optional[float] = None   # p99 TPOT target (seconds)
+    error_rate: Optional[float] = None   # max fraction status=="error"
+    availability: Optional[float] = None  # min fraction served ok
+    fast_window_s: float = 60.0
+    slow_window_s: float = 300.0
+    trip_burn: float = 2.0      # both windows >= this trips
+    resolve_burn: float = 1.0   # slow window <= this resolves
+    min_events: int = 5         # don't alert on fewer fast-window events
+
+    def __post_init__(self):
+        if self.slow_window_s < self.fast_window_s:
+            raise ValueError("slow_window_s must be >= fast_window_s")
+        if self.resolve_burn > self.trip_burn:
+            raise ValueError(
+                "resolve_burn must be <= trip_burn (the hysteresis band)"
+            )
+
+    @classmethod
+    def from_json(cls, source) -> "SLOConfig":
+        """A dict, a JSON string, or a path to a JSON file."""
+        if isinstance(source, cls):
+            return source
+        if isinstance(source, str):
+            stripped = source.strip()
+            if stripped.startswith("{"):
+                source = json.loads(stripped)
+            elif os.path.exists(source):
+                with open(source) as f:
+                    source = json.load(f)
+            else:
+                raise ValueError(
+                    f"--slo wants a JSON object or an existing file path, "
+                    f"got {source!r}"
+                )
+        if not isinstance(source, dict):
+            raise TypeError(f"cannot build SLOConfig from {type(source)}")
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(source) - known
+        if unknown:
+            raise ValueError(f"unknown SLO config keys: {sorted(unknown)}")
+        return cls(**source)
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self))
+
+    def objectives(self) -> Dict[str, float]:
+        """Active objectives -> error budget (bad fraction allowed)."""
+        out: Dict[str, float] = {}
+        if self.ttft_p99_s is not None:
+            out["ttft_p99"] = _LATENCY_BUDGET
+        if self.tpot_p99_s is not None:
+            out["tpot_p99"] = _LATENCY_BUDGET
+        if self.error_rate is not None:
+            out["error_rate"] = self.error_rate
+        if self.availability is not None:
+            out["availability"] = 1.0 - self.availability
+        if not out:
+            raise ValueError("SLO config enables no objective")
+        for name, budget in out.items():
+            if budget <= 0:
+                raise ValueError(
+                    f"objective {name} has zero error budget — a single "
+                    "bad event would be an infinite burn; relax the target"
+                )
+        return out
+
+
+def classify(config: SLOConfig, *, status: str,
+             ttft: Optional[float] = None,
+             tpot: Optional[float] = None) -> Dict[str, bool]:
+    """One event's per-objective bad flags (shared with check_slo.py).
+
+    Latency objectives only judge events that HAVE the measurement
+    (a request that never produced a token has no TTFT — its failure is
+    the availability objective's business, and double-counting it as a
+    latency breach would overstate burn)."""
+    flags: Dict[str, bool] = {}
+    if config.ttft_p99_s is not None and ttft is not None:
+        flags["ttft_p99"] = ttft > config.ttft_p99_s
+    if config.tpot_p99_s is not None and tpot is not None:
+        flags["tpot_p99"] = tpot > config.tpot_p99_s
+    if config.error_rate is not None:
+        flags["error_rate"] = status == "error"
+    if config.availability is not None:
+        flags["availability"] = status not in OK_STATUSES
+    return flags
+
+
+class SLOWatchdog:
+    """Rolling-window burn-rate evaluation with per-objective alerts."""
+
+    def __init__(self, config: SLOConfig, *, clock=None,
+                 registry=None, tracer=None, telemetry=None,
+                 pid: int = ROUTER_PID) -> None:
+        self.config = config
+        self.budgets = config.objectives()
+        self.tracer = tracer
+        self.telemetry = telemetry
+        self.registry = registry
+        self.pid = pid
+        # default time source when a caller omits `now`/`t` (the router
+        # always passes its own clock reading explicitly — same domain)
+        self._now = _resolve_clock(clock)
+        # (t, {objective: bad}) — pruned past the slow window
+        self._events: deque = deque()
+        # evaluation is O(events-in-slow-window) per objective, and the
+        # router calls evaluate() every tick: throttle the rescan to 5%
+        # of the fast window (detection latency <= interval, cost
+        # amortized). Callers that need an immediate verdict (tests,
+        # edge-of-window assertions) pass force=True.
+        self._eval_interval = config.fast_window_s / 20.0
+        self._last_eval: Optional[float] = None
+        self._last_report: Dict[str, dict] = {}
+        self.alerts: Dict[str, bool] = {o: False for o in self.budgets}
+        # (t, "trip"|"resolve", objective) history — tests and reports
+        self.alert_log: List[Tuple[float, str, str]] = []
+        self._alerts_ctr = (
+            registry.counter("slo_alerts_total")
+            if registry is not None else None
+        )
+
+    # ------------------------------------------------------------ intake
+    def observe(self, completion) -> None:
+        """Feed one scheduler/router Completion."""
+        self.observe_event(
+            t=completion.finish, status=completion.status,
+            ttft=completion.ttft, tpot=completion.tpot,
+        )
+
+    def observe_event(self, *, t: Optional[float] = None,
+                      status: str = "eos",
+                      ttft: Optional[float] = None,
+                      tpot: Optional[float] = None) -> None:
+        """Generic event intake — the train loop feeds step outcomes
+        through here (an anomalous step is status="error"). `t`
+        defaults to the injected clock."""
+        flags = classify(self.config, status=status, ttft=ttft, tpot=tpot)
+        if flags:
+            self._events.append(
+                (t if t is not None else self._now(), flags)
+            )
+
+    # -------------------------------------------------------- evaluation
+    def _window_burn(self, objective: str, now: float,
+                     window_s: float) -> Tuple[float, int]:
+        """(burn rate, events judged) for one objective over one window."""
+        lo = now - window_s
+        total = bad = 0
+        for t, flags in self._events:
+            if t <= lo or objective not in flags:
+                continue
+            total += 1
+            bad += flags[objective]
+        if total == 0:
+            return 0.0, 0
+        return (bad / total) / self.budgets[objective], total
+
+    def evaluate(self, now: Optional[float] = None,
+                 force: bool = False) -> Dict[str, dict]:
+        """Prune, recompute both windows per objective, walk the alert
+        state machine; returns the per-objective burn report. `now`
+        defaults to the injected clock. Called more often than the
+        throttle interval, it returns the cached report (see
+        `_eval_interval`) unless `force`."""
+        if now is None:
+            now = self._now()
+        if (not force and self._last_eval is not None
+                and now - self._last_eval < self._eval_interval):
+            return self._last_report
+        self._last_eval = now
+        cfg = self.config
+        lo = now - cfg.slow_window_s
+        while self._events and self._events[0][0] <= lo:
+            self._events.popleft()
+        report: Dict[str, dict] = {}
+        for objective in self.budgets:
+            fast, n_fast = self._window_burn(
+                objective, now, cfg.fast_window_s)
+            slow, n_slow = self._window_burn(
+                objective, now, cfg.slow_window_s)
+            active = self.alerts[objective]
+            if (not active and n_fast >= cfg.min_events
+                    and fast >= cfg.trip_burn and slow >= cfg.trip_burn):
+                self._edge(objective, "trip", now, fast, slow)
+                active = True
+            elif active and slow <= cfg.resolve_burn:
+                self._edge(objective, "resolve", now, fast, slow)
+                active = False
+            self.alerts[objective] = active
+            report[objective] = {
+                "burn_fast": fast, "burn_slow": slow,
+                "events_fast": n_fast, "events_slow": n_slow,
+                "active": active,
+            }
+            if self.registry is not None:
+                self.registry.gauge(labelled(
+                    "slo_burn_rate", objective=objective, window="fast",
+                )).set(fast)
+                self.registry.gauge(labelled(
+                    "slo_burn_rate", objective=objective, window="slow",
+                )).set(slow)
+                self.registry.gauge(labelled(
+                    "slo_alert_active", objective=objective,
+                )).set(float(active))
+        self._last_report = report
+        return report
+
+    def _edge(self, objective: str, edge: str, now: float,
+              fast: float, slow: float) -> None:
+        self.alert_log.append((now, edge, objective))
+        if edge == "trip" and self._alerts_ctr is not None:
+            self._alerts_ctr.inc()
+        if self.tracer is not None and self.tracer.enabled:
+            self.tracer.instant(
+                f"slo_{edge}" if edge == "resolve" else "slo_alert",
+                pid=self.pid, objective=objective,
+                burn_fast=round(fast, 3), burn_slow=round(slow, 3),
+            )
+        if self.telemetry is not None:
+            self.telemetry.emit(
+                "alert", event=edge, objective=objective,
+                burn_fast=fast, burn_slow=slow,
+            )
+
+    @property
+    def active(self) -> bool:
+        """Any objective currently alerting — the router's brown-out
+        trigger."""
+        return any(self.alerts.values())

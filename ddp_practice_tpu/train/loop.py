@@ -490,7 +490,11 @@ class Trainer:
         # Device-side profiles (profile_dir) line up with these by wall
         # clock; process 0 only, None = zero overhead.
         self._tracer = None
-        if config.trace_out and dist.process_index() == 0:
+        # a recorder exists for EITHER consumer: --trace_out wants the
+        # exit-time dump, --telemetry_out wants the live stream (the
+        # exporter attaches as sink below)
+        if (config.trace_out or config.telemetry_out) \
+                and dist.process_index() == 0:
             from ddp_practice_tpu.utils.trace import TraceRecorder
 
             self._tracer = TraceRecorder()
@@ -519,6 +523,143 @@ class Trainer:
 
         self._pending = deque()
 
+        # ---- live telemetry plane (utils/telemetry.py; process 0 only):
+        # step-time histogram, per-step MFU gauge (utils/flops.py
+        # analytic count / measured step time / chip peak), rolling-MAD
+        # straggler detector, optionally exported as streaming JSONL
+        # (--telemetry_out) and scraped over HTTP (--metrics_port), with
+        # an SLO burn-rate watchdog (--slo) over the detector's verdicts
+        # — the same plane the serving stack exposes (serve/slo.py).
+        self._telemetry = None
+        self._tele_server = None
+        self._train_registry = None
+        self._anomaly = None
+        self._slo = None
+        self._last_group_t = None
+        plane_on = (config.metrics_port is not None
+                    or config.telemetry_out or config.slo)
+        if plane_on and dist.process_index() == 0:
+            from ddp_practice_tpu.utils.flops import chip_peak_flops
+            from ddp_practice_tpu.utils.metrics import MetricsRegistry
+            from ddp_practice_tpu.utils.telemetry import (
+                StepAnomalyDetector,
+                TelemetryExporter,
+                TelemetryServer,
+            )
+
+            reg = MetricsRegistry()
+            self._train_registry = reg
+            self._step_time = reg.histogram("train_step_time_s")
+            self._mfu_gauge = reg.gauge("train_mfu")
+            self._anomaly_ctr = reg.counter("train_step_anomalies_total")
+            self._anomaly = StepAnomalyDetector()
+            self._flops_per_step = self._estimate_flops_per_step()
+            self._peak_flops = chip_peak_flops(
+                jax.devices()[0].device_kind
+            )
+            if config.telemetry_out:
+                self._telemetry = TelemetryExporter(
+                    config.telemetry_out, registry=reg
+                )
+                if self._tracer is not None:
+                    self._telemetry.attach(self._tracer)
+            if config.metrics_port is not None:
+                self._tele_server = TelemetryServer(
+                    registry=reg,
+                    # one lane; DEGRADED while the step-time SLO burns
+                    health_fn=lambda: {0: (
+                        "degraded"
+                        if self._slo is not None and self._slo.active
+                        else "healthy"
+                    )},
+                    flight_fn=lambda: {
+                        "step_time_s": self._step_time.summary(),
+                    },
+                    port=config.metrics_port,
+                )
+                info0("telemetry: /metrics /healthz /flight on port %d",
+                      self._tele_server.port)
+            if config.slo:
+                from ddp_practice_tpu.serve.slo import (
+                    SLOConfig,
+                    SLOWatchdog,
+                )
+
+                self._slo = SLOWatchdog(
+                    SLOConfig.from_json(config.slo), registry=reg,
+                    tracer=self._tracer, telemetry=self._telemetry,
+                    pid=0,
+                )
+
+    def _estimate_flops_per_step(self) -> Optional[float]:
+        """Analytic train FLOPs per optimizer step (utils/flops.py) for
+        the MFU gauge — best-effort: None (gauge stays 0) when the
+        architecture has no analytic model here."""
+        cfg = self.config
+        try:
+            if self.task == "lm":
+                from ddp_practice_tpu.utils.flops import (
+                    lm_train_flops_per_token,
+                )
+
+                m = self.model
+                per_tok = lm_train_flops_per_token(
+                    hidden_dim=m.hidden_dim, depth=m.depth,
+                    mlp_dim=m.mlp_dim, vocab_size=m.vocab_size,
+                    seq_len=cfg.seq_len,
+                )
+                return per_tok * cfg.seq_len * self.global_batch
+            from ddp_practice_tpu.utils.flops import train_flops_per_image
+
+            kw = {}
+            if cfg.model.startswith("vit"):
+                m = self.model
+                kw = dict(patch_size=m.patch_size, hidden_dim=m.hidden_dim,
+                          depth=m.depth, mlp_dim=m.mlp_dim)
+            f = train_flops_per_image(
+                cfg.model, tuple(self.train_ds.image_shape),
+                self.train_ds.num_classes, **kw,
+            )
+            return f * self.global_batch if f else None
+        except (AttributeError, TypeError, ValueError):
+            return None
+
+    def _observe_group(self, k: int) -> None:
+        """Telemetry per dispatch group: step-time histogram, rolling-
+        MAD straggler verdict (counted, traced, streamed), per-step MFU
+        gauge, SLO feed. Host wall time between group boundaries — a
+        straggler is a straggler whether the time went to the device,
+        the data pipeline, or dispatch."""
+        import time as _time
+
+        now = _time.monotonic()
+        last, self._last_group_t = self._last_group_t, now
+        if last is None or k <= 0:
+            return
+        step_s = (now - last) / k
+        self._step_time.observe(step_s)
+        anomalous = self._anomaly.observe(step_s)
+        if anomalous:
+            self._anomaly_ctr.inc()
+            warn0("step-time anomaly: %.3fs/step vs rolling median "
+                  "(straggler?)", step_s)
+            if self._tracer is not None and self._tracer.enabled:
+                self._tracer.instant("step_anomaly", pid=0, tid=0,
+                                     step_s=round(step_s, 6))
+            if self._telemetry is not None:
+                self._telemetry.emit("anomaly", step_s=step_s)
+        if self._flops_per_step and self._peak_flops:
+            self._mfu_gauge.set(
+                self._flops_per_step / step_s
+                / (self._peak_flops * jax.device_count())
+            )
+        if self._slo is not None:
+            # the straggler SLO: an anomalous step is the bad event
+            self._slo.observe_event(
+                t=now, status="error" if anomalous else "eos"
+            )
+            self._slo.evaluate(now)
+
     def _tspan(self, name: str, **attrs):
         """A step-phase span on the train lane, or a no-op without
         --trace-out (one attribute test on the hot path)."""
@@ -539,8 +680,8 @@ class Trainer:
             yield item
 
     def _save_trace(self) -> None:
-        if self._tracer is None:
-            return
+        if self._tracer is None or not self.config.trace_out:
+            return  # stream-only runs (--telemetry_out) have no dump
         try:
             self._tracer.save(self.config.trace_out)
             info0("wrote host trace to %s (%d events)",
@@ -711,6 +852,8 @@ class Trainer:
         progress beat). Boundary-crossing tests, not modulo: groups
         advance by K."""
         cfg = self.config
+        if self._train_registry is not None:
+            self._observe_group(steps_done - prev)
         self._track(metrics["loss"])
         self._probe_if_due(prev, steps_done)
         if cfg.sync_check_every_steps and (
@@ -725,9 +868,11 @@ class Trainer:
                 epoch * self.train_loader.steps_per_epoch + steps_done,
                 what="driver step",
             )
+        bookkeeping = False  # log readback / checkpoint this boundary?
         if cfg.log_every_steps and (
             prev // cfg.log_every_steps != steps_done // cfg.log_every_steps
         ):
+            bookkeeping = True
             with self._tspan("block", step=steps_done):
                 m = jax.device_get(metrics)
             if self._watchdog is not None:
@@ -748,7 +893,16 @@ class Trainer:
             and prev // cfg.checkpoint_every_steps
             != steps_done // cfg.checkpoint_every_steps
         ):
+            bookkeeping = True
             self.save(periodic=True)
+        if bookkeeping and self._train_registry is not None:
+            # the readback/checkpoint above is boundary bookkeeping, not
+            # a step: restart the step-time window AFTER it, or the next
+            # group's sample absorbs it and the straggler detector / SLO
+            # flags a healthy run (same reason _close_train_epoch resets)
+            import time as _time
+
+            self._last_group_t = _time.monotonic()
 
     def _write_metrics(self, record: dict) -> None:
         """Append one JSON line to the metrics file (process 0; no-op
@@ -784,6 +938,9 @@ class Trainer:
                 jax.device_get(final_metrics["loss"])
                 if self._watchdog is not None:
                     self._watchdog.beat()
+        # an epoch boundary's eval/checkpoint gap is not a step — don't
+        # let the straggler detector judge it as one
+        self._last_group_t = None
 
     def _train_epoch_resident(self, epoch: int) -> dict:
         """One epoch against the HBM-resident corpus: the only H2D traffic
@@ -1174,6 +1331,15 @@ class Trainer:
             # written in the finally so a crashed run still leaves its
             # partial timeline — a flight recorder's whole point
             self._save_trace()
+            if self._tele_server is not None:
+                self._tele_server.close()
+                self._tele_server = None
+            if self._telemetry is not None:
+                # drain + final snapshot; the streamed lines were
+                # flushed as they happened, so even skipping this
+                # (SIGKILL) leaves a valid line-by-line file
+                self._telemetry.close()
+                self._telemetry = None
 
     def _fit_inner(self) -> dict:
         cfg = self.config

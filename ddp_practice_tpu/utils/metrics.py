@@ -23,8 +23,11 @@ standard scraper can consume the registry without an adapter.
 
 from __future__ import annotations
 
+import logging
 import threading
-from typing import Dict, Iterable, Optional
+from typing import Dict, Iterable, Optional, Sequence, Tuple
+
+log = logging.getLogger(__name__)
 
 
 def _escape_label_value(v: str) -> str:
@@ -68,6 +71,41 @@ def _fmt_value(v: float) -> str:
     return repr(f)
 
 
+# ---------------------------------------------------- label cardinality guard
+# A labelled() family's size is bounded by the number of DISTINCT label
+# values it has ever seen; a label fed from unbounded input (request ids,
+# error strings, hostnames) would grow the registry — and every scrape —
+# without limit over a long run. The guard caps distinct values per
+# (metric name, label key): the first `_LABEL_LIMIT` values pass through,
+# everything after lands in the shared "other" bucket, counted in
+# `metrics_label_overflow_total` (default registry) and warned once per
+# family. Process-wide on purpose: labelled() is a pure key-maker used
+# against many registries, and the blast radius of a high-cardinality
+# label is the process, not one registry.
+_LABEL_LIMIT = 64
+_LABEL_OVERFLOW = "other"
+_label_values: Dict[Tuple[str, str], set] = {}
+_label_warned: set = set()
+_label_lock = threading.Lock()
+
+
+def set_label_limit(n: int) -> int:
+    """Set the per-(metric, label) distinct-value cap; returns the old
+    cap (so tests can restore it)."""
+    global _LABEL_LIMIT
+    if n < 1:
+        raise ValueError("label limit must be positive")
+    old, _LABEL_LIMIT = _LABEL_LIMIT, n
+    return old
+
+
+def reset_label_guard() -> None:
+    """Forget seen label values (tests; a production process never does)."""
+    with _label_lock:
+        _label_values.clear()
+        _label_warned.clear()
+
+
 def labelled(name: str, **labels) -> str:
     """Render a labelled metric name: ``labelled("x", r="a")`` -> ``x{r=a}``.
 
@@ -75,11 +113,39 @@ def labelled(name: str, **labels) -> str:
     per-reason families (router breaker state, sheds-by-reason) need one
     metric per label value. Labels render sorted, so the same label set
     always produces the same name however the caller spells the kwargs.
+    Distinct values per (name, key) are capped (see the guard above):
+    past the cap a value renders as "other" instead of minting a new
+    registry entry.
     """
     if not labels:
         return name
-    inner = ",".join(f"{k}={labels[k]}" for k in sorted(labels))
-    return f"{name}{{{inner}}}"
+    parts = []
+    for k in sorted(labels):
+        v = str(labels[k])
+        with _label_lock:
+            seen = _label_values.setdefault((name, k), set())
+            if v not in seen:
+                if len(seen) >= _LABEL_LIMIT:
+                    if (name, k) not in _label_warned:
+                        _label_warned.add((name, k))
+                        log.warning(
+                            "metric %s label %s exceeded %d distinct values"
+                            " — overflow bucketed to %r",
+                            name, k, _LABEL_LIMIT, _LABEL_OVERFLOW,
+                        )
+                    v = _LABEL_OVERFLOW
+                    overflow = True
+                else:
+                    seen.add(v)
+                    overflow = False
+            else:
+                overflow = False
+        if overflow:
+            default_registry().counter(
+                "metrics_label_overflow_total"
+            ).inc()
+        parts.append(f"{k}={v}")
+    return f"{name}{{{','.join(parts)}}}"
 
 
 class Counter:
@@ -144,6 +210,30 @@ class Histogram:
             out[f"p{p:g}"] = self.percentile(p)
         return out
 
+    @classmethod
+    def of(cls, values: Sequence[float]) -> "Histogram":
+        """A histogram holding exactly `values` (offline summaries)."""
+        h = cls(max_samples=max(len(values), 1))
+        for v in values:
+            h.observe(v)
+        return h
+
+
+def percentile_summary(values: Sequence[float],
+                       percentiles: Iterable[float] = (50, 90, 99)) -> dict:
+    """{"p50": ..., "p90": ..., "p99": ..., "mean": ...} over `values`.
+
+    THE percentile implementation of the telemetry plane — the serve
+    bench's latency rows, the /flight scrape endpoint, the SLO watchdog,
+    and tools/check_slo.py all summarize through here (nearest-rank via
+    Histogram.percentile), so a quantile quoted by any of them means the
+    same thing. Empty input yields zeros, matching Histogram.
+    """
+    h = Histogram.of(values)
+    out = {f"p{p:g}": h.percentile(p) for p in percentiles}
+    out["mean"] = h.mean
+    return out
+
 
 class MetricsRegistry:
     """Create-or-get named metrics; snapshot() flattens to one dict.
@@ -175,13 +265,20 @@ class MetricsRegistry:
 
     def snapshot(self) -> dict:
         """Flat `{name: value}` dict; histograms expand to name_count /
-        name_mean / name_p50 / name_p90 / name_p99."""
+        name_mean / name_p50 / name_p90 / name_p99. The metric dicts
+        are copied under the create-lock first — a background reader
+        (the telemetry exporter's snapshot thread, an HTTP scrape) must
+        not race a serve loop that is still minting labelled metrics."""
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            histograms = dict(self._histograms)
         out: dict = {}
-        for name, c in self._counters.items():
+        for name, c in counters.items():
             out[name] = c.value
-        for name, g in self._gauges.items():
+        for name, g in gauges.items():
             out[name] = g.value
-        for name, h in self._histograms.items():
+        for name, h in histograms.items():
             for k, v in h.summary().items():
                 out[f"{name}_{k}"] = v
         return out

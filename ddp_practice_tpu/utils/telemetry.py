@@ -1,0 +1,419 @@
+"""Live telemetry plane: streaming JSONL export + HTTP scrape endpoints.
+
+PR 4 made every request a traceable timeline, but the telemetry was dead
+on arrival: the trace was one JSON dump at exit (a SIGKILL'd run left
+nothing), `render_text()` Prometheus exposition had no scrape endpoint,
+and flight-record percentiles existed only in the bench's offline
+report. This module is the live half:
+
+- **TelemetryExporter** — a background writer draining a BOUNDED queue
+  of events to line-delimited JSONL. Producers (the TraceRecorder sink,
+  scheduler/router completion hooks, periodic metrics snapshots) never
+  block the serving loop: a full queue drops the event and counts it in
+  ``telemetry_dropped_total`` — dropped telemetry is a metric, stalled
+  serving is an outage. Every line is written whole and flushed, so a
+  killed run leaves a file that is valid line by line (at worst one
+  truncated tail line, which the offline tools tolerate).
+- **TelemetryServer** — an embedded stdlib ThreadingHTTPServer (port 0
+  for tests) exposing ``/metrics`` (utils/metrics.py render_text
+  Prometheus exposition), ``/healthz`` (per-replica HEALTHY/DEGRADED/
+  DEAD from serve/health.py via an injected callback; 503 only when the
+  whole fleet is dead), and ``/flight`` (rolling per-phase
+  queue/prefill/decode/stall percentiles from flight records).
+- **FlightStats** — the rolling window behind ``/flight``: last-N
+  flight records summarized through utils/metrics.percentile_summary,
+  the same percentile math the bench and the SLO tools use.
+- **StepAnomalyDetector** — train-side rolling median/MAD straggler
+  detector: a step time that exceeds the rolling median by k MADs is an
+  anomaly (counted, traced, and feedable to an SLO watchdog). MAD
+  rather than mean/stddev so one straggler doesn't inflate the baseline
+  it is judged against.
+
+Host-pure (nothing here imports jax); the event clock is injectable so
+FakeClock runs stamp deterministic times, while the writer thread's
+snapshot cadence uses wall time (it is I/O pacing, not data).
+
+JSONL stream schema (one object per line, "kind"-tagged):
+``meta`` / ``span`` / ``async`` / ``instant`` come from the
+TraceRecorder sink (tools/check_traces.py re-assembles and validates
+them as a Chrome trace); ``flight`` carries one completion's merged
+flight record (tools/check_slo.py renders SLO verdicts from these);
+``metrics`` is a periodic registry snapshot; ``alert`` is an SLO
+burn-rate trip/resolve instant (serve/slo.py).
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+import time
+from collections import deque
+from statistics import median
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional
+
+from ddp_practice_tpu.utils.metrics import (
+    MetricsRegistry,
+    percentile_summary,
+)
+from ddp_practice_tpu.utils.trace import _resolve_clock
+
+
+class FlightStats:
+    """Rolling window of flight records -> per-phase percentiles.
+
+    The live counterpart of the bench's offline phase breakdown: the
+    last `window` completions' queue/prefill/decode/stall seconds plus
+    TTFT/TPOT, summarized on demand for the ``/flight`` endpoint and
+    anything else that wants "where is latency going RIGHT NOW".
+    Thread-safe: the serve loop appends, the HTTP thread reads.
+    """
+
+    PHASES = ("queue_s", "prefill_s", "decode_s", "stall_s")
+
+    def __init__(self, window: int = 512) -> None:
+        self._lock = threading.Lock()
+        self._flights: deque = deque(maxlen=window)
+        self._ttft: deque = deque(maxlen=window)
+        self._tpot: deque = deque(maxlen=window)
+
+    def on_completion(self, completion, **_kw) -> None:
+        with self._lock:
+            if completion.flight is not None:
+                self._flights.append(completion.flight)
+            if completion.ttft is not None:
+                self._ttft.append(completion.ttft)
+            if completion.tpot is not None:
+                self._tpot.append(completion.tpot)
+
+    def report(self) -> dict:
+        with self._lock:
+            flights = list(self._flights)
+            ttft = list(self._ttft)
+            tpot = list(self._tpot)
+        out: dict = {"window": len(flights)}
+        for key in self.PHASES:
+            out[key] = percentile_summary(
+                [f[key] for f in flights if key in f]
+            )
+        out["ttft_s"] = percentile_summary(ttft)
+        out["tpot_s"] = percentile_summary(tpot)
+        return out
+
+
+class TelemetryExporter:
+    """Background JSONL writer over a bounded, drop-counting queue."""
+
+    def __init__(self, path: str, *, registry: Optional[MetricsRegistry]
+                 = None, clock=None, snapshot_interval_s: float = 1.0,
+                 max_queue: int = 8192, flight_window: int = 512,
+                 start: bool = True) -> None:
+        self.path = path
+        self.registry = registry
+        self._now = _resolve_clock(clock)
+        self._interval = snapshot_interval_s
+        self._q: queue.Queue = queue.Queue(maxsize=max_queue)
+        self.flight = FlightStats(flight_window)
+        self.dropped = 0
+        self.write_errors = 0  # events the worker could not serialize/write
+        self._dropped_ctr = (
+            registry.counter("telemetry_dropped_total")
+            if registry is not None else None
+        )
+        self._fh = open(path, "w")
+        self._wlock = threading.Lock()  # file writes (worker vs pump/close)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._closed = False
+        if start:
+            self.start()
+
+    # ------------------------------------------------------------ producers
+    def emit(self, kind: str, **fields) -> None:
+        """Enqueue one event (never blocks; full queue drops + counts)."""
+        self._enqueue({"kind": kind, "t": self._now(), **fields})
+
+    def trace_sink(self, record: dict) -> None:
+        """TraceRecorder sink: pass span/async/instant/meta records
+        through verbatim (already kind-tagged, already timestamped in
+        the recorder's clock domain). Attach via `attach(tracer)`."""
+        self._enqueue(record)
+
+    def attach(self, tracer) -> None:
+        """Subscribe to a utils/trace.py TraceRecorder (replays lane
+        labels recorded before the attach)."""
+        tracer.set_sink(self.trace_sink)
+
+    def on_completion(self, completion, slo_exempt: bool = False) -> None:
+        """Scheduler/Router completion hook: one ``flight`` line plus
+        the rolling /flight window. `slo_exempt` marks completions the
+        live watchdog deliberately did not judge (the router's own
+        brown-out sheds), so the offline verdict (tools/check_slo.py)
+        can reproduce the online judgment instead of disagreeing."""
+        self.flight.on_completion(completion)
+        ev = {
+            "kind": "flight", "t": completion.finish,
+            "rid": completion.rid, "status": completion.status,
+            "arrival": completion.arrival, "finish": completion.finish,
+            "ttft": completion.ttft, "tpot": completion.tpot,
+            "tokens": len(completion.tokens),
+        }
+        if slo_exempt:
+            ev["slo_exempt"] = True
+        if completion.flight is not None:
+            ev.update(completion.flight)
+        self._enqueue(ev)
+
+    def snapshot_now(self) -> None:
+        """Enqueue one metrics snapshot out of band (the worker also
+        writes one per `snapshot_interval_s` while running)."""
+        if self.registry is not None:
+            self._enqueue({"kind": "metrics", "t": self._now(),
+                           "snapshot": self.registry.snapshot()})
+
+    def _enqueue(self, ev: dict) -> None:
+        if self._closed:
+            return
+        try:
+            self._q.put_nowait(ev)
+        except queue.Full:
+            # the whole point of the bounded queue: a slow disk must
+            # never stall the serve/train loop — drop, and make the
+            # drop itself observable
+            self.dropped += 1
+            if self._dropped_ctr is not None:
+                self._dropped_ctr.inc()
+
+    # ------------------------------------------------------------- the drain
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._run, name="telemetry-exporter", daemon=True
+        )
+        self._thread.start()
+
+    def _run(self) -> None:
+        # wall time for PACING (how often to snapshot / poll), the
+        # injected clock only stamps event payloads
+        last_snap = time.monotonic()
+        poll = min(0.2, self._interval) if self._interval else 0.2
+        while not self._stop.is_set():
+            try:
+                ev = self._q.get(timeout=poll)
+            except queue.Empty:
+                ev = None
+            try:
+                if ev is not None:
+                    self._write(ev)
+                if (self.registry is not None and self._interval
+                        and time.monotonic() - last_snap
+                        >= self._interval):
+                    last_snap = time.monotonic()
+                    self._write({"kind": "metrics", "t": self._now(),
+                                 "snapshot": self.registry.snapshot()})
+            except Exception:
+                # one bad event (unserializable attr, transient OS
+                # error) must not kill the drain thread — that would
+                # silently turn every later event into a "drop"
+                self.write_errors += 1
+
+    def pump(self) -> int:
+        """Drain the queue synchronously (tests run with start=False so
+        the file content is deterministic); returns lines written."""
+        n = 0
+        while True:
+            try:
+                ev = self._q.get_nowait()
+            except queue.Empty:
+                return n
+            try:
+                self._write(ev)
+                n += 1
+            except Exception:
+                # same contract as the worker: one unserializable event
+                # skips, it does not break the drain (pump/close run in
+                # finally blocks — raising here would mask the real
+                # result or exception)
+                self.write_errors += 1
+
+    def _write(self, ev: dict) -> None:
+        # one json.dumps + one write + one flush per event: after the
+        # flush the line is in the OS page cache whole — a SIGKILL can
+        # truncate at most the line currently being written
+        line = json.dumps(ev)
+        with self._wlock:
+            if self._fh.closed:
+                return
+            self._fh.write(line + "\n")
+            self._fh.flush()
+
+    def close(self) -> None:
+        """Stop the worker, drain everything queued, write one final
+        snapshot + drop count, close the file."""
+        if self._closed:
+            return
+        self._closed = True
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        self.pump()
+        try:
+            if self.registry is not None:
+                self._write({"kind": "metrics", "t": self._now(),
+                             "snapshot": self.registry.snapshot()})
+            self._write({"kind": "telemetry_close", "t": self._now(),
+                         "dropped": self.dropped,
+                         "write_errors": self.write_errors})
+        except Exception:
+            self.write_errors += 1  # never raise out of a finally block
+        with self._wlock:
+            self._fh.close()
+
+    def __enter__(self) -> "TelemetryExporter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+# --------------------------------------------------------------- HTTP plane
+# /healthz overall verdict: DEAD only when EVERY replica is dead (a fleet
+# with one live replica still serves — degraded is a routing concern, not
+# an availability one); 503 only on DEAD so orchestrators restart the
+# process exactly when it can no longer serve at all.
+def _overall_health(states: Dict) -> str:
+    vals = [str(v).lower() for v in states.values()]
+    if vals and all(v == "dead" for v in vals):
+        return "DEAD"
+    if any(v != "healthy" for v in vals):
+        return "DEGRADED"
+    return "HEALTHY"
+
+
+class TelemetryServer:
+    """Embedded scrape endpoint: /metrics, /healthz, /flight.
+
+    stdlib ThreadingHTTPServer on its own daemon thread — no framework,
+    no dependency, good enough for a scraper hitting it a few times a
+    second. `port=0` binds an ephemeral port (tests read `.port`).
+    Handlers only READ (render_text snapshot, health callback, flight
+    window), so they never contend with the serve loop beyond the
+    registry's create-lock.
+    """
+
+    def __init__(self, *, registry: Optional[MetricsRegistry] = None,
+                 health_fn: Optional[Callable[[], Dict]] = None,
+                 flight_fn: Optional[Callable[[], dict]] = None,
+                 port: int = 0, host: str = "127.0.0.1",
+                 start: bool = True) -> None:
+        self.registry = registry
+        self.health_fn = health_fn
+        self.flight_fn = flight_fn
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # no stderr spam per scrape
+                pass
+
+            def do_GET(self):
+                try:
+                    body, status, ctype = outer._route(self.path)
+                except Exception as e:  # a broken callback must not
+                    body = f"internal error: {e}".encode()
+                    status, ctype = 500, "text/plain"  # kill the server
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._server.daemon_threads = True
+        self.host = host
+        self.port = self._server.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+        if start:
+            self.start()
+
+    def _route(self, path: str):
+        path = path.split("?", 1)[0]
+        if path == "/metrics":
+            text = (self.registry.render_text()
+                    if self.registry is not None else "")
+            return text.encode(), 200, "text/plain; version=0.0.4"
+        if path == "/healthz":
+            states = dict(self.health_fn()) if self.health_fn else {}
+            overall = _overall_health(states)
+            body = json.dumps({"status": overall, "replicas": states})
+            return (body.encode(),
+                    503 if overall == "DEAD" else 200,
+                    "application/json")
+        if path == "/flight":
+            report = self.flight_fn() if self.flight_fn else {}
+            return json.dumps(report).encode(), 200, "application/json"
+        return b"not found", 404, "text/plain"
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            kwargs={"poll_interval": 0.1},
+            name="telemetry-http", daemon=True,
+        )
+        self._thread.start()
+
+    def close(self) -> None:
+        if self._thread is None:
+            return
+        self._server.shutdown()
+        self._thread.join(timeout=5.0)
+        self._server.server_close()
+        self._thread = None
+
+    def __enter__(self) -> "TelemetryServer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+# ------------------------------------------------------- train-side rolling
+class StepAnomalyDetector:
+    """Rolling median/MAD straggler detector for step times.
+
+    An anomaly is a step SLOWER than median + threshold * scale, where
+    scale = max(MAD, rel_floor * median): the MAD term adapts to real
+    jitter, the relative floor keeps a near-constant step-time history
+    (FakeClock, or a well-behaved TPU) from flagging microscopic noise
+    once MAD collapses toward zero. Fast steps are never anomalies —
+    the detector hunts stragglers, not luck.
+    """
+
+    def __init__(self, window: int = 64, threshold: float = 5.0,
+                 min_samples: int = 8, rel_floor: float = 0.05) -> None:
+        if min_samples < 2:
+            raise ValueError("min_samples must be >= 2")
+        self._times: deque = deque(maxlen=window)
+        self.threshold = threshold
+        self.min_samples = min_samples
+        self.rel_floor = rel_floor
+        self.anomalies = 0
+
+    def observe(self, step_s: float) -> bool:
+        """Record one step time; True when it is a straggler vs the
+        window BEFORE it (the anomaly is judged against history, then
+        joins it — one bad step inflates no baseline)."""
+        anomalous = False
+        if len(self._times) >= self.min_samples:
+            med = median(self._times)
+            mad = median([abs(x - med) for x in self._times])
+            scale = max(mad, self.rel_floor * med, 1e-9)
+            anomalous = (step_s - med) > self.threshold * scale
+        self._times.append(step_s)
+        if anomalous:
+            self.anomalies += 1
+        return anomalous

@@ -19,6 +19,11 @@ This module is the missing recorder:
   shared no-op context manager and `instant()` returns immediately; the
   instrumented hot paths additionally gate on `tracer is not None`, so
   the production default (no tracer) pays a single attribute test.
+- **Streamable.** An optional `sink` (utils/telemetry.py
+  TelemetryExporter) receives every record as a plain dict THE MOMENT it
+  is recorded — line-delimited JSONL export that survives a SIGKILL,
+  where `save()` (the exit-time Chrome dump) would leave nothing.
+  tools/check_traces.py validates both forms.
 
 Three record kinds, three Chrome trace-event encodings
 (`to_chrome_trace()` emits the JSON Perfetto / chrome://tracing /
@@ -133,7 +138,7 @@ class TraceRecorder:
     """Bounded, clock-injected span/event recorder (see module doc)."""
 
     def __init__(self, *, clock=None, max_events: int = 65536,
-                 enabled: bool = True) -> None:
+                 enabled: bool = True, sink=None) -> None:
         if max_events < 1:
             raise ValueError("max_events must be positive")
         self._now = _resolve_clock(clock)
@@ -143,6 +148,46 @@ class TraceRecorder:
         self._lock = threading.Lock()
         self._process_names: Dict[int, str] = {}
         self._thread_names: Dict[tuple, str] = {}
+        # streaming sink (utils/telemetry.py TelemetryExporter): called
+        # with one plain dict per record AS IT IS RECORDED, so a killed
+        # run's events survive outside this ring buffer. None = the
+        # exit-time export (save()) is the only output.
+        self._sink = None
+        if sink is not None:
+            self.set_sink(sink)
+
+    def set_sink(self, sink) -> None:
+        """Attach a streaming consumer: `sink(record_dict)` per span/
+        async/instant record (kind-tagged; see _stream) plus one "meta"
+        record per lane label. Already-recorded lane labels are replayed
+        into the sink at attach time, so a sink attached after
+        label_replica() still knows every pid."""
+        self._sink = sink
+        for pid, name in self._process_names.items():
+            sink({"kind": "meta", "meta": "process_name",
+                  "pid": pid, "name": name})
+        for (pid, tid), name in self._thread_names.items():
+            sink({"kind": "meta", "meta": "thread_name",
+                  "pid": pid, "tid": tid, "name": name})
+
+    def _stream(self, rec: dict) -> None:
+        if self._sink is not None:
+            self._sink(rec)
+
+    def _stream_record(self, kind: str, name, pid, tid, trace_id,
+                       attrs, **times) -> None:
+        """Build + emit one sink record (callers gate on `_sink is not
+        None` first, so the no-sink hot path never builds the dict).
+        The stream schema has ONE producer: change it here, and every
+        record kind follows."""
+        rec = {"kind": kind, "name": name, **times, "pid": pid}
+        if tid is not None:
+            rec["tid"] = tid
+        if trace_id is not None:
+            rec["trace_id"] = trace_id
+        if attrs:
+            rec["attrs"] = attrs
+        self._sink(rec)
 
     # ------------------------------------------------------------ recording
     def now(self) -> float:
@@ -164,6 +209,9 @@ class TraceRecorder:
         self._records.append(_Rec(
             _DUR, name, t0, t1, pid, tid, trace_id, attrs, next(self._seq)
         ))
+        if self._sink is not None:
+            self._stream_record("span", name, pid, tid, trace_id, attrs,
+                                t0=t0, t1=t1)
 
     def record_async(self, name: str, t0: float, t1: float, *,
                      trace_id: str, pid: int = 0,
@@ -175,6 +223,9 @@ class TraceRecorder:
         self._records.append(_Rec(
             _ASYNC, name, t0, t1, pid, 0, trace_id, attrs, next(self._seq)
         ))
+        if self._sink is not None:
+            self._stream_record("async", name, pid, None, trace_id,
+                                attrs, t0=t0, t1=t1)
 
     def instant(self, name: str, *, trace_id: Optional[str] = None,
                 pid: int = 0, tid: int = 0, **attrs) -> None:
@@ -185,13 +236,20 @@ class TraceRecorder:
             _INSTANT, name, t, t, pid, tid, trace_id, attrs or None,
             next(self._seq)
         ))
+        if self._sink is not None:
+            self._stream_record("instant", name, pid, tid, trace_id,
+                                attrs or None, t=t)
 
     # ------------------------------------------------------------- metadata
     def set_process_name(self, pid: int, name: str) -> None:
         self._process_names[pid] = name
+        self._stream({"kind": "meta", "meta": "process_name",
+                      "pid": pid, "name": name})
 
     def set_thread_name(self, pid: int, tid: int, name: str) -> None:
         self._thread_names[(pid, tid)] = name
+        self._stream({"kind": "meta", "meta": "thread_name",
+                      "pid": pid, "tid": tid, "name": name})
 
     # ------------------------------------------------------------- plumbing
     def __len__(self) -> int:

@@ -240,6 +240,89 @@ def test_brownout_sheds_low_priority_and_caps_budget(devices, lm):
     assert snap["serve_sheds_total{reason=brownout}"] == 3
 
 
+def test_slo_burn_trips_brownout_below_pressure_threshold(devices, lm):
+    """THE SLO-brownout pin (ISSUE 5): the router browns out from SLO
+    burn with fleet pressure far below `brownout_on`, behaves exactly
+    like a pressure brown-out while engaged (door sheds, budget caps),
+    and disengages with hysteresis only after the slow window clears —
+    all under FakeClock."""
+    from ddp_practice_tpu.serve.slo import SLOConfig, SLOWatchdog
+    from ddp_practice_tpu.utils.trace import TraceRecorder
+
+    model, params = lm
+    cfg = EngineConfig(max_slots=4, max_len=96, prompt_buckets=(8,),
+                       temperature=0.0)
+    clock = FakeClock(step_s=0.01)
+    tracer = TraceRecorder(clock=clock)
+    watchdog = SLOWatchdog(
+        SLOConfig(availability=0.9, fast_window_s=0.5, slow_window_s=2.0,
+                  trip_burn=2.0, resolve_burn=1.0, min_events=3),
+        clock=clock, tracer=tracer,
+    )
+    router = make_router(
+        model, params, 1, cfg, clock=clock, max_queue=64,
+        # brownout_on is unreachable: ONLY the SLO can trip the mode
+        config=RouterConfig(brownout_on=50.0, brownout_off=0.4,
+                            brownout_max_new=2, shed_priority=1,
+                            retry_jitter=0.0),
+        tracer=tracer, slo=watchdog,
+    )
+    router.warmup()
+    tracer.clear()
+    # five already-expired deadlines -> five "timeout" completions in
+    # one tick: availability burn trips while the fleet sits idle
+    for rid in range(5):
+        router.submit(Request(rid=rid, prompt=[1 + rid, 2],
+                              max_new_tokens=4, deadline=-1.0))
+    router.step()
+    assert watchdog.active
+    assert router.brownout
+    assert router.metrics.brownout_active.value == 1
+    # the point: pressure is nowhere near the pressure trigger
+    assert router.metrics.fleet_pressure.value < 50.0
+    # engaged brown-out behaves identically to the pressure one
+    assert not router.submit(Request(rid=10, prompt=[3, 2],
+                                     max_new_tokens=6, priority=1))
+    assert router.submit(Request(rid=11, prompt=[4, 2],
+                                 max_new_tokens=6, priority=0))
+    router.run_until_idle()
+    by_rid = {c.rid: c for c in router.completions}
+    assert by_rid[10].status == "shed"
+    assert by_rid[11].status == "length" and len(by_rid[11].tokens) == 2
+    # anti-windup: rid 10's shed was the BROWN-OUT's own doing — it
+    # must not count as an availability failure, or the alert would
+    # feed itself and the mode could never disengage under sustained
+    # low-priority traffic. Bad events seen = the 5 original timeouts.
+    assert sum(
+        flags.get("availability", False)
+        for _, flags in watchdog._events
+    ) == 5
+    # pressure is BELOW brownout_off already; the mode must still hold
+    # until the SLO resolves (disengage needs both)
+    assert router.metrics.fleet_pressure.value <= 0.4
+    assert router.brownout
+    # tick past the slow window: watchdog resolves, brown-out clears
+    for _ in range(400):
+        router.step()
+        if not router.brownout:
+            break
+    assert not watchdog.active
+    assert not router.brownout
+    assert [e for _, e, _ in watchdog.alert_log] == ["trip", "resolve"]
+    # the trace records the whole story: slo alert edges + a brownout_on
+    # instant attributed to the SLO trigger, and it validates clean
+    from tools.check_traces import validate
+
+    trace = tracer.to_chrome_trace()
+    assert validate(trace) == []
+    by_name = {}
+    for ev in trace["traceEvents"]:
+        by_name.setdefault(ev["name"], []).append(ev)
+    assert "slo_alert" in by_name and "slo_resolve" in by_name
+    assert by_name["brownout_on"][0]["args"]["trigger"] == "slo"
+    assert "brownout_off" in by_name
+
+
 def test_permanently_dead_fleet_sheds_not_hangs(devices, lm):
     """The none-lost invariant with NOWHERE to fail over: a 1-replica
     fleet whose only replica dies for good must give every in-flight and

@@ -1,0 +1,296 @@
+"""SLO burn-rate watchdog (serve/slo.py): trip/resolve semantics under
+an injected clock, config round-trips, and THE chaos acceptance pin —
+a 2-replica fault-plan run whose SLO alert/resolve instants land in a
+validator-clean trace and whose streamed telemetry JSONL renders the
+violation through tools/check_slo.py.
+
+The window tests are pure host math on synthetic events (no engines):
+fast-window trip, slow-window resolve, and no flapping when burn hovers
+in the hysteresis band between `resolve_burn` and `trip_burn`.
+"""
+
+import json
+
+import pytest
+
+from ddp_practice_tpu.serve.scheduler import Completion
+from ddp_practice_tpu.serve.slo import SLOConfig, SLOWatchdog, classify
+from ddp_practice_tpu.utils.metrics import MetricsRegistry
+from ddp_practice_tpu.utils.trace import TraceRecorder
+
+CFG = SLOConfig(
+    error_rate=0.1, fast_window_s=1.0, slow_window_s=5.0,
+    trip_burn=2.0, resolve_burn=1.0, min_events=3,
+)
+
+
+def _feed(wd, t0, n, status, spacing=0.01):
+    for i in range(n):
+        wd.observe_event(t=t0 + i * spacing, status=status)
+
+
+# --------------------------------------------------------------- config
+def test_config_from_json_string_path_and_dict(tmp_path):
+    want = SLOConfig(ttft_p99_s=0.5, error_rate=0.01)
+    assert SLOConfig.from_json('{"ttft_p99_s": 0.5, "error_rate": 0.01}') \
+        == want
+    p = tmp_path / "slo.json"
+    p.write_text(want.to_json())
+    assert SLOConfig.from_json(str(p)) == want
+    assert SLOConfig.from_json(json.loads(want.to_json())) == want
+    with pytest.raises(ValueError):
+        SLOConfig.from_json('{"nonsense_key": 1}')
+    with pytest.raises(ValueError):
+        SLOConfig.from_json("no-such-file.json")
+    with pytest.raises(ValueError):  # hysteresis band must be a band
+        SLOConfig(error_rate=0.1, trip_burn=1.0, resolve_burn=2.0)
+    with pytest.raises(ValueError):  # zero budget = infinite burn
+        SLOConfig(availability=1.0).objectives()
+    with pytest.raises(ValueError):
+        SLOConfig().objectives()  # nothing enabled
+
+
+def test_classify_judges_only_measured_latencies():
+    cfg = SLOConfig(ttft_p99_s=0.5, availability=0.9)
+    assert classify(cfg, status="length", ttft=0.6) == {
+        "ttft_p99": True, "availability": False,
+    }
+    # no TTFT measured (never produced a token): the latency objective
+    # abstains; the failure is availability's business alone
+    assert classify(cfg, status="shed", ttft=None) == {
+        "availability": True,
+    }
+
+
+# ----------------------------------------------------------- windowing
+def test_fast_window_trip():
+    reg = MetricsRegistry()
+    wd = SLOWatchdog(CFG, registry=reg)
+    _feed(wd, 0.0, 5, "error")  # 100% bad vs 10% budget: burn 10
+    assert not wd.active
+    wd.evaluate(0.1)
+    assert wd.active
+    assert [e for _, e, _ in wd.alert_log] == ["trip"]
+    assert reg.snapshot()["slo_alerts_total"] == 1
+    assert reg.snapshot()[
+        'slo_alert_active{objective=error_rate}'] == 1.0
+    # burn gauges track both windows
+    assert reg.snapshot()[
+        'slo_burn_rate{objective=error_rate,window=fast}'] == 10.0
+
+
+def test_min_events_gate_blocks_noise_trips():
+    wd = SLOWatchdog(CFG)
+    _feed(wd, 0.0, 2, "error")  # only 2 events < min_events=3
+    wd.evaluate(0.1)
+    assert not wd.active
+
+
+def test_slow_window_resolve():
+    wd = SLOWatchdog(CFG)
+    _feed(wd, 0.0, 5, "error")
+    wd.evaluate(0.1)
+    assert wd.active
+    # the burst leaves the fast window almost immediately, but the
+    # alert HOLDS until the slow window clears — resolve is slow by
+    # design (fast resolve + fast trip = flapping)
+    wd.evaluate(2.0)
+    assert wd.active
+    # dilute the slow window with good traffic: 5 bad / 50 total = 10%
+    # bad = budget exactly -> burn 1.0 <= resolve_burn -> resolve
+    _feed(wd, 2.0, 45, "eos")
+    wd.evaluate(2.6)
+    assert not wd.active
+    assert [e for _, e, _ in wd.alert_log] == ["trip", "resolve"]
+
+
+def test_no_flapping_in_the_hysteresis_band():
+    """Burn held between resolve_burn (1.0) and trip_burn (2.0) must
+    move NEITHER edge: an active alert stays active, a resolved one
+    stays resolved."""
+    wd = SLOWatchdog(CFG)
+    _feed(wd, 0.0, 10, "error")
+    wd.evaluate(0.2)
+    assert wd.active and len(wd.alert_log) == 1
+    # steady state at burn 1.5 (15% bad vs 10% budget), rebuilt inside
+    # every window: the alert must hold, not flap
+    t = 0.3
+    for _ in range(8):
+        _feed(wd, t, 3, "error", spacing=0.001)
+        _feed(wd, t + 0.01, 17, "eos", spacing=0.001)
+        t += 0.5
+        wd.evaluate(t)
+    assert wd.active
+    assert len(wd.alert_log) == 1  # no resolve, no re-trip
+    # now genuinely clear, resolve once, and band-burn again: the
+    # resolved state must also hold through the band
+    wd.evaluate(t + 6.0)  # every event aged out of the slow window
+    assert not wd.active and len(wd.alert_log) == 2
+    t += 6.0
+    for _ in range(4):
+        _feed(wd, t, 3, "error", spacing=0.001)
+        _feed(wd, t + 0.01, 17, "eos", spacing=0.001)
+        t += 0.5
+        wd.evaluate(t)
+    assert not wd.active  # burn 1.5 < trip_burn: no re-trip
+    assert len(wd.alert_log) == 2
+
+
+def test_latency_objective_burns_on_p99_violations():
+    cfg = SLOConfig(ttft_p99_s=0.5, fast_window_s=1.0, slow_window_s=5.0,
+                    trip_burn=2.0, resolve_burn=1.0, min_events=3)
+    wd = SLOWatchdog(cfg)
+    for i in range(10):  # every TTFT over target: burn 1/0.01 = 100
+        wd.observe_event(t=0.01 * i, status="length", ttft=0.8)
+    wd.evaluate(0.2)
+    assert wd.active
+    # exactly-at-budget traffic (1% over target) resolves once the
+    # storm ages out of the slow window
+    wd.evaluate(6.0)
+    assert not wd.active
+
+
+# ------------------------------------------------- chaos acceptance pin
+@pytest.mark.chaos
+def test_chaos_slo_telemetry_e2e(tmp_path):
+    """THE acceptance pin (ISSUE 5): a 2-replica fault-plan run with an
+    SLO config trips a burn-rate alert whose alert/resolve instants
+    appear in a validator-clean trace, and tools/check_slo.py renders
+    the violation from the streamed JSONL — the whole plane, live, on
+    FakeClock replicas."""
+    import jax
+    import jax.numpy as jnp
+
+    from ddp_practice_tpu.models import create_model
+    from ddp_practice_tpu.serve import (
+        EngineConfig,
+        FakeClock,
+        FaultPlan,
+        FaultSpec,
+        Request,
+        RouterConfig,
+        make_router,
+    )
+    from ddp_practice_tpu.utils.telemetry import TelemetryExporter
+    from tools.check_slo import load_events, slo_report
+    from tools.check_traces import parse_stream_text, validate
+
+    vocab = 32
+    model = create_model(
+        "lm_tiny", vocab_size=vocab, max_len=96, hidden_dim=64,
+        depth=2, num_heads=4, mlp_dim=128, pos_emb="rope",
+    )
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    cfg = EngineConfig(max_slots=2, max_len=96, prompt_buckets=(8,),
+                       temperature=0.0)
+    clock = FakeClock(step_s=0.01)
+    path = str(tmp_path / "chaos.jsonl")
+    reg = MetricsRegistry()
+    exporter = TelemetryExporter(path, registry=reg, clock=clock,
+                                 start=False)
+    tracer = TraceRecorder(clock=clock)
+    exporter.attach(tracer)
+    slo_cfg = SLOConfig(
+        error_rate=0.05, fast_window_s=0.3, slow_window_s=1.0,
+        trip_burn=2.0, resolve_burn=1.0, min_events=3,
+    )
+    watchdog = SLOWatchdog(slo_cfg, clock=clock, registry=reg,
+                           tracer=tracer, telemetry=exporter)
+    # replica 0 spews NaN logits across several ticks; with a zero
+    # retry budget each poisoned request terminates "error" — the SLO's
+    # bad events. trip_after is out of reach so the breaker never hides
+    # the errors by killing the replica.
+    plan = FaultPlan([
+        FaultSpec(kind="nan_logits", tick=t, replica=0, slot=t % 2)
+        for t in (2, 3, 4, 5)
+    ])
+    router = make_router(
+        model, params, 2, cfg, clock=clock, max_queue=64,
+        config=RouterConfig(max_retries=0, retry_jitter=0.0,
+                            trip_after=100),
+        fault_plan=plan, registry=reg, tracer=tracer,
+        slo=watchdog, telemetry=exporter,
+    )
+    router.warmup()
+    tracer.clear()
+    for rid in range(10):
+        router.submit(Request(rid=rid, prompt=[1 + rid % 7, 2],
+                              max_new_tokens=6))
+    router.run_until_idle()
+    statuses = {c.rid: c.status for c in router.completions}
+    assert sum(s == "error" for s in statuses.values()) >= 1
+    assert watchdog.active, "burn-rate alert must have tripped"
+    # drain the fleet past the slow window: the alert resolves
+    for _ in range(300):
+        router.step()
+        if not watchdog.active:
+            break
+    assert not watchdog.active
+    edges = [e for _, e, _ in watchdog.alert_log]
+    assert edges == ["trip", "resolve"]
+    exporter.close()
+
+    # the exit-time Chrome dump AND the streamed JSONL both validate,
+    # both carrying the alert edges
+    dump = tracer.to_chrome_trace()
+    assert validate(dump) == []
+    names = {ev["name"] for ev in dump["traceEvents"]}
+    assert {"slo_alert", "slo_resolve"} <= names
+    streamed, truncated, errors = parse_stream_text(open(path).read())
+    assert errors == [] and not truncated
+    assert validate(streamed) == []
+    snames = {ev["name"] for ev in streamed["traceEvents"]}
+    assert {"slo_alert", "slo_resolve"} <= snames
+
+    # and the offline tool renders the violation from the same stream
+    records, truncated = load_events(path)
+    assert not truncated
+    report = slo_report(records, slo_cfg)
+    assert not report["ok"]
+    assert not report["objectives"]["error_rate"]["met"]
+    assert report["trips"] == 1
+    # metrics snapshots streamed too (close() wrote at least one), and
+    # nothing was dropped on the way
+    kinds = {r["kind"] for r in records}
+    assert "metrics" in kinds and "flight" in kinds
+    assert exporter.dropped == 0
+
+
+def test_offline_verdict_skips_slo_exempt_flights():
+    """Online/offline agreement: the router's own brown-out sheds are
+    slo_exempt (anti-windup — the live watchdog never judges them), so
+    the offline verdict must skip them too; a GENUINE shed still
+    counts."""
+    from tools.check_slo import slo_report
+
+    cfg = SLOConfig(availability=0.95)
+    records = [
+        *[{"kind": "flight", "t": 0.01 * i, "status": "length"}
+          for i in range(9)],
+        {"kind": "flight", "t": 0.2, "status": "shed",
+         "slo_exempt": True},
+    ]
+    rep = slo_report(records, cfg)
+    assert rep["ok"] and rep["slo_exempt"] == 1 and rep["flights"] == 9
+    records.append({"kind": "flight", "t": 0.3, "status": "shed"})
+    rep = slo_report(records, cfg)
+    assert not rep["ok"]  # 9/10 judged = 0.9 < 0.95
+
+
+def test_alert_edges_reach_tracer_and_completions_feed():
+    clock = {"t": 0.0}
+    tracer = TraceRecorder(clock=lambda: clock["t"])
+    tracer.set_process_name(-1, "router")
+    wd = SLOWatchdog(CFG, tracer=tracer)
+    for i in range(5):
+        wd.observe(Completion(
+            rid=i, tokens=[], status="error", arrival=0.0,
+            finish=0.01 * i,
+        ))
+    wd.evaluate(0.1)
+    clock["t"] = 6.0
+    wd.evaluate(6.0)
+    names = [ev["name"] for ev in tracer.to_chrome_trace()["traceEvents"]]
+    assert "slo_alert" in names and "slo_resolve" in names

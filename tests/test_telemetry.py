@@ -1,0 +1,299 @@
+"""Live telemetry plane (utils/telemetry.py): streaming JSONL export,
+HTTP scrape endpoints, straggler detection, and the metrics satellites.
+
+The streaming contract under test is the one the exit-time trace dump
+cannot give: every line is written whole and flushed, so a run killed
+with SIGKILL still leaves a file that parses line by line (at worst one
+truncated tail line) — pinned with a real subprocess and a real SIGKILL.
+The HTTP side binds port 0 and is scraped through http.client, shutdown
+included. All host-pure: no jax, no engines, fast.
+"""
+
+import http.client
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from ddp_practice_tpu.serve.scheduler import Completion
+from ddp_practice_tpu.utils.metrics import (
+    Histogram,
+    MetricsRegistry,
+    default_registry,
+    labelled,
+    percentile_summary,
+    reset_label_guard,
+    set_label_limit,
+)
+from ddp_practice_tpu.utils.telemetry import (
+    FlightStats,
+    StepAnomalyDetector,
+    TelemetryExporter,
+    TelemetryServer,
+)
+from ddp_practice_tpu.utils.trace import TraceRecorder, label_replica
+from tools.check_traces import parse_stream_text, validate
+
+
+def _completion(rid=0, status="length", ttft=0.2, tpot=0.01,
+                queue_s=0.1, prefill_s=0.05, decode_s=0.3, stall_s=0.0):
+    return Completion(
+        rid=rid, tokens=[1, 2, 3], status=status, arrival=0.0,
+        finish=queue_s + prefill_s + decode_s + stall_s,
+        ttft=ttft, tpot=tpot,
+        flight={"queue_s": queue_s, "prefill_s": prefill_s,
+                "decode_s": decode_s, "stall_s": stall_s,
+                "retries": 0, "failovers": 0},
+    )
+
+
+# --------------------------------------------------------------- exporter
+def test_exporter_streams_jsonl_lines(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    reg = MetricsRegistry()
+    reg.counter("requests").inc(7)
+    exp = TelemetryExporter(path, registry=reg, clock=lambda: 2.5,
+                            start=False)
+    exp.emit("alert", event="trip", objective="error_rate")
+    exp.on_completion(_completion(rid=3))
+    exp.snapshot_now()
+    exp.close()
+    lines = [json.loads(ln) for ln in
+             open(path).read().strip().split("\n")]
+    kinds = [ln["kind"] for ln in lines]
+    assert kinds[0] == "alert" and lines[0]["t"] == 2.5
+    flight = lines[kinds.index("flight")]
+    assert flight["rid"] == 3 and flight["queue_s"] == 0.1
+    snap = lines[kinds.index("metrics")]
+    assert snap["snapshot"]["requests"] == 7
+    # close() writes a final snapshot + the drop count
+    assert kinds[-1] == "telemetry_close" and lines[-1]["dropped"] == 0
+
+
+def test_exporter_bounded_queue_drops_and_counts(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    reg = MetricsRegistry()
+    exp = TelemetryExporter(path, registry=reg, max_queue=2, start=False)
+    for i in range(5):  # no consumer running: 3 of 5 must drop
+        exp.emit("flight", rid=i)
+    assert exp.dropped == 3
+    assert reg.counter("telemetry_dropped_total").value == 3
+    exp.close()
+    lines = [json.loads(ln) for ln in
+             open(path).read().strip().split("\n")]
+    flights = [ln for ln in lines if ln["kind"] == "flight"]
+    assert [f["rid"] for f in flights] == [0, 1]  # oldest-first survive
+    assert lines[-1]["dropped"] == 3
+
+
+def test_exporter_background_thread_drains(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    exp = TelemetryExporter(path, snapshot_interval_s=0.0)  # start=True
+    for i in range(50):
+        exp.emit("flight", rid=i)
+    exp.close()
+    lines = [json.loads(ln) for ln in
+             open(path).read().strip().split("\n")]
+    assert sum(ln["kind"] == "flight" for ln in lines) == 50
+    assert exp.dropped == 0
+
+
+def test_trace_sink_stream_revalidates_as_chrome_trace(tmp_path):
+    """Streamed span/async/instant/meta lines re-assemble into a
+    validator-clean Chrome trace (tools/check_traces.py stream mode)."""
+    path = str(tmp_path / "t.jsonl")
+    exp = TelemetryExporter(path, start=False)
+    t = {"now": 0.0}
+    tr = TraceRecorder(clock=lambda: t["now"])
+    label_replica(tr, 0, 2)  # labelled BEFORE attach: must be replayed
+    exp.attach(tr)
+    tr.record_span("prefill", 0.1, 0.2, pid=0, tid=1, trace_id="r1",
+                   attrs={"bucket": 8})
+    tr.record_async("request", 0.0, 0.5, trace_id="r1", pid=0)
+    t["now"] = 0.3
+    tr.instant("shed", pid=0, tid=0, rid=9)
+    exp.close()
+    trace, truncated, errors = parse_stream_text(open(path).read())
+    assert not truncated and not errors
+    assert validate(trace) == []
+    by_ph = {}
+    for ev in trace["traceEvents"]:
+        by_ph.setdefault(ev["ph"], []).append(ev)
+    assert [e["name"] for e in by_ph["X"]] == ["prefill"]
+    assert by_ph["X"][0]["args"] == {"bucket": 8, "trace_id": "r1"}
+    assert {e["ph"] for e in by_ph["b"] + by_ph["e"]} == {"b", "e"}
+    assert by_ph["i"][0]["name"] == "shed"
+
+
+def test_sigkill_leaves_line_parseable_file(tmp_path):
+    """THE flush-on-crash pin: a writer process killed with SIGKILL
+    mid-stream leaves a telemetry file every line of which (except at
+    most a truncated tail) parses — the property the exit-time dump
+    fundamentally lacks."""
+    path = str(tmp_path / "killed.jsonl")
+    script = f"""
+import sys
+sys.path.insert(0, {os.getcwd()!r})
+from ddp_practice_tpu.utils.telemetry import TelemetryExporter
+exp = TelemetryExporter({path!r}, snapshot_interval_s=0.0)
+i = 0
+print("ready", flush=True)
+while True:
+    exp.emit("flight", rid=i, payload="x" * 256)
+    i += 1
+"""
+    proc = subprocess.Popen(
+        [sys.executable, "-c", script],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        cwd=os.getcwd(),
+    )
+    try:
+        assert proc.stdout.readline().strip() == b"ready"
+        # let it stream for a moment, then kill it un-gracefully
+        deadline = time.monotonic() + 5.0
+        while (not os.path.exists(path) or os.path.getsize(path) < 4096) \
+                and time.monotonic() < deadline:
+            time.sleep(0.02)
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=10)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    raw = open(path).read()
+    lines = raw.split("\n")
+    while lines and not lines[-1].strip():
+        lines.pop()
+    assert len(lines) >= 10, "writer never got going"
+    parsed = 0
+    for i, ln in enumerate(lines):
+        try:
+            rec = json.loads(ln)
+            assert rec["kind"] == "flight"
+            parsed += 1
+        except json.JSONDecodeError:
+            assert i == len(lines) - 1, \
+                f"non-tail line {i} corrupt — flush-per-line is broken"
+    assert parsed >= 10
+    # and the offline tool accepts the same file
+    trace, truncated, errors = parse_stream_text(raw)
+    assert errors == []
+
+
+# ------------------------------------------------------------- HTTP plane
+def test_http_endpoints_scrape_and_clean_shutdown():
+    reg = MetricsRegistry()
+    reg.counter("serve_tokens_total").inc(42)
+    reg.histogram("serve_ttft_s").observe(0.25)
+    flight = FlightStats()
+    flight.on_completion(_completion())
+    health = {"states": {0: "healthy", 1: "degraded"}}
+    srv = TelemetryServer(
+        registry=reg, health_fn=lambda: health["states"],
+        flight_fn=flight.report, port=0,
+    )
+    assert srv.port > 0  # ephemeral bind reported
+
+    def get(p):
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                          timeout=5)
+        conn.request("GET", p)
+        r = conn.getresponse()
+        body = r.read()
+        conn.close()
+        return r.status, body
+
+    status, body = get("/metrics")
+    text = body.decode()
+    assert status == 200
+    assert "serve_tokens_total 42" in text
+    assert 'serve_ttft_s{quantile="0.99"}' in text
+    assert text == reg.render_text()  # byte-stable exposition
+
+    status, body = get("/healthz")
+    payload = json.loads(body)
+    assert status == 200 and payload["status"] == "DEGRADED"
+    assert payload["replicas"] == {"0": "healthy", "1": "degraded"}
+
+    health["states"] = {0: "dead", 1: "dead"}
+    status, body = get("/healthz")
+    assert status == 503 and json.loads(body)["status"] == "DEAD"
+
+    status, body = get("/flight")
+    rep = json.loads(body)
+    assert status == 200 and rep["window"] == 1
+    assert rep["decode_s"]["p99"] == pytest.approx(0.3)
+
+    assert get("/nope")[0] == 404
+
+    srv.close()
+    with pytest.raises(OSError):
+        get("/metrics")  # nothing listening after close
+
+
+# ------------------------------------------------- straggler detection
+def test_step_anomaly_detector_flags_stragglers_only():
+    det = StepAnomalyDetector(window=32, threshold=5.0, min_samples=8)
+    flags = [det.observe(0.1 + 0.001 * (i % 3)) for i in range(16)]
+    assert not any(flags)
+    assert det.observe(0.5)        # 5x the median: straggler
+    assert not det.observe(0.02)   # FAST step is not an anomaly
+    assert det.anomalies == 1
+
+
+def test_step_anomaly_detector_survives_constant_history():
+    # FakeClock-flat history collapses MAD to 0; the relative floor
+    # must keep microscopic jitter from flagging
+    det = StepAnomalyDetector(min_samples=4)
+    for _ in range(8):
+        assert not det.observe(0.1)
+    assert not det.observe(0.1001)
+    assert det.observe(0.2)
+
+
+# --------------------------------------------------- metrics satellites
+def test_percentile_summary_is_the_histogram_math():
+    xs = [0.5, 0.1, 0.9, 0.3, 0.7]
+    s = percentile_summary(xs)
+    h = Histogram.of(xs)
+    assert s["p50"] == h.percentile(50)
+    assert s["p99"] == h.percentile(99)
+    assert s["mean"] == pytest.approx(h.mean)
+    assert percentile_summary([]) == {
+        "p50": 0.0, "p90": 0.0, "p99": 0.0, "mean": 0.0,
+    }
+
+
+@pytest.fixture
+def label_guard():
+    reset_label_guard()
+    old = set_label_limit(3)
+    yield
+    set_label_limit(old)
+    reset_label_guard()
+
+
+def test_labelled_cardinality_guard(label_guard):
+    ctr = default_registry().counter("metrics_label_overflow_total")
+    base = ctr.value
+    reg = MetricsRegistry()
+    for rid in range(10):  # an unbounded label (request ids)
+        reg.counter(labelled("sheds", reason=f"r{rid}")).inc()
+    snap = reg.snapshot()
+    named = [k for k in snap if k.startswith("sheds{")]
+    # 3 distinct values + the shared overflow bucket — not 10
+    assert len(named) == 4
+    assert snap["sheds{reason=other}"] == 7
+    assert ctr.value - base == 7
+    # repeat values keep hitting their established bucket
+    reg.counter(labelled("sheds", reason="r0")).inc()
+    assert reg.snapshot()["sheds{reason=r0}"] == 2
+
+
+def test_labelled_guard_does_not_touch_small_families(label_guard):
+    assert labelled("m", replica=0) == "m{replica=0}"
+    assert labelled("m", replica=1) == "m{replica=1}"
+    assert labelled("m", replica=0) == "m{replica=0}"  # re-seen: stable

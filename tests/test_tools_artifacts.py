@@ -1,0 +1,122 @@
+"""The offline tools exercised over CHECKED-IN bench artifacts, via
+their real CLIs (subprocess, exit codes asserted) — so tools/
+check_traces.py and tools/check_slo.py cannot silently rot while the
+modules they validate move on (ISSUE 5 CI satellite).
+
+The artifacts are a deterministic FakeClock 2-replica chaos run
+(nan_logits fault plan, SLO watchdog armed):
+
+- tests/data/bench_trace.json      — the exit-time Chrome dump
+- tests/data/bench_telemetry.jsonl — the STREAMED telemetry of the same
+  run (trace events, flight records, alert edges, metrics snapshots)
+
+Both forms must stay validator-clean; the JSONL must render an SLO
+verdict both ways (the chaos run violates a tight error-rate SLO and
+meets a loose one).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TRACE = os.path.join(ROOT, "tests", "data", "bench_trace.json")
+TELEMETRY = os.path.join(ROOT, "tests", "data", "bench_telemetry.jsonl")
+
+# the SLO the artifact run was recorded against (it violates this one)
+TIGHT_SLO = json.dumps({
+    "error_rate": 0.05, "fast_window_s": 0.3, "slow_window_s": 1.0,
+    "trip_burn": 2.0, "resolve_burn": 1.0, "min_events": 3,
+})
+LOOSE_SLO = json.dumps({"error_rate": 0.5})
+
+
+def _run(*argv):
+    return subprocess.run(
+        [sys.executable, *argv], capture_output=True, text=True,
+        cwd=ROOT, timeout=120,
+    )
+
+
+def test_check_traces_cli_accepts_both_artifact_forms():
+    r = _run("tools/check_traces.py", TRACE, TELEMETRY)
+    assert r.returncode == 0, r.stdout + r.stderr
+    # one OK verdict per file, and the stream form found real spans
+    assert r.stdout.count(": OK") == 2
+    assert "decode_burst" in r.stdout
+
+
+def test_check_traces_cli_exit_code_on_corruption(tmp_path):
+    # mid-file corruption is an error (only the TAIL may be truncated)
+    lines = open(TELEMETRY).read().strip().split("\n")
+    lines[2] = lines[2][: len(lines[2]) // 2]
+    bad = tmp_path / "corrupt.jsonl"
+    bad.write_text("\n".join(lines) + "\n")
+    r = _run("tools/check_traces.py", str(bad))
+    assert r.returncode == 1
+    assert "INVALID" in r.stdout
+    # a truncated FINAL line alone is tolerated (the SIGKILL signature)
+    tail_cut = tmp_path / "tail.jsonl"
+    tail_cut.write_text("\n".join(lines[:2]) + "\n" + lines[3][:20])
+    r = _run("tools/check_traces.py", str(tail_cut))
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "crash-truncated" in r.stdout
+    # a Chrome dump truncated mid-save is ONE broken line: it must not
+    # slip through as an "empty but OK" stream — and nor may an empty
+    # file
+    cut_dump = tmp_path / "cut_dump.json"
+    cut_dump.write_text(open(TRACE).read()[:200])
+    assert _run("tools/check_traces.py", str(cut_dump)).returncode == 1
+    empty = tmp_path / "empty.json"
+    empty.write_text("")
+    assert _run("tools/check_traces.py", str(empty)).returncode == 1
+
+
+def test_check_slo_cli_renders_violation_and_pass():
+    r = _run("tools/check_slo.py", "--slo", TIGHT_SLO, TELEMETRY)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "SLO VIOLATED" in r.stdout
+    assert "error_rate" in r.stdout and "VIOLATED" in r.stdout
+    assert "trip" in r.stdout  # the recorded alert timeline is shown
+    r = _run("tools/check_slo.py", "--slo", LOOSE_SLO, TELEMETRY)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "OK" in r.stdout
+
+
+def test_check_slo_cli_json_mode_and_bad_inputs(tmp_path):
+    r = _run("tools/check_slo.py", "--slo", TIGHT_SLO, "--json", TELEMETRY)
+    assert r.returncode == 1
+    report = json.loads(r.stdout)[TELEMETRY]
+    assert report["ok"] is False and report["trips"] == 1
+    assert report["objectives"]["error_rate"]["measured"] > 0.05
+    # unreadable input and a bad --slo are distinguishable from a
+    # violation (exit 2, not 1)
+    assert _run("tools/check_slo.py", "--slo", TIGHT_SLO,
+                str(tmp_path / "missing.jsonl")).returncode == 2
+    assert _run("tools/check_slo.py", "--slo", "{not json",
+                TELEMETRY).returncode == 2
+
+
+def test_artifacts_validate_as_library_too():
+    """Belt to the CLI suspenders: the library entry points the tests
+    and the serve bench use agree with the CLIs."""
+    from tools.check_slo import load_events, slo_report
+    from tools.check_traces import parse_stream_text, validate
+
+    trace = json.load(open(TRACE))
+    assert validate(trace) == []
+    streamed, truncated, errors = parse_stream_text(open(TELEMETRY).read())
+    assert errors == [] and not truncated
+    assert validate(streamed) == []
+    names = {ev["name"] for ev in streamed["traceEvents"]}
+    assert {"slo_alert", "slo_resolve", "prefill", "decode_burst"} <= names
+
+    from ddp_practice_tpu.serve.slo import SLOConfig
+
+    records, _ = load_events(TELEMETRY)
+    report = slo_report(records, SLOConfig.from_json(TIGHT_SLO))
+    assert not report["ok"] and report["trips"] == 1
+    assert {r["kind"] for r in records} >= {
+        "flight", "metrics", "alert", "span", "meta",
+    }

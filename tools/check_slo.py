@@ -1,0 +1,206 @@
+"""Offline SLO report/verdict over a streamed telemetry JSONL.
+
+The live watchdog (serve/slo.py) answers "are we burning budget RIGHT
+NOW"; this tool answers the post-hoc question over a whole run's
+telemetry file (utils/telemetry.py TelemetryExporter): did the run meet
+its SLOs, and what did the alerting actually do? Used two ways:
+
+- as a library from tests: ``load_events`` + ``slo_report`` (the
+  tier-1 artifact test runs it over the checked-in bench telemetry);
+- as a CLI over bench artifacts::
+
+      python tools/check_slo.py --slo '{"ttft_p99_s": 0.5}' run.jsonl
+      python tools/check_slo.py --slo slo.json *.jsonl
+
+  exit 0 = every objective met, 1 = at least one violated, 2 = input
+  unreadable. The report prints measured vs target per objective plus
+  the alert trip/resolve timeline the run recorded.
+
+Config, status semantics (OK_STATUSES), and percentile math are SHARED
+with the live plane (serve/slo.py SLOConfig,
+utils/metrics.percentile_summary), so offline verdicts and online
+alerts can never disagree about what a target or a p99 means. Input is
+the line-by-line telemetry stream; a crash-truncated final line is
+tolerated (that is the streaming format's whole point).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional, Tuple
+
+# runnable as `python tools/check_slo.py` from the repo root: the
+# package is imported from the working tree, not an installed dist
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ddp_practice_tpu.serve.slo import OK_STATUSES, SLOConfig  # noqa: E402
+from ddp_practice_tpu.utils.metrics import percentile_summary  # noqa: E402
+from tools.check_traces import iter_stream_records  # noqa: E402
+
+
+def load_events(path: str) -> Tuple[List[dict], bool]:
+    """Parse a telemetry JSONL -> (records, truncated_tail).
+
+    Shares the tail-tolerant parsing rule with tools/check_traces.py
+    (iter_stream_records): only the FINAL line may fail to parse
+    (SIGKILL mid-write); garbage anywhere else raises ValueError — it
+    means the writer is broken, not that the run died.
+    """
+    with open(path) as f:
+        text = f.read()
+    records, truncated, errors = iter_stream_records(text)
+    if errors:
+        raise ValueError(f"{path}: {errors[0]}")
+    return records, truncated
+
+
+def slo_report(records: List[dict], config: SLOConfig) -> dict:
+    """Evaluate each configured objective over the run's flight records.
+
+    Per objective: the measured value, the target, and met/violated.
+    A latency objective is met when its p99 over the whole run is at or
+    under the target (the offline equivalent of "burn stayed <= 1");
+    rate objectives compare the run's bad fraction to its budget.
+    Alert lines (kind="alert") and slo_alert/slo_resolve instants are
+    surfaced as the timeline, so a verdict can be cross-checked against
+    what the live watchdog actually fired.
+    """
+    all_flights = [r for r in records if r.get("kind") == "flight"]
+    # slo_exempt flights are the router's OWN brown-out sheds — the
+    # live watchdog deliberately never judged them (anti-windup), so
+    # the offline verdict must not either, or the two would disagree
+    # about the same run
+    flights = [r for r in all_flights if not r.get("slo_exempt")]
+    ttft = [r["ttft"] for r in flights if r.get("ttft") is not None]
+    tpot = [r["tpot"] for r in flights if r.get("tpot") is not None]
+    statuses = [r.get("status", "") for r in flights]
+    n = len(flights)
+
+    objectives: dict = {}
+
+    def add(name, measured, target, met, **extra):
+        objectives[name] = {
+            "measured": measured, "target": target,
+            "met": bool(met), **extra,
+        }
+
+    if config.ttft_p99_s is not None:
+        p99 = percentile_summary(ttft, (99,))["p99"]
+        add("ttft_p99", p99, config.ttft_p99_s,
+            bool(ttft) and p99 <= config.ttft_p99_s, samples=len(ttft))
+    if config.tpot_p99_s is not None:
+        p99 = percentile_summary(tpot, (99,))["p99"]
+        add("tpot_p99", p99, config.tpot_p99_s,
+            bool(tpot) and p99 <= config.tpot_p99_s, samples=len(tpot))
+    if config.error_rate is not None:
+        bad = sum(s == "error" for s in statuses)
+        rate = bad / n if n else 0.0
+        add("error_rate", rate, config.error_rate,
+            n > 0 and rate <= config.error_rate, bad=bad, total=n)
+    if config.availability is not None:
+        ok = sum(s in OK_STATUSES for s in statuses)
+        avail = ok / n if n else 0.0
+        add("availability", avail, config.availability,
+            n > 0 and avail >= config.availability, ok=ok, total=n)
+    if not objectives:
+        raise ValueError("SLO config enables no objective")
+
+    alerts = [
+        {"t": r.get("t"), "event": r["event"],
+         "objective": r.get("objective")}
+        for r in records if r.get("kind") == "alert"
+    ]
+    if not alerts:
+        # no watchdog telemetry handle on this run: the same edges may
+        # still be present as streamed tracer instants — use those
+        # (never both, or every edge would count twice)
+        for r in records:
+            if r.get("kind") == "instant" and r.get("name") in (
+                    "slo_alert", "slo_resolve"):
+                alerts.append({
+                    "t": r.get("t"),
+                    "event": ("trip" if r["name"] == "slo_alert"
+                              else "resolve"),
+                    "objective": (r.get("attrs") or {}).get("objective"),
+                })
+    alerts.sort(key=lambda a: (a["t"] is None, a["t"]))
+
+    return {
+        "flights": n,
+        "slo_exempt": len(all_flights) - n,
+        "objectives": objectives,
+        "ok": all(o["met"] for o in objectives.values()),
+        "alerts": alerts,
+        "trips": sum(a["event"] == "trip" for a in alerts),
+    }
+
+
+def render(path: str, report: dict, truncated: bool) -> str:
+    lines = [f"{path}: {'OK' if report['ok'] else 'SLO VIOLATED'} — "
+             f"{report['flights']} flight records"
+             + (f" (+{report['slo_exempt']} slo-exempt brown-out sheds,"
+                " not judged)" if report["slo_exempt"] else "")
+             + (" (crash-truncated tail line skipped)" if truncated
+                else "")]
+    for name, o in report["objectives"].items():
+        verdict = "met" if o["met"] else "VIOLATED"
+        lines.append(
+            f"  {name:>12}: measured {o['measured']:.6g} vs "
+            f"target {o['target']:.6g} — {verdict}"
+        )
+    if report["alerts"]:
+        lines.append(f"  alerts: {report['trips']} trip(s)")
+        for a in report["alerts"]:
+            t = f"{a['t']:.3f}" if a["t"] is not None else "?"
+            lines.append(f"    t={t} {a['event']} {a['objective']}")
+    else:
+        lines.append("  alerts: none recorded")
+    return "\n".join(lines)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        "check_slo", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    p.add_argument("--slo", required=True, metavar="JSON|PATH",
+                   help="SLO config: a JSON object literal or a path "
+                        "to a JSON file (serve/slo.py SLOConfig keys)")
+    p.add_argument("--json", action="store_true",
+                   help="print the report(s) as one JSON object")
+    p.add_argument("files", nargs="+", metavar="TELEMETRY_JSONL")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        config = SLOConfig.from_json(args.slo)
+    except (ValueError, TypeError, json.JSONDecodeError) as e:
+        print(f"bad --slo: {e}", file=sys.stderr)
+        return 2
+    rc = 0
+    reports = {}
+    for path in args.files:
+        try:
+            records, truncated = load_events(path)
+            report = slo_report(records, config)
+        except (OSError, ValueError) as e:
+            print(f"{path}: UNREADABLE — {e}", file=sys.stderr)
+            rc = 2
+            continue
+        reports[path] = report
+        if not args.json:
+            print(render(path, report, truncated))
+        if not report["ok"] and rc == 0:
+            rc = 1
+    if args.json:
+        print(json.dumps(reports))
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

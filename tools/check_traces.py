@@ -15,6 +15,17 @@ schema a checkable contract, used two ways:
 
   prints a per-file verdict + span summary, exit 1 on any error.
 
+Two input forms, auto-detected per file:
+
+- the single-JSON Chrome dump `TraceRecorder.save()` writes at exit;
+- a STREAMED telemetry JSONL (utils/telemetry.py TelemetryExporter):
+  one kind-tagged event per line. `parse_stream_text` re-assembles the
+  trace-shaped lines (meta/span/async/instant; flight/metrics/alert
+  lines are telemetry, not trace, and are skipped) into a Chrome trace
+  — spans become complete "X" events, so streaming needs no B/E
+  matching — and tolerates EXACTLY ONE truncated line at EOF (the line
+  a SIGKILL cut mid-write); garbage anywhere else is an error.
+
 Checks (each one a real corruption mode of the exporter):
 
 - top level is ``{"traceEvents": [...]}``; every event has name/ph/pid/
@@ -153,13 +164,129 @@ def validate(trace) -> List[str]:
     return errors
 
 
+def chrome_from_stream(records) -> dict:
+    """Assemble streamed telemetry records into a Chrome trace object.
+
+    Lane spans arrive COMPLETE (the recorder streams at span end), so
+    they export as ph "X" (ts + dur) — no B/E pairing to get wrong;
+    async spans become adjacent b/e pairs keyed by trace_id; instants
+    and lane-label metadata map 1:1. Non-trace kinds (flight, metrics,
+    alert, telemetry_close) are skipped: they ride the same JSONL but
+    belong to tools/check_slo.py.
+    """
+    events = []
+
+    def us(t):
+        return round(float(t) * 1e6, 3)
+
+    def args_of(r):
+        args = dict(r.get("attrs") or {})
+        if r.get("trace_id") is not None:
+            args["trace_id"] = r["trace_id"]
+        return args
+
+    for r in records:
+        kind = r.get("kind")
+        if kind == "meta":
+            if r.get("meta") == "process_name":
+                events.append({
+                    "name": "process_name", "ph": "M",
+                    "pid": r["pid"], "tid": 0,
+                    "args": {"name": r["name"]},
+                })
+            else:
+                events.append({
+                    "name": "thread_name", "ph": "M",
+                    "pid": r["pid"], "tid": r["tid"],
+                    "args": {"name": r["name"]},
+                })
+        elif kind == "span":
+            ev = {"name": r["name"], "ph": "X", "ts": us(r["t0"]),
+                  "dur": round((r["t1"] - r["t0"]) * 1e6, 3),
+                  "pid": r["pid"], "tid": r["tid"]}
+            args = args_of(r)
+            if args:
+                ev["args"] = args
+            events.append(ev)
+        elif kind == "async":
+            base = {"name": r["name"], "cat": "request",
+                    "id": r["trace_id"], "pid": r["pid"], "tid": 0}
+            b = dict(base, ph="b", ts=us(r["t0"]))
+            args = args_of(r)
+            if args:
+                b["args"] = args
+            events.append(b)
+            events.append(dict(base, ph="e", ts=us(r["t1"])))
+        elif kind == "instant":
+            ev = {"name": r["name"], "ph": "i", "s": "t",
+                  "ts": us(r["t"]), "pid": r["pid"],
+                  "tid": r.get("tid", 0)}
+            args = args_of(r)
+            if args:
+                ev["args"] = args
+            events.append(ev)
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def iter_stream_records(text: str):
+    """Tail-tolerant telemetry JSONL loader -> (records, truncated,
+    errors).
+
+    THE parsing rule of the streaming format, shared with
+    tools/check_slo.py: `truncated` is True when the LAST line failed
+    to parse — the signature of a run killed mid-write, tolerated by
+    design. An unparseable line anywhere ELSE lands in `errors`: the
+    line-by-line format means a crash can only ever damage the tail.
+    A file whose ONLY line is the truncated one yields no records and
+    an error — that is a corrupt single-JSON artifact, not a stream.
+    """
+    errors = []
+    records = []
+    truncated = False
+    lines = text.split("\n")
+    # drop trailing empty strings from the final newline
+    while lines and not lines[-1].strip():
+        lines.pop()
+    for i, ln in enumerate(lines):
+        ln = ln.strip()
+        if not ln:
+            continue
+        try:
+            rec = json.loads(ln)
+        except json.JSONDecodeError:
+            if i == len(lines) - 1:
+                truncated = True
+            else:
+                errors.append(f"line {i + 1}: unparseable JSONL (only "
+                              "the final line may be crash-truncated)")
+            continue
+        if not isinstance(rec, dict) or "kind" not in rec:
+            errors.append(f"line {i + 1}: not a kind-tagged object")
+            continue
+        records.append(rec)
+    if truncated and not records:
+        errors.append(
+            "no parseable line at all — a truncated single-JSON dump, "
+            "not a telemetry stream"
+        )
+    elif not records and not errors:
+        errors.append("empty file — neither a trace dump nor a stream")
+    return records, truncated, errors
+
+
+def parse_stream_text(text: str):
+    """Parse telemetry JSONL -> (chrome_trace, truncated_tail, errors)."""
+    records, truncated, errors = iter_stream_records(text)
+    return chrome_from_stream(records), truncated, errors
+
+
 def summarize(trace) -> dict:
     """Counts for the CLI report: events by phase, spans by name."""
     events = trace.get("traceEvents", [])
     by_ph = Counter(ev.get("ph") for ev in events if isinstance(ev, dict))
     spans = Counter(
         ev.get("name") for ev in events
-        if isinstance(ev, dict) and ev.get("ph") in ("B", "b")
+        if isinstance(ev, dict) and ev.get("ph") in ("B", "b", "X")
     )
     pids = sorted({
         ev.get("pid") for ev in events
@@ -178,12 +305,31 @@ def main(argv=None) -> int:
     for path in args:
         try:
             with open(path) as f:
-                trace = json.load(f)
-        except (OSError, json.JSONDecodeError) as e:
+                text = f.read()
+        except OSError as e:
             print(f"{path}: UNREADABLE — {e}")
             rc = 1
             continue
-        errors = validate(trace)
+        # auto-detect: a Chrome dump is ONE JSON object; anything that
+        # doesn't parse whole is treated as streamed JSONL
+        trace = None
+        note = ""
+        if text.lstrip().startswith("{"):
+            try:
+                parsed = json.loads(text)
+                # a one-line JSONL file also parses whole — only a
+                # traceEvents object is actually the dump form
+                if isinstance(parsed, dict) and "traceEvents" in parsed:
+                    trace = parsed
+            except json.JSONDecodeError:
+                trace = None
+        if trace is None:
+            trace, truncated, errors = parse_stream_text(text)
+            if truncated:
+                note = " (crash-truncated tail line skipped)"
+        else:
+            errors = []
+        errors += validate(trace)
         s = summarize(trace)
         if errors:
             rc = 1
@@ -197,7 +343,7 @@ def main(argv=None) -> int:
             top = sorted(s["spans"].items(), key=lambda kv: -kv[1])[:8]
             spans = ", ".join(f"{n} x{c}" for n, c in top) or "none"
             print(f"{path}: OK — {s['events']} events, "
-                  f"pids {s['pids']}, spans: {spans}")
+                  f"pids {s['pids']}, spans: {spans}{note}")
     return rc
 
 
