@@ -96,7 +96,12 @@ def decode_apply(
     (serve/kv_pages.py): `cache` holds block pools instead of per-row
     buffers, each sequence writes/attends at its own slot-local position
     (kv_lengths), and there is no shared cursor — `attn_start` then masks
-    in slot-local coordinates.
+    in slot-local coordinates. `tokens` with s > 1 is a paged PREFILL:
+    the s tokens land at positions kv_lengths[b] + [0, s), attending any
+    already-resident prefix through the table (the prefix-cache
+    admission path, serve/engine.py PagedEngine._prefix_prefill). An
+    int8-cache model pools per-block scale pages alongside
+    (models/vit.py).
     """
     variables = {"params": params, "cache": cache}
     if batch_stats is not None:

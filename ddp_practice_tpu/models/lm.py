@@ -124,7 +124,10 @@ class TransformerLM(nn.Module):
         collection holds a pool of fixed-size blocks, each sequence
         writes/attends at its OWN slot-local position through its page
         table row, and attn_start/positions are slot-local. Requires
-        pos_emb="rope" (per-slot offsets) and s == 1.
+        pos_emb="rope" (per-slot offsets). s == 1 is the decode step;
+        s > 1 is the paged PREFILL (prefix-cache admissions append a
+        prompt suffix at kv_lengths, attending the shared prefix blocks
+        through the table — models/vit.py `_paged_decode`).
         """
         if page_table is not None and self.pos_emb != "rope":
             raise ValueError(

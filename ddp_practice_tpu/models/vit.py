@@ -321,21 +321,38 @@ class SelfAttention(nn.Module):
         return self._out_proj(out)
 
     def _paged_decode(self, q, k, v, page_table, kv_lengths, attn_start):
-        """Paged KV-cache decode step (serve/kv_pages.py layout).
+        """Paged KV-cache decode step / prefill (serve/kv_pages.py).
 
         The "cache" collection leaves are a POOL of fixed-size blocks
         (num_blocks, block_size, h*hd) shared by every slot; `page_table`
         (b, max_blocks_per_slot) int32 maps each slot's block list and
         `kv_lengths` (b,) int32 is each slot's write position — slot-LOCAL
         coordinates starting at 0, so RoPE rotates each slot at its own
-        offset and there is no shared cursor to run out. The incoming
-        token's K/V scatters into pool block
-        `page_table[b, pos // block_size]` row `pos % block_size`;
+        offset and there is no shared cursor to run out.
+
+        s == 1 (decode step): the incoming token's K/V scatters into pool
+        block `page_table[b, pos // block_size]` row `pos % block_size`;
         attention gathers through the same table
         (ops/decode_attention.paged_decode_attention) and masks
         [attn_start[b], pos[b]] in slot-local positions.
+
+        s > 1 (paged PREFILL, PR 6): the s tokens occupy positions
+        `kv_lengths[b] + [0, s)` — the prefix-cache admission path, where
+        a prompt whose first `kv_lengths` positions are already resident
+        (shared radix-cache blocks) prefills only its SUFFIX, attending
+        the cached prefix through the page table. Writes scatter per
+        position; attention gathers the slot's span once and masks
+        causally per query row (amortized over the whole admission, the
+        same trade the flat prefill makes).
+
+        kv_cache_dtype="int8" composes (PR 6): the pool carries
+        per-block (num_blocks, h, block_size) fp32 scale pages
+        (`cached_key_scale`/`cached_value_scale`, make_paged_cache) and
+        the quantized kernel walks them through the same page table.
         """
+        from ddp_practice_tpu.ops.attention import attention_with_mask
         from ddp_practice_tpu.ops.decode_attention import (
+            gather_pages,
             paged_decode_attention,
         )
 
@@ -348,26 +365,17 @@ class SelfAttention(nn.Module):
                 "paged decode needs rope=True — slot-local positions "
                 "require relative position encoding"
             )
-        if self.kv_cache_dtype == "int8":
-            raise ValueError(
-                "paged KV cache does not compose with kv_cache_dtype="
-                "'int8' yet (the scales would need their own page pool)"
-            )
         b_, s_, h_, hd_ = k.shape
-        if s_ != 1:
-            raise ValueError(
-                f"paged decode is single-token (got s={s_}); prompt "
-                "prefill runs through a contiguous scratch cache that "
-                "serve/kv_pages.py scatters into blocks"
-            )
         if self.is_initializing():
             raise ValueError(
                 "paged cache pools are allocated by serve/kv_pages.py "
                 "make_paged_cache, not by model.init"
             )
+        quant = self.kv_cache_dtype == "int8"
         cache_dtype = (
-            self.kv_cache_dtype if self.kv_cache_dtype is not None
-            else k.dtype
+            jnp.int8 if quant
+            else (self.kv_cache_dtype if self.kv_cache_dtype is not None
+                  else k.dtype)
         )
         cached_key = self.variable(
             "cache", "cached_key", jnp.zeros, (b_, s_, h_ * hd_), cache_dtype
@@ -376,6 +384,16 @@ class SelfAttention(nn.Module):
             "cache", "cached_value", jnp.zeros, (b_, s_, h_ * hd_),
             cache_dtype,
         )
+        key_scale = value_scale = None
+        if quant:
+            key_scale = self.variable(
+                "cache", "cached_key_scale", jnp.zeros,
+                (b_, h_, s_), jnp.float32,
+            )
+            value_scale = self.variable(
+                "cache", "cached_value_scale", jnp.zeros,
+                (b_, h_, s_), jnp.float32,
+            )
         # declared for tree parity with the flat cache (make_paged_cache
         # mirrors make_cache's structure); a block pool has no global
         # clock, so the scalar stays untouched
@@ -384,28 +402,71 @@ class SelfAttention(nn.Module):
         )
         block_size = cached_key.value.shape[1]
         pool_dtype = cached_key.value.dtype
-        pos = jnp.asarray(kv_lengths, jnp.int32)
-        q = apply_rope(q, pos[:, None])   # (b, 1): per-slot offsets
-        k = apply_rope(k, pos[:, None])
+        pos0 = jnp.asarray(kv_lengths, jnp.int32)
+        # (b, s) slot-local positions of the incoming tokens
+        positions = pos0[:, None] + jnp.arange(s_, dtype=jnp.int32)[None, :]
+        q = apply_rope(q, positions)
+        k = apply_rope(k, positions)
+        if quant:
+            def _quantize(x4):
+                # per-(batch, token, head) symmetric int8, same recipe
+                # as the flat int8 cache above
+                amax = jnp.max(jnp.abs(x4.astype(jnp.float32)), axis=-1)
+                scale = jnp.maximum(amax, 1e-8) / 127.0    # (b, s, h)
+                xq = jnp.round(
+                    x4.astype(jnp.float32) / scale[..., None]
+                ).astype(jnp.int8)
+                return xq, scale
+
+            k_store, ks_new = _quantize(k)
+            v_store, vs_new = _quantize(v)
+        else:
+            k_store, v_store = k, v
         # clamp keeps a retired slot (page row 0, length pinned) writing
         # inside the table; active slots never reach the clamp — the
         # engine pre-allocates blocks for every position it dispatches
-        blk_col = jnp.minimum(pos // block_size, page_table.shape[1] - 1)
-        blk = jnp.take_along_axis(page_table, blk_col[:, None], axis=1)[:, 0]
-        off = pos % block_size
+        blk_col = jnp.minimum(positions // block_size,
+                              page_table.shape[1] - 1)
+        blk = jnp.take_along_axis(page_table, blk_col, axis=1)  # (b, s)
+        off = positions % block_size
         kc = cached_key.value.at[blk, off].set(
-            k.reshape(b_, -1).astype(pool_dtype)
+            k_store.reshape(b_, s_, -1).astype(pool_dtype)
         )
         vc = cached_value.value.at[blk, off].set(
-            v.reshape(b_, -1).astype(pool_dtype)
+            v_store.reshape(b_, s_, -1).astype(pool_dtype)
         )
         cached_key.value = kc
         cached_value.value = vc
-        out = paged_decode_attention(
-            q.reshape(b_, 1, -1), kc, vc, page_table, pos, attn_start,
-            n_heads=h_,
+        ks_pool = vs_pool = None
+        if quant:
+            # scale pages: advanced indices (b, s) on axes 0/2 straddle
+            # the head slice, so the indexed result is (b, s, h) — set
+            # with the per-(batch, token, head) scales directly
+            ks_pool = key_scale.value.at[blk, :, off].set(ks_new)
+            vs_pool = value_scale.value.at[blk, :, off].set(vs_new)
+            key_scale.value = ks_pool
+            value_scale.value = vs_pool
+        if s_ == 1:
+            out = paged_decode_attention(
+                q.reshape(b_, 1, -1), kc, vc, page_table, pos0, attn_start,
+                n_heads=h_, k_scale=ks_pool, v_scale=vs_pool,
+            )
+            return out.reshape(b_, 1, h_, hd_)
+        # paged prefill: gather the slot's span once (dequantizing int8
+        # pools through their scale pages) and mask causally per query
+        # row in slot-local coordinates
+        k4 = gather_pages(kc, page_table, h_, ks_pool)
+        v4 = gather_pages(vc, page_table, h_, vs_pool)
+        span = k4.shape[1]
+        kpos = jnp.arange(span, dtype=jnp.int32)
+        valid = kpos[None, None, :] <= positions[:, :, None]  # (b, s, span)
+        if attn_start is not None:
+            valid &= kpos[None, None, :] >= attn_start[:, None, None]
+        cd = pool_dtype if not quant else q.dtype
+        out = attention_with_mask(
+            q.astype(cd), k4.astype(cd), v4.astype(cd), valid[:, None]
         )
-        return out.reshape(b_, 1, h_, hd_)
+        return out.reshape(b_, s_, h_, hd_).astype(q.dtype)
 
     def _out_proj(self, out):
         """Shared output projection over (b, s, h, hd) attention output —

@@ -395,6 +395,29 @@ def _paged_kernel(
     )
 
 
+def gather_pages(pages: jnp.ndarray, page_table: jnp.ndarray,
+                 n_heads: int, k_scale=None):
+    """Materialize each slot's pages as one contiguous span:
+    (num_blocks, block_size, h*hd) pool + (b, mb) table ->
+    (b, mb*block_size, h, d). With `k_scale` ((num_blocks, h,
+    block_size) fp32 — the int8 pool's per-block scale pages) the span
+    is dequantized per (position, head) on the way out. Shared by the
+    reference attention below and the model's paged PREFILL path
+    (models/vit.py `_paged_decode` s > 1)."""
+    b = page_table.shape[0]
+    bs, hh = pages.shape[1], pages.shape[2]
+    d = hh // n_heads
+    mb = page_table.shape[1]
+    span = mb * bs
+    k = jnp.take(pages, page_table, axis=0).reshape(b, span, n_heads, d)
+    if k_scale is not None:
+        # (b, mb, h, bs) -> per-position (b, span, h)
+        sc = jnp.take(k_scale, page_table, axis=0)
+        sc = jnp.swapaxes(sc, 2, 3).reshape(b, span, n_heads)
+        k = k.astype(jnp.float32) * sc[..., None]
+    return k
+
+
 def paged_attention_reference(
     q: jnp.ndarray,           # (b, 1, h*hd)
     k_pages: jnp.ndarray,     # (num_blocks, block_size, h*hd) pool
@@ -405,6 +428,8 @@ def paged_attention_reference(
     attn_start=None,          # optional (b,) int32 slot-local first key
     *,
     n_heads: int,
+    k_scale=None,             # (num_blocks, h, block_size) f32 — int8
+    v_scale=None,             # pool per-block dequant scale pages
 ) -> jnp.ndarray:
     """XLA gather path: materialize each slot's pages as a contiguous
     (b, max_blocks_per_slot * block_size) span and run masked attention.
@@ -412,27 +437,81 @@ def paged_attention_reference(
     The span is the PER-SLOT capacity (sized to the request's own
     context budget), not the pool — the slot engine's cost driver was
     the pool-global [0, max_len) scan, which this path already removes.
-    It is also the correctness oracle for `_paged_kernel` and the
-    serving path on backends without the kernel (CPU tests; unpackable
-    head shapes)."""
+    It is also the correctness oracle for `_paged_kernel` (and its int8
+    variant) and the serving path on backends without the kernel (CPU
+    tests; unpackable head shapes). An int8 pool dequantizes through
+    its scale pages during the gather."""
     from ddp_practice_tpu.ops.attention import attention_with_mask
 
     b = q.shape[0]
-    bs, hh = k_pages.shape[1], k_pages.shape[2]
+    hh = k_pages.shape[2]
     d = hh // n_heads
-    mb = page_table.shape[1]
-    span = mb * bs
-    k = jnp.take(k_pages, page_table, axis=0).reshape(b, span, n_heads, d)
-    v = jnp.take(v_pages, page_table, axis=0).reshape(b, span, n_heads, d)
+    span = page_table.shape[1] * k_pages.shape[1]
+    k = gather_pages(k_pages, page_table, n_heads, k_scale)
+    v = gather_pages(v_pages, page_table, n_heads, v_scale)
     pos = jnp.arange(span, dtype=jnp.int32)[None, :]
     valid = pos <= lengths[:, None]
     if attn_start is not None:
         valid &= pos >= attn_start[:, None]
+    cd = k_pages.dtype if k_scale is None else jnp.float32
     out = attention_with_mask(
-        q.reshape(b, 1, n_heads, d).astype(k.dtype),
-        k, v, valid[:, None, None, :],
+        q.reshape(b, 1, n_heads, d).astype(cd),
+        k.astype(cd), v.astype(cd), valid[:, None, None, :],
     )
     return out.reshape(b, 1, hh).astype(q.dtype)
+
+
+def _paged_kernel_quant(
+    len_ref, start_ref, pt_ref,              # scalar prefetch (SMEM)
+    q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref,
+    m_scr, l_scr, acc_scr,
+    *, sm_scale, block_size, n_heads, d, has_start, compute_dtype,
+):
+    """`_paged_kernel` over an INT8 block pool with per-block scale
+    pages: the (h, block_size) scale tiles ride the SAME page-table
+    index map as the K/V tiles they dequantize, the K scale multiplies
+    the score row after the q.k dot and the V scale folds into the
+    probability row before the p.v dot (`_softmax_accumulate(vs_row=)`) —
+    no dequantized tile ever materializes, so HBM still streams
+    1 byte/element for the cache walk."""
+    b_idx = pl.program_id(0)
+    j = pl.program_id(1)
+    cur = len_ref[b_idx]
+    n_j = pl.num_programs(1)
+    cd = compute_dtype
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full(m_scr.shape, -jnp.inf, jnp.float32)
+        l_scr[:] = jnp.zeros(l_scr.shape, jnp.float32)
+        acc_scr[:] = jnp.zeros(acc_scr.shape, jnp.float32)
+
+    @pl.when(j * block_size <= cur)
+    def _compute():
+        k_pos = j * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, (8, block_size), 1
+        )
+        valid = k_pos <= cur
+        if has_start:
+            valid &= k_pos >= start_ref[b_idx]
+        penalty = jnp.where(valid, 0.0, _NEG_INF)
+        for hh in range(n_heads):
+            lo, hi = hh * d, (hh + 1) * d
+            qs = (q_ref[:, lo:hi] * sm_scale).astype(cd)
+            q8 = jnp.broadcast_to(qs, (8, d))
+            s = _dot_tb(q8, k_ref[:, lo:hi].astype(cd))   # (8, bs) f32
+            ks = ks_ref[hh, :].reshape(1, block_size)
+            s = s * ks + penalty
+            vs = vs_ref[hh, :].reshape(1, block_size)
+            (m_scr[hh], l_scr[hh],
+             acc_scr[:, lo:hi]) = _softmax_accumulate(
+                s, v_ref[:, lo:hi].astype(cd),
+                m_scr[hh], l_scr[hh], acc_scr[:, lo:hi], vs_row=vs,
+            )
+
+    @pl.when(j == n_j - 1)
+    def _finalize():
+        o_ref[:] = acc_scr[:1].astype(o_ref.dtype)
 
 
 def paged_decode_attention(
@@ -444,6 +523,8 @@ def paged_decode_attention(
     attn_start=None,
     *,
     n_heads: int,
+    k_scale=None,
+    v_scale=None,
     impl: str = "auto",
 ) -> jnp.ndarray:
     """One paged decode step; returns (b, 1, h*hd). See the module-level
@@ -455,6 +536,13 @@ def paged_decode_attention(
     per grid cell, and the reference's gather is one fused XLA op);
     "kernel" forces the kernel (interpret-mode on CPU — the numerics-
     test hook); "reference" forces the gather path.
+
+    k_scale/v_scale mark an INT8 block pool (serve/kv_pages.py
+    make_paged_cache over a kv_cache_dtype="int8" model): per-block
+    (num_blocks, h, block_size) fp32 scale pages, walked through the
+    same page table and folded into the score/probability rows inside
+    `_paged_kernel_quant` — cache bytes/token halve while the numerics
+    stay pinned to the dequantizing gather reference.
     """
     from jax.experimental.pallas import tpu as pltpu
 
@@ -465,6 +553,9 @@ def paged_decode_attention(
             f"query rows); prefill runs through a contiguous scratch "
             f"cache and scatters whole blocks (serve/kv_pages.py)"
         )
+    quant = k_scale is not None
+    if quant != (v_scale is not None):
+        raise ValueError("int8 page pool needs BOTH k_scale and v_scale")
     bs = k_pages.shape[1]
     d = hd_total // n_heads
     packable = _heads_per_pack(n_heads, d) is not None and bs % 8 == 0
@@ -472,7 +563,7 @@ def paged_decode_attention(
             not packable or jax.default_backend() == "cpu")):
         return paged_attention_reference(
             q, k_pages, v_pages, page_table, lengths, attn_start,
-            n_heads=n_heads,
+            n_heads=n_heads, k_scale=k_scale, v_scale=v_scale,
         )
     if not packable:
         raise ValueError(
@@ -493,6 +584,39 @@ def paged_decode_attention(
         j_sel = lax.select(j * bs <= len_ref[b_], j, 0)
         return (pt_ref[b_, j_sel], 0, 0)
 
+    common = dict(
+        grid=(b, mb),
+        out_specs=pl.BlockSpec((None, 1, hd_total),
+                               lambda b_, j, *_: (b_, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((n_heads, 8, _LANES), jnp.float32),
+            pltpu.VMEM((n_heads, 8, _LANES), jnp.float32),
+            pltpu.VMEM((8, hd_total), jnp.float32),
+        ],
+    )
+    q_spec = pl.BlockSpec((None, 1, hd_total), lambda b_, j, *_: (b_, 0, 0))
+    kv_spec = pl.BlockSpec((None, bs, hd_total), kv_map)
+    if quant:
+        scale_spec = pl.BlockSpec((None, n_heads, bs), kv_map)
+        kernel = functools.partial(
+            _paged_kernel_quant, sm_scale=sm_scale, block_size=bs,
+            n_heads=n_heads, d=d, has_start=has_start,
+            compute_dtype=q.dtype,
+        )
+        return pl.pallas_call(
+            kernel,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=3,
+                in_specs=[q_spec, kv_spec, kv_spec,
+                          scale_spec, scale_spec],
+                **common,
+            ),
+            out_shape=jax.ShapeDtypeStruct((b, 1, hd_total), q.dtype),
+            compiler_params=tpu_compiler_params(
+                dimension_semantics=("parallel", "arbitrary")
+            ),
+            interpret=jax.default_backend() == "cpu",
+        )(lens, start, pt, q, k_pages, v_pages, k_scale, v_scale)
     kernel = functools.partial(
         _paged_kernel, sm_scale=sm_scale, block_size=bs,
         n_heads=n_heads, d=d, has_start=has_start,
@@ -501,20 +625,8 @@ def paged_decode_attention(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=3,
-            grid=(b, mb),
-            in_specs=[
-                pl.BlockSpec((None, 1, hd_total),
-                             lambda b_, j, *_: (b_, 0, 0)),
-                pl.BlockSpec((None, bs, hd_total), kv_map),
-                pl.BlockSpec((None, bs, hd_total), kv_map),
-            ],
-            out_specs=pl.BlockSpec((None, 1, hd_total),
-                                   lambda b_, j, *_: (b_, 0, 0)),
-            scratch_shapes=[
-                pltpu.VMEM((n_heads, 8, _LANES), jnp.float32),
-                pltpu.VMEM((n_heads, 8, _LANES), jnp.float32),
-                pltpu.VMEM((8, hd_total), jnp.float32),
-            ],
+            in_specs=[q_spec, kv_spec, kv_spec],
+            **common,
         ),
         out_shape=jax.ShapeDtypeStruct((b, 1, hd_total), q.dtype),
         compiler_params=tpu_compiler_params(

@@ -101,12 +101,19 @@ def _widen(x128, w):
     return jnp.broadcast_to(x128[:, :1], (x128.shape[0], w))
 
 
-def _softmax_accumulate(s, v_tile, m_prev, l_prev, acc_prev):
+def _softmax_accumulate(s, v_tile, m_prev, l_prev, acc_prev, *,
+                        vs_row=None):
     """One online-softmax accumulation step, shared by every forward
     kernel (folded, packed, decode): fold the fp32 score tile `s`
     (rows, block_k) and its value tile into lane-replicated (rows, 128)
     running max/denominator state and a NORMALIZED accumulator
-    (rows, d). Returns (m_next, l_next, acc_next)."""
+    (rows, d). Returns (m_next, l_next, acc_next).
+
+    `vs_row` (rows, block_k) handles an INT8 value tile with
+    per-position dequant scales: p @ diag(vs) @ V == (p * vs_row) @ V,
+    so the scale folds into the probability row BEFORE the dot and the
+    MXU still consumes the raw tile. The softmax DENOMINATOR stays
+    unscaled — vs dequantizes values, it is not probability mass."""
     block_k = s.shape[-1]
     d = acc_prev.shape[-1]
     m_next = jnp.maximum(m_prev, jnp.max(s, axis=1)[:, None])
@@ -116,7 +123,8 @@ def _softmax_accumulate(s, v_tile, m_prev, l_prev, acc_prev):
     l_next = l_corr + jnp.sum(p, axis=1)[:, None]
     l_inv = jnp.where(l_next == 0.0, 1.0, 1.0 / l_next)
     pv = lax.dot_general(
-        p.astype(v_tile.dtype), v_tile, (((1,), (0,)), ((), ())),
+        (p if vs_row is None else p * vs_row).astype(v_tile.dtype),
+        v_tile, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
     acc_next = acc_prev * _widen(l_corr * l_inv, d) + pv * _widen(l_inv, d)

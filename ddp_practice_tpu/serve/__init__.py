@@ -61,7 +61,10 @@ from ddp_practice_tpu.serve.health import (
     HealthState,
     ReplicaHealth,
 )
-from ddp_practice_tpu.serve.kv_pages import BlockAllocator
+from ddp_practice_tpu.serve.kv_pages import (
+    BlockAllocator,
+    RadixPrefixCache,
+)
 from ddp_practice_tpu.serve.kv_slots import SlotAllocator
 from ddp_practice_tpu.serve.metrics import RouterMetrics, ServeMetrics
 from ddp_practice_tpu.serve.router import (
@@ -91,6 +94,7 @@ __all__ = [
     "HealthState",
     "MonotonicClock",
     "PagedEngine",
+    "RadixPrefixCache",
     "ReplicaCrashed",
     "ReplicaHealth",
     "Request",

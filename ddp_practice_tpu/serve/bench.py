@@ -79,7 +79,47 @@ def build_trace(
     return trace
 
 
-def _build_model(*, vocab, max_len, hidden, depth, heads, mlp):
+def build_shared_prefix_trace(
+    *,
+    n_requests: int,
+    rate_hz: float,
+    vocab: int,
+    k_prefixes: int = 2,
+    prefix_len: int = 48,
+    tail_range=(1, 8),
+    max_new_range=(8, 24),
+    seed: int = 0,
+) -> list:
+    """K seeded system prompts x many continuations — the PR-6 prefix
+    workload: every request is one of `k_prefixes` fixed prefixes plus a
+    short unique tail, arriving Poisson. Deterministic per seed (same
+    trace replays through the plain and prefix-sharing engines)."""
+    rng = np.random.default_rng(seed)
+    prefixes = [
+        rng.integers(0, vocab, prefix_len).tolist()
+        for _ in range(k_prefixes)
+    ]
+    gaps = rng.exponential(1.0 / rate_hz, n_requests)
+    arrivals = np.cumsum(gaps)
+    trace = []
+    for i in range(n_requests):
+        pre = prefixes[int(rng.integers(0, k_prefixes))]
+        tail = rng.integers(
+            0, vocab, int(rng.integers(tail_range[0], tail_range[1] + 1))
+        ).tolist()
+        trace.append({
+            "rid": i,
+            "arrival": float(arrivals[i]),
+            "prompt": list(pre) + tail,
+            "max_new_tokens": int(
+                rng.integers(max_new_range[0], max_new_range[1] + 1)
+            ),
+        })
+    return trace
+
+
+def _build_model(*, vocab, max_len, hidden, depth, heads, mlp,
+                 kv_cache_dtype=None):
     import jax
     import jax.numpy as jnp
 
@@ -88,11 +128,24 @@ def _build_model(*, vocab, max_len, hidden, depth, heads, mlp):
     model = create_model(
         "lm_tiny", vocab_size=vocab, max_len=max_len, hidden_dim=hidden,
         depth=depth, num_heads=heads, mlp_dim=mlp, pos_emb="rope",
+        kv_cache_dtype=kv_cache_dtype,
     )
     params = model.init(
         jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
     )["params"]
     return model, params
+
+
+def _kv_bytes_per_token(cache, num_blocks, block_size) -> float:
+    """HBM bytes one context position costs in a paged pool: every
+    non-scalar cache leaf's bytes (K/V + any int8 scale pages), divided
+    by the pool's positions. The int8-halving acceptance number."""
+    import jax
+
+    total = sum(
+        leaf.nbytes for leaf in jax.tree.leaves(cache) if leaf.ndim
+    )
+    return total / (num_blocks * block_size)
 
 
 def _percentiles(xs) -> dict:
@@ -167,7 +220,8 @@ class _Scraper:
 
 def _run_continuous(model, params, trace, *, max_slots, prompt_buckets,
                     max_len, decode_burst, eos_id, paged: bool = False,
-                    block_size: int = 16, tracer=None,
+                    block_size: int = 16, prefix_cache: bool = False,
+                    num_blocks: Optional[int] = None, tracer=None,
                     telemetry=None, health_slot=None) -> dict:
     from ddp_practice_tpu.serve.engine import (
         EngineConfig,
@@ -181,7 +235,9 @@ def _run_continuous(model, params, trace, *, max_slots, prompt_buckets,
         # (bucket + burst-rounded max_new), not to max_len — this is the
         # paged decoupling: attention span follows the request, while
         # the POOL carries max_len-equivalent memory per slot so both
-        # engines hold the same HBM
+        # engines hold the same HBM. `num_blocks` overrides the pool
+        # size (the shared-prefix bench undersizes it so block pressure
+        # — what sharing relieves — is actually on the table).
         worst_new = max(t["max_new_tokens"] for t in trace)
         worst_new = -(-worst_new // decode_burst) * decode_burst
         cap_blocks = -(-(max(prompt_buckets) + worst_new) // block_size)
@@ -192,7 +248,11 @@ def _run_continuous(model, params, trace, *, max_slots, prompt_buckets,
                 prompt_buckets=prompt_buckets, temperature=0.0,
                 decode_burst=decode_burst, eos_id=eos_id,
                 block_size=block_size, max_blocks_per_slot=cap_blocks,
-                num_blocks=1 + max_slots * (-(-max_len // block_size)),
+                num_blocks=(
+                    num_blocks if num_blocks is not None
+                    else 1 + max_slots * (-(-max_len // block_size))
+                ),
+                prefix_cache=prefix_cache,
             ),
         )
     else:
@@ -226,6 +286,18 @@ def _run_continuous(model, params, trace, *, max_slots, prompt_buckets,
                             max_positions=decode_burst)
         engine.step_burst()
         engine.release(slot)
+    if paged and prefix_cache:
+        # warm the HIT path too: re-admitting a just-cached prompt
+        # compiles the suffix-bucket prefix-prefill program. Then the
+        # tree and its counters reset, so the timed window starts cold.
+        for w in widths:
+            slot = engine.admit(list(range(1, w + 1))[:w],
+                                max_positions=decode_burst)
+            engine.step_burst()
+            engine.release(slot)
+        engine.radix.clear()
+        engine.radix.hit_tokens = engine.radix.miss_tokens = 0
+        engine.preemptions = 0
     if not paged:
         engine.reset_epoch()
     if tracer is not None:
@@ -266,8 +338,29 @@ def _run_continuous(model, params, trace, *, max_slots, prompt_buckets,
 
     tokens = sum(len(c.tokens) for c in sched.completions)
     lat = [c.finish - c.arrival for c in sched.completions]
+    extra = {}
+    if paged:
+        extra["preemptions"] = engine.preemptions
+        extra["kv_bytes_per_token"] = _kv_bytes_per_token(
+            engine._cache, engine.blocks.num_blocks, block_size
+        )
+        extra["num_blocks"] = engine.blocks.num_blocks
+        if prefix_cache:
+            # the proof-of-reuse counters the acceptance gate reads
+            extra["prefix_cache"] = {
+                "hit_tokens": engine.radix.hit_tokens,
+                "miss_tokens": engine.radix.miss_tokens,
+                "hit_rate": (
+                    engine.radix.hit_tokens
+                    / max(1, engine.radix.hit_tokens
+                          + engine.radix.miss_tokens)
+                ),
+                "nodes": len(engine.radix),
+            }
     return {
-        "mode": "paged" if paged else "continuous",
+        "mode": ("paged+prefix" if paged and prefix_cache
+                 else "paged" if paged else "continuous"),
+        **extra,
         # largest total context one request can reach: the slot pool is
         # hard-capped by its shared clock (a request can never span more
         # than max_len - max_bucket decode positions from base), the
@@ -478,6 +571,113 @@ def _run_static(model, params, trace, *, max_slots, width, max_new,
         "latency_s": _percentiles(lat),
         "completions": len(done),
     }
+
+
+def shared_prefix_bench(
+    *,
+    n_requests: int = 32,
+    # effectively-instant arrivals: the tiny CPU bench model drains 100
+    # real rps without queueing, and an arrival-bound run measures the
+    # Poisson clock, not the pool — saturate so the ratio is the
+    # engines' goodput at full block pressure
+    rate_hz: float = 1000.0,
+    max_slots: int = 8,
+    vocab: int = 64,
+    hidden: int = 128,
+    depth: int = 2,
+    heads: int = 4,
+    mlp: int = 256,
+    max_len: int = 128,
+    prompt_buckets=(16, 128),
+    # the workload: K fixed system prompts (block-aligned so the radix
+    # tree caches exactly the prefix) x short unique tails — prefixes
+    # deliberately DOMINATE each prompt (96 of ~100 tokens), the
+    # production shape ROADMAP item 2 names
+    k_prefixes: int = 2,
+    prefix_len: int = 96,
+    tail_range=(1, 8),
+    max_new_range=(4, 8),
+    decode_burst: int = 4,
+    block_size: int = 16,
+    # UNDERSIZED pool (19 real blocks ~ 2 plain worst-case contexts for
+    # 8 slots): block pressure is what prefix sharing + preemption
+    # relieve, so the pool must actually be contended — the plain row
+    # runs ~2 contexts at a time while the prefix row's slots share the
+    # two 6-block prefixes and fit ~7
+    num_blocks: int = 20,
+    seed: int = 0,
+    kv_int8: bool = False,
+) -> dict:
+    """Replay ONE shared-prefix Poisson trace through the plain paged
+    engine and the prefix-sharing engine at the SAME pool size.
+
+    The report's `prefix_vs_paged` goodput ratio is the PR-6 acceptance
+    number (>= 1.5x target): the prefix engine pays prefill only for
+    each request's tail and shares the K prefixes' blocks refcounted,
+    so the same 24 blocks hold ~2x the concurrent contexts. Hit/miss
+    token counters prove the reuse. `kv_int8=True` additionally stores
+    the pool int8 with per-block scale pages (halved KV bytes/token —
+    reported against the same model's fp32 pool)."""
+    model, params = _build_model(
+        vocab=vocab, max_len=max_len, hidden=hidden, depth=depth,
+        heads=heads, mlp=mlp,
+        kv_cache_dtype="int8" if kv_int8 else None,
+    )
+    trace = build_shared_prefix_trace(
+        n_requests=n_requests, rate_hz=rate_hz, vocab=vocab,
+        k_prefixes=k_prefixes, prefix_len=prefix_len,
+        tail_range=tail_range, max_new_range=max_new_range, seed=seed,
+    )
+    common = dict(
+        max_slots=max_slots, prompt_buckets=tuple(prompt_buckets),
+        max_len=max_len, decode_burst=decode_burst, eos_id=None,
+        paged=True, block_size=block_size, num_blocks=num_blocks,
+    )
+    plain = _run_continuous(model, params, trace, **common)
+    prefix = _run_continuous(model, params, trace, prefix_cache=True,
+                             **common)
+    report = {
+        "trace": {
+            "n_requests": n_requests, "rate_hz": rate_hz, "seed": seed,
+            "k_prefixes": k_prefixes, "prefix_len": prefix_len,
+            "tail_range": list(tail_range),
+            "max_new_range": list(max_new_range),
+        },
+        "pool": {
+            "num_blocks": num_blocks, "block_size": block_size,
+            "max_slots": max_slots,
+            "kv_cache_dtype": "int8" if kv_int8 else "f32",
+        },
+        "paged": plain,
+        "paged_prefix": prefix,
+        "prefix_vs_paged": (
+            prefix["tokens_per_sec"] / plain["tokens_per_sec"]
+            if plain["tokens_per_sec"] else float("inf")
+        ),
+    }
+    if kv_int8:
+        # bytes/token against the SAME architecture's fp32 pool — the
+        # halved-KV acceptance number (shapes only, no fp32 arrays)
+        import jax
+
+        f32_model, _ = _build_model(
+            vocab=vocab, max_len=max_len, hidden=hidden, depth=depth,
+            heads=heads, mlp=mlp,
+        )
+        from ddp_practice_tpu.serve.kv_pages import make_paged_cache
+
+        f32_cache = jax.eval_shape(
+            lambda: make_paged_cache(f32_model, num_blocks, block_size)
+        )
+        f32_bytes = sum(
+            int(np.prod(leaf.shape)) * leaf.dtype.itemsize
+            for leaf in jax.tree.leaves(f32_cache) if leaf.ndim
+        ) / (num_blocks * block_size)
+        report["kv_bytes_per_token_f32"] = f32_bytes
+        report["kv_bytes_ratio"] = (
+            prefix["kv_bytes_per_token"] / f32_bytes
+        )
+    return report
 
 
 def serve_bench(
@@ -738,6 +938,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "to see the span decoupling")
     p.add_argument("--block-size", dest="block_size", type=int, default=16,
                    help="paged engine: positions per KV block")
+    p.add_argument("--shared-prefix", dest="shared_prefix",
+                   action="store_true",
+                   help="bench: replay a deterministic K-system-prompts x"
+                        " continuations trace through the plain paged "
+                        "engine AND the prefix-sharing engine at the "
+                        "same (undersized) pool — reports the goodput "
+                        "ratio plus prefix-cache hit/miss token "
+                        "counters (serve/kv_pages.py RadixPrefixCache)")
+    p.add_argument("--kv-int8", dest="kv_int8", action="store_true",
+                   help="with --shared-prefix: store the paged pool "
+                        "int8 with per-block scale pages — halves KV "
+                        "bytes/token (reported vs the fp32 pool)")
     p.add_argument("--trace-out", "--trace_out", dest="trace_out",
                    default=None, metavar="PATH",
                    help="write a Chrome trace-event JSON of the request "
@@ -847,6 +1059,39 @@ def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     if args.ckpt_dir:
         return _serve_checkpoint(args)
+    if args.kv_int8 and not args.shared_prefix:
+        raise SystemExit("--kv-int8 rides the --shared-prefix bench")
+    if args.shared_prefix:
+        report = shared_prefix_bench(
+            n_requests=args.requests, rate_hz=args.rate,
+            max_slots=args.max_slots, block_size=args.block_size,
+            seed=args.seed, kv_int8=args.kv_int8,
+        )
+        if args.json:
+            print(json.dumps(report))
+        else:
+            pl, pf = report["paged"], report["paged_prefix"]
+            pc = pf["prefix_cache"]
+            print(f"[shared_prefix_bench] {args.requests} requests @ "
+                  f"{args.rate}/s, pool {report['pool']['num_blocks']} "
+                  f"blocks x {report['pool']['block_size']} "
+                  f"({report['pool']['kv_cache_dtype']})")
+            for r in (pl, pf):
+                print(f"  {r['mode']:>12}: {r['tokens_per_sec']:8.1f} "
+                      f"tok/s  ttft p50 {r['ttft_s']['p50'] * 1e3:7.1f} "
+                      f"ms  p99 {r['ttft_s']['p99'] * 1e3:7.1f} ms  "
+                      f"preemptions {r['preemptions']}")
+            print(f"  prefix/paged goodput: "
+                  f"{report['prefix_vs_paged']:.2f}x  "
+                  f"hit/miss tokens {pc['hit_tokens']}/"
+                  f"{pc['miss_tokens']} "
+                  f"(hit rate {pc['hit_rate']:.2f})")
+            if args.kv_int8:
+                print(f"  kv bytes/token: int8 "
+                      f"{pf['kv_bytes_per_token']:.0f} vs f32 "
+                      f"{report['kv_bytes_per_token_f32']:.0f} "
+                      f"({report['kv_bytes_ratio']:.2f}x)")
+        return 0
     if args.fault_plan and not args.replicas:
         raise SystemExit("--fault-plan needs --replicas N (faults are "
                          "injected into the router fleet run)")

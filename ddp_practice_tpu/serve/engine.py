@@ -52,7 +52,10 @@ from jax import lax
 
 from ddp_practice_tpu.inference import decode_apply, make_cache, sample_logits
 from ddp_practice_tpu.serve.kv_pages import (
+    GARBAGE_BLOCK,
     BlockAllocator,
+    RadixPrefixCache,
+    copy_block,
     make_paged_cache,
     scatter_prompt_blocks,
 )
@@ -108,6 +111,15 @@ class EngineConfig:
     # ceil(max_len / block_size). THIS is a slot's attention span — size
     # it to the workload's real contexts, not the pool.
     max_blocks_per_slot: int = 0
+    # radix prefix cache over the block pool (serve/kv_pages.py
+    # RadixPrefixCache): admissions whose prompt prefix is already
+    # resident share those blocks refcounted and prefill only the
+    # suffix. Changes the admission layout from left-padded to
+    # canonical right-padded positions (sharing needs every request to
+    # agree where token i of a prefix lives), so the prefill program is
+    # `_prefix_prefill`, not the scratch+scatter pair — greedy tokens
+    # stay equivalent (RoPE; pinned in tests/test_serve_equivalence.py).
+    prefix_cache: bool = False
 
 
 def _sample_step(cfg: EngineConfig, last_logits, active, keys):
@@ -132,13 +144,14 @@ def _sample_step(cfg: EngineConfig, last_logits, active, keys):
     return toks, new_keys
 
 
-def _decode_donate() -> tuple:
-    """donate_argnums for the decode dispatch: the cache pool (arg 1
-    after params) is donated on TPU so XLA reuses its HBM in place —
-    with a paged pool the buffer is the whole serving memory, big enough
-    to care (ROADMAP engine-level item). Gated off on CPU, where
-    donation is unimplemented and every dispatch would warn."""
-    return (1,) if jax.default_backend() == "tpu" else ()
+def _decode_donate(pool_argnum: int = 1) -> tuple:
+    """donate_argnums for a pool-rewriting dispatch: the cache pool
+    (arg 1 after params for decode, arg 0 for the CoW copy) is donated
+    on TPU so XLA reuses its HBM in place — with a paged pool the buffer
+    is the whole serving memory, big enough to care (ROADMAP
+    engine-level item). Gated off on CPU, where donation is
+    unimplemented and every dispatch would warn."""
+    return (pool_argnum,) if jax.default_backend() == "tpu" else ()
 
 
 class _EngineBase:
@@ -318,13 +331,16 @@ class SlotEngine(_EngineBase):
         """Decode positions left before the pool cursor hits max_len."""
         return self.max_len - self.cursor
 
-    def admit_gate(self, prompt_len: int, needed_positions: int) -> str:
+    def admit_gate(self, prompt_len: int, needed_positions: int,
+                   prompt: Optional[Sequence[int]] = None) -> str:
         """Admission verdict for a request needing `needed_positions`
         decode positions (burst-rounded by the scheduler):
         "ok" = admit now; "later" = cannot yet (positions will free —
         here, after a drain + `make_room` rewind); "never" = can never
         run on this engine (prompt outgrows every bucket, or more
-        positions than a fresh pool holds)."""
+        positions than a fresh pool holds). `prompt` is accepted for
+        interface parity with PagedEngine (whose prefix cache probes
+        the tokens themselves) and ignored here."""
         try:
             self.bucket_for(prompt_len)
         except ValueError:
@@ -335,12 +351,17 @@ class SlotEngine(_EngineBase):
             return "later"
         return "ok"
 
-    def make_room(self) -> bool:
+    def make_room(self, prompt_len: Optional[int] = None,
+                  needed_positions: Optional[int] = None,
+                  prompt: Optional[Sequence[int]] = None) -> bool:
         """Try to create admission headroom; True if anything changed.
         Positions are a global resource under the shared cursor — the
         only lever is rewinding the pool clock once every slot is free
-        (the scheduler drains, then calls this). The paged engine has no
-        equivalent: its blocks free individually at release."""
+        (the scheduler drains, then calls this), so the blocked
+        request's shape (used by PagedEngine for targeted cache aging)
+        is accepted for interface parity and ignored. The paged engine
+        has no drain equivalent: its blocks free individually at
+        release."""
         if self.allocator.num_used == 0 and self.cursor != self.base_cursor:
             self.reset_epoch()
             return True
@@ -484,18 +505,39 @@ class PagedEngine(_EngineBase):
       pages (ops/decode_attention.paged_decode_attention) — a step's
       attention span is the request's own context, not a pool-global
       [0, max_len);
-    - `release` returns the slot's blocks to the free list individually;
-      nothing ever drains and nothing rewinds (no reset_epoch here);
+    - `release` DEREFS the slot's blocks (serve/kv_pages.py refcounts):
+      a block shared with the prefix cache or a fork sibling survives,
+      a sole-owned one returns to the free list. Nothing ever drains
+      and nothing rewinds (no reset_epoch here);
     - a request may decode past the model's / slot engine's max_len:
       per-slot capacity is `max_blocks_per_slot * block_size` and RoPE
       positions are unbounded.
 
-    Block accounting is LAZY with a worst-case reservation: admission
-    reserves `ceil((bucket + max_positions) / block_size)` blocks (so a
-    running request can never starve mid-decode — the deadlock-freedom
-    the slot engine got from headroom gating), allocates only the prompt
-    blocks up front, and draws the rest from its reservation at burst
-    granularity as the context actually grows.
+    PR 6 turned the pool into a MULTIPLIER instead of a partition:
+
+    - **Prefix sharing** (`EngineConfig.prefix_cache`): admission walks
+      a radix tree of previously served prompt blocks; matched blocks
+      join the new slot's page table refcounted and only the prompt
+      SUFFIX is prefilled (`_prefix_prefill`, one compile per suffix
+      bucket — the hit's prefill chunks are skipped entirely). Sharing
+      needs canonical slot-local positions, so this mode right-pads
+      (attn_start 0) instead of left-padding.
+    - **Copy-on-write**: before a burst writes into a block some other
+      holder also references (a fork sibling's tail block), the block
+      is first copied into a private one (`copy_block`, one compile
+      ever) — which is what makes `fork` (n>1 sampling per prompt)
+      memory-cheap: siblings share every prefix block and split only
+      where they diverge.
+    - **Block-aware preemption** replaces the PR-3 worst-case admission
+      reservation: admission takes only the prompt blocks, and when
+      growth finds the pool empty the engine first evicts unreferenced
+      prefix-cache blocks (LRU), then preempts the YOUNGEST-admitted
+      slot — its non-shared blocks free, the victim lands in
+      `take_preempted()` and the scheduler re-prefills it on
+      readmission (serve/scheduler.py). Admission at the same pool goes
+      up because nobody holds blocks they may never use; the solo-fit
+      admission gate ("never" when a request outgrows the whole pool)
+      keeps the preemption cascade terminating.
     """
 
     def __init__(self, model, params, config: EngineConfig = EngineConfig(),
@@ -536,6 +578,10 @@ class PagedEngine(_EngineBase):
         )
         self.allocator = SlotAllocator(s)     # slot ids (metrics reads it)
         self.blocks = BlockAllocator(num_blocks)
+        self.radix = (
+            RadixPrefixCache(self.blocks, bs) if config.prefix_cache
+            else None
+        )
         self._cache = make_paged_cache(model, num_blocks, bs)
         self._last_logits = jnp.zeros((s, model.vocab_size), model.dtype)
         self._keys = jnp.zeros((s, 2), jnp.uint32)
@@ -544,14 +590,26 @@ class PagedEngine(_EngineBase):
         self._pt = np.zeros((s, self.max_blocks_per_slot), np.int32)
         self._len = np.zeros((s,), np.int32)
         self._attn = np.zeros((s,), np.int32)
-        self._nblk = np.zeros((s,), np.int64)   # blocks allocated
-        self._resv = np.zeros((s,), np.int64)   # blocks still reserved
+        self._nblk = np.zeros((s,), np.int64)   # blocks in the table
+        self._budget = np.zeros((s,), np.int64)  # admit-time block cap
+        self._seq = np.zeros((s,), np.int64)     # admission order (LIFO
+        self._admit_seq = 0                      # preemption victims)
+        self._preempted: list = []   # slots evicted since last drain
+        self.preemptions = 0         # cumulative (metrics export)
         self.last_finite = np.ones((1, s), bool)
         self._slot_trace: dict = {}  # slot -> trace_id (tracer attached)
         self._prefill_jit = jax.jit(self._prefill_admit)
         self._decode_jit = jax.jit(
             self._decode_burst, donate_argnums=_decode_donate()
         )
+        # prefix-mode suffix prefill (one compile per suffix bucket) and
+        # the copy-on-write block split (one compile, ever) — both in
+        # compile_stats so the churn pins cover the new admission paths
+        self._prefix_jit = jax.jit(self._prefix_prefill)
+        self._cow_jit = jax.jit(
+            copy_block, donate_argnums=_decode_donate(pool_argnum=0)
+        )
+        self._fork_jit = jax.jit(self._fork_rows)
 
     # ---------------------------------------------------------------- jitted
     def _prefill_admit(self, params, pool, last_logits, tokens,
@@ -575,6 +633,41 @@ class PagedEngine(_EngineBase):
             last_logits, logits[:, -1].astype(last_logits.dtype), (slot, 0)
         )
         return pool, last_logits
+
+    def _prefix_prefill(self, params, pool, last_logits, tokens,
+                        pos0, true_len, pt_row, slot):
+        """Prefix-cache admission prefill: tokens (1, w) RIGHT-padded —
+        the real suffix in rows [0, true_len) — appended at slot-local
+        positions [pos0, pos0+w) THROUGH the page table, attending the
+        shared prefix blocks [0, pos0) in place (models/vit.py paged
+        s>1 path). One compile per suffix bucket width w. The pad rows
+        write garbage K/V at positions past the context, which the
+        causal mask hides until decode overwrites them; the next-token
+        logits are the last REAL row's (dynamic true_len - 1)."""
+        pool, logits = decode_apply(
+            self.model, params, pool, tokens,
+            batch_stats=self.batch_stats,
+            page_table=pt_row, kv_lengths=pos0[None],
+        )
+        last = lax.dynamic_slice(
+            logits, (0, true_len - 1, 0), (1, 1, logits.shape[2])
+        )[:, 0]
+        last_logits = lax.dynamic_update_slice(
+            last_logits, last.astype(last_logits.dtype), (slot, 0)
+        )
+        return pool, last_logits
+
+    @staticmethod
+    def _fork_rows(last_logits, keys, src, dst, key):
+        """Duplicate one slot's carried sampling state into another
+        (fork): same pending logits, a FRESH PRNG chain — siblings
+        diverge by sampling, not by context."""
+        row = lax.dynamic_slice(
+            last_logits, (src, 0), (1, last_logits.shape[1])
+        )
+        last_logits = lax.dynamic_update_slice(last_logits, row, (dst, 0))
+        keys = lax.dynamic_update_slice(keys, key[None], (dst, 0))
+        return last_logits, keys
 
     def _decode_burst(self, params, pool, last_logits, attn_starts,
                       active, keys, page_table, lengths):
@@ -607,145 +700,444 @@ class PagedEngine(_EngineBase):
 
     @property
     def blocks_available(self) -> int:
-        """Free blocks not spoken for by running requests' reservations —
-        what admission can actually promise to a new request."""
-        return self.blocks.num_free - int(self._resv.sum())
+        """Blocks admission can promise RIGHT NOW: the free list plus
+        unreferenced prefix-cache blocks (evicted on demand). No
+        reservation term any more — future growth is backed by releases
+        and block-aware preemption, not by up-front hoarding."""
+        free = self.blocks.num_free
+        if self.radix is not None:
+            free += self.radix.evictable()
+        return free
 
     @property
     def headroom(self) -> int:
-        """Unreserved pool positions (informational — admission gates on
+        """Promisable pool positions (informational — admission gates on
         blocks per request, not on a global span)."""
         return self.blocks_available * self.config.block_size
 
-    def admit_gate(self, prompt_len: int, needed_positions: int) -> str:
-        """"ok" | "later" (blocks free as running requests release) |
-        "never" (outgrows every bucket or the per-slot capacity)."""
+    def _probe_prefix(self, prompt: Sequence[int]) -> int:
+        """Read-only longest-cached-prefix length for `prompt` (0 with
+        the cache off) — what the admission gate subtracts before
+        bucketing: a prompt whose cached prefix leaves a bucketable
+        suffix is servable even when the WHOLE prompt outgrows every
+        bucket (long shared system prompts)."""
+        if self.radix is None:
+            return 0
+        return self.radix.peek(prompt)
+
+    def _admit_plan(self, prompt_len: int,
+                    prompt: Optional[Sequence[int]] = None):
+        """(matched, bucket_w, need_now) for an admission, or None when
+        no bucket fits the uncached suffix. need_now = prompt-table
+        blocks not already cached + one decode block — THE one place the
+        gate, make_room, and preempt_headroom derive it, so the three
+        can never disagree on what an admission must take right now."""
+        matched = self._probe_prefix(prompt) if prompt is not None else 0
         try:
-            w = self.bucket_for(prompt_len)
+            w = self.bucket_for(prompt_len - matched)
         except ValueError:
+            return None
+        need_now = self._blocks_for(matched + w) \
+            - matched // self.config.block_size + 1
+        return matched, w, need_now
+
+    def admit_gate(self, prompt_len: int, needed_positions: int,
+                   prompt: Optional[Sequence[int]] = None) -> str:
+        """"ok" | "later" (blocks free as running requests release, get
+        preempted, or prefix blocks age out) | "never" (outgrows every
+        bucket even after the cached prefix, the per-slot capacity, or
+        the whole pool). Passing the `prompt` itself lets the gate probe
+        the prefix cache; without it the gate judges the full length."""
+        plan = self._admit_plan(prompt_len, prompt)
+        if plan is None:
             return "never"
-        if w + needed_positions > self.max_context:
+        matched, w, need_now = plan
+        if self.radix is None:
+            end = w + needed_positions
+        else:
+            end = max(matched + w, prompt_len + needed_positions)
+        if end > self.max_context:
             return "never"
-        worst = self._blocks_for(w + needed_positions)
-        if worst > self.blocks.num_blocks - 1:
+        if self._blocks_for(end) > self.blocks.num_blocks - 1:
             return "never"  # outgrows the whole pool, even empty
-        if worst > self.blocks_available:
+        # prompt blocks now + one decode block; growth is backed by
+        # releases / preemption, not a reservation
+        if need_now > self.blocks_available:
             return "later"
         return "ok"
 
-    def make_room(self) -> bool:
-        """Nothing to do: pages free individually at release — there is
-        no epoch to rewind (the scheduler's drain path never triggers)."""
-        return False
+    def preempt_headroom(self, slots: Sequence[int], prompt_len: int,
+                         prompt: Optional[Sequence[int]] = None) -> bool:
+        """Could evicting every slot in `slots` possibly admit a blocked
+        request of this shape? Upper bound: a victim's whole table
+        surfaces (in truth blocks shared with another RUNNING slot
+        stay). False means preemption is pure churn — the scheduler
+        skips it and the head just waits for releases."""
+        plan = self._admit_plan(prompt_len, prompt)
+        if plan is None:
+            return False
+        bound = self.blocks_available \
+            + int(sum(self._nblk[s] for s in slots))
+        return plan[2] <= bound
 
+    def make_room(self, prompt_len: Optional[int] = None,
+                  needed_positions: Optional[int] = None,
+                  prompt: Optional[Sequence[int]] = None) -> bool:
+        """Evict unreferenced prefix-cache blocks (LRU) back to the free
+        list; True if anything freed. Eviction helps a blocked admission
+        only by EXPOSURE: `blocks_available` already counts evictable
+        leaves, so the win is interior chain nodes becoming evictable as
+        their leaves drop. With the blocked request's shape (the same
+        args its admit_gate saw) the pass is TARGETED: the request's own
+        matched prefix is pinned first — a blanket evict would consume
+        the very blocks that made a long prompt servable, flipping a
+        feasible "later" into "never" — and only the shortfall against
+        the gate's need is freed, so one blocked tick no longer wipes
+        the whole warm cache. Preempting a RUNNING victim for a queued
+        request is the scheduler's call (it knows arrival order —
+        serve/scheduler.py preempts only young victims for older
+        requests, which keeps the cascade terminating); the engine-side
+        lever here is only the cache that nobody is attending through."""
+        if self.radix is None:
+            return False
+        keep = self.radix.ref_prefix(prompt) if prompt is not None else []
+        try:
+            if prompt_len is not None and needed_positions is not None:
+                plan = self._admit_plan(prompt_len, prompt)
+                if plan is None:
+                    return False      # no bucket fits: room cannot help
+                # the FULL shortfall, not min(shortfall, evictable()):
+                # evictable() counts only current leaves, but evict()'s
+                # exposure loop drains interior chain blocks too — a
+                # deep single-leaf chain can cover a 3-block shortfall
+                want = max(0, plan[2] - self.blocks.num_free)
+            else:
+                want = self.radix.evictable()
+            return want > 0 and self.radix.evict(want) > 0
+        finally:
+            if keep:
+                self.blocks.free(keep)
+
+    # ------------------------------------------------- block acquisition
+    def _acquire_admit(self, n: int):
+        """n blocks for an admission: free list first, then on-demand
+        LRU eviction of unreferenced prefix-cache blocks. Admission
+        never preempts runners — the scheduler's gate queues instead."""
+        ids = self.blocks.alloc(n)
+        if ids is None and self.radix is not None:
+            self.radix.evict(n - self.blocks.num_free)
+            ids = self.blocks.alloc(n)
+        if ids is None:
+            raise RuntimeError(
+                "not enough free blocks — scheduler must gate admits"
+            )
+        return ids
+
+    def _acquire_decode(self, n: int, protect: int):
+        """n blocks for mid-decode growth / a CoW split: free list, then
+        prefix-cache eviction, then BLOCK-AWARE PREEMPTION — evict the
+        youngest-admitted active slot's non-shared blocks (LIFO victims,
+        vLLM-style) and let the scheduler re-prefill it. `protect` is
+        the slot being grown (never preempts itself while older slots
+        could yield). Raises only when even preempting everyone else
+        cannot cover — impossible for scheduler-gated traffic (the
+        "never" gate bounds one request's whole-pool need), reachable by
+        direct users who oversubscribe fork budgets."""
+        while True:
+            ids = self.blocks.alloc(n)
+            if ids is not None:
+                return ids
+            if self.radix is not None \
+                    and self.radix.evict(n - self.blocks.num_free):
+                continue
+            victims = [
+                s for s in np.flatnonzero(self._active) if s != protect
+            ]
+            if not victims:
+                raise RuntimeError(
+                    f"paged pool exhausted: {n} blocks needed with no "
+                    f"victim left to preempt (slot {protect} already "
+                    f"holds {int(self._nblk[protect])})"
+                )
+            victim = max(victims, key=lambda s: self._seq[s])
+            self.preempt(int(victim))
+
+    def preempt(self, slot: int) -> None:
+        """Evict one active slot: deref its blocks (shared ones — prefix
+        blocks, fork siblings' — survive for their other holders), clear
+        the slot, and queue it on `take_preempted()` for the scheduler's
+        readmission path (re-prefill prompt + generated-so-far).
+        Callable by the scheduler (preempt-for-admission) and by
+        `_acquire_decode` (growth exhaustion)."""
+        if not self._active[slot]:
+            raise ValueError(f"slot {slot} is not active")
+        self._clear_slot(slot)
+        self.preemptions += 1
+        self._preempted.append(slot)
+        tr = self.tracer
+        if tr is not None and tr.enabled:
+            tr.instant("preempt", trace_id=self._slot_trace.get(slot),
+                       pid=self.replica, tid=ENGINE_LANE, slot=slot,
+                       blocks_free=self.blocks.num_free)
+        self._slot_trace.pop(slot, None)
+
+    def take_preempted(self) -> list:
+        """Slots preempted since the last drain (the scheduler calls
+        this after `step_burst` and after its admission loop, re-queues
+        the victims' requests, and re-prefills them when room returns)."""
+        out, self._preempted = self._preempted, []
+        return out
+
+    # ---------------------------------------------------------- admission
     def admit(self, prompt: Sequence[int], *, seed: int = 0,
               max_positions: Optional[int] = None,
               trace_id: Optional[str] = None) -> int:
-        """Prefill `prompt` into a free slot + fresh blocks; the slot id.
+        """Prefill `prompt` into a free slot + blocks; the slot id.
 
         `max_positions` is the request's decode-position budget
-        (burst-rounded max_new_tokens from the scheduler) — it sizes the
-        block reservation that guarantees the request can always finish.
-        None reserves up to the per-slot capacity (direct engine users:
-        fine for tests, wasteful under concurrency).
+        (burst-rounded max_new_tokens from the scheduler): no longer a
+        reservation, just the growth CAP (`_grow_tables` refuses past
+        it) and the whole-pool feasibility check. None caps at the
+        per-slot capacity.
+
+        With `EngineConfig.prefix_cache` the prompt first walks the
+        radix tree: matched blocks join this slot's page table
+        refcounted (their prefill is SKIPPED), only the suffix runs
+        through `_prefix_prefill` at canonical positions, and the
+        prompt's own full blocks are inserted for future admissions.
         """
         p = len(prompt)
         if p == 0:
             raise ValueError("prompt must contain at least one token")
-        w = self.bucket_for(p)
+        bs = self.config.block_size
+        shared: list = []
+        matched = 0
+        if self.radix is not None:
+            shared, matched = self.radix.match(prompt)
+        try:
+            w = self.bucket_for(p - matched)
+        except ValueError:
+            self.blocks.free(shared)
+            raise
+        # the slot's context END: the plain path starts at length w
+        # (left-padding counts as positions), the prefix path at the
+        # true p — but its prefill pad rows touch up to matched + w
         if max_positions is None:
-            max_positions = self.max_context - w
-        if w + max_positions > self.max_context:
-            raise ValueError(
-                f"prompt bucket {w} + max_positions {max_positions} "
-                f"exceeds the per-slot capacity {self.max_context} "
-                f"(= max_blocks_per_slot * block_size)"
+            max_positions = self.max_context - (
+                w if self.radix is None else max(matched + w, p)
             )
-        worst = self._blocks_for(w + max_positions)
-        if worst > self.blocks_available:
-            raise RuntimeError(
-                "not enough free blocks — scheduler must gate admits"
+        if self.radix is None:
+            end = w + max_positions
+        else:
+            end = max(matched + w, p + max_positions)
+        if end > self.max_context:
+            self.blocks.free(shared)
+            raise ValueError(
+                f"prompt {p} (prefill span {matched + w}) + max_positions "
+                f"{max_positions} exceeds the per-slot capacity "
+                f"{self.max_context} (= max_blocks_per_slot * block_size)"
+            )
+        if self._blocks_for(end) > self.blocks.num_blocks - 1:
+            self.blocks.free(shared)
+            raise ValueError(
+                f"prompt {p} + max_positions {max_positions} outgrows "
+                f"the whole pool ({self.blocks.num_blocks - 1} blocks)"
             )
         slot = self.allocator.alloc()
         if slot is None:
+            self.blocks.free(shared)
             raise RuntimeError("no free slot — scheduler must gate admits")
-        n_prompt = self._blocks_for(w)
-        ids = self.blocks.alloc(n_prompt)
-        assert ids is not None  # worst >= n_prompt <= blocks_available
+        n_shared = len(shared)
+        n_table = self._blocks_for(matched + w)
+        try:
+            ids = self._acquire_admit(n_table - n_shared)
+        except RuntimeError:
+            self.allocator.free(slot)
+            self.blocks.free(shared)
+            raise
         self._pt[slot, :] = 0
-        self._pt[slot, :n_prompt] = ids
-        self._nblk[slot] = n_prompt
-        self._resv[slot] = worst - n_prompt
-        self._len[slot] = w
-        self._attn[slot] = w - p
-        padded = np.full((1, w), self.config.pad_id, np.int32)
-        padded[0, w - p:] = np.asarray(prompt, np.int32)
+        self._pt[slot, :n_shared] = shared
+        self._pt[slot, n_shared:n_table] = ids
+        self._nblk[slot] = n_table
+        self._budget[slot] = min(
+            max(self._blocks_for(end), n_table), self.max_blocks_per_slot
+        )
+        self._seq[slot] = self._admit_seq
+        self._admit_seq += 1
         tr = self.tracer
         if tr is not None and tr.enabled:
             tid = trace_id or f"slot{slot}"
             self._slot_trace[slot] = tid
             span = tr.span("prefill", trace_id=tid, pid=self.replica,
                            tid=SLOT_LANE_BASE + slot, bucket=w,
-                           prompt_len=p, slot=slot, blocks=n_prompt)
+                           prompt_len=p, slot=slot, blocks=n_table,
+                           prefix_hit=matched)
             ann = jax.profiler.TraceAnnotation(f"serve:prefill:{tid}")
         else:
             span = ann = _NULL
-        with span, ann:
-            self._cache, self._last_logits = self._prefill_jit(
-                self.params, self._cache, self._last_logits,
-                jnp.asarray(padded), jnp.int32(w - p),
-                jnp.asarray(ids, jnp.int32), jnp.int32(slot),
-            )
+        if self.radix is None:
+            # plain path, unchanged since PR 3: LEFT-padded scratch
+            # prefill + block scatter
+            self._len[slot] = w
+            self._attn[slot] = w - p
+            padded = np.full((1, w), self.config.pad_id, np.int32)
+            padded[0, w - p:] = np.asarray(prompt, np.int32)
+            with span, ann:
+                self._cache, self._last_logits = self._prefill_jit(
+                    self.params, self._cache, self._last_logits,
+                    jnp.asarray(padded), jnp.int32(w - p),
+                    jnp.asarray(ids, jnp.int32), jnp.int32(slot),
+                )
+        else:
+            # prefix path: canonical positions, RIGHT-padded suffix
+            # appended at `matched` through the page table; the hit's
+            # [0, matched) prefill chunks are never recomputed
+            sl = p - matched
+            self._len[slot] = matched + sl
+            self._attn[slot] = 0
+            padded = np.full((1, w), self.config.pad_id, np.int32)
+            padded[0, :sl] = np.asarray(prompt[matched:], np.int32)
+            with span, ann:
+                self._cache, self._last_logits = self._prefix_jit(
+                    self.params, self._cache, self._last_logits,
+                    jnp.asarray(padded), jnp.int32(matched),
+                    jnp.int32(sl),
+                    jnp.asarray(self._pt[slot:slot + 1]),
+                    jnp.int32(slot),
+                )
+            # publish this prompt's own full blocks for future hits
+            # (already-cached chunks keep their existing node)
+            n_full = p // bs
+            if n_full:
+                self.radix.insert(
+                    prompt, [int(b) for b in self._pt[slot, :n_full]]
+                )
         # keyed by the REQUEST's seed alone, as in SlotEngine: placement
         # must stay invisible to the sample stream
         self._keys = self._keys.at[slot].set(jax.random.PRNGKey(seed))
         self._active[slot] = True
         return slot
 
+    def fork(self, slot: int, *, seed: Optional[int] = None,
+             trace_id: Optional[str] = None) -> int:
+        """Clone a running request into a new slot WITHOUT copying its
+        context: the child references every parent block (refcounted)
+        and carries the same pending logits under a fresh PRNG chain —
+        n>1 parallel sampling per prompt for the price of the tail
+        blocks the siblings eventually split via copy-on-write. With no
+        explicit seed the child's chain is folded out of the parent's
+        CURRENT key, so siblings diverge by construction — a seed that
+        merely defaulted to the parent's admit seed would sample the
+        identical tokens."""
+        if not self._active[slot]:
+            raise ValueError(f"slot {slot} is not active")
+        child = self.allocator.alloc()
+        if child is None:
+            raise RuntimeError("no free slot — gate fork like an admit")
+        n = int(self._nblk[slot])
+        self.blocks.ref([int(b) for b in self._pt[slot, :n]])
+        self._pt[child, :] = self._pt[slot, :]
+        self._len[child] = self._len[slot]
+        self._attn[child] = self._attn[slot]
+        self._nblk[child] = n
+        self._budget[child] = self._budget[slot]
+        self._seq[child] = self._admit_seq
+        self._admit_seq += 1
+        key = (jax.random.PRNGKey(seed) if seed is not None
+               else jax.random.fold_in(self._keys[slot], child))
+        self._last_logits, self._keys = self._fork_jit(
+            self._last_logits, self._keys, jnp.int32(slot),
+            jnp.int32(child), key,
+        )
+        self._active[child] = True
+        if trace_id is not None:
+            self._slot_trace[child] = trace_id
+        return child
+
+    # ------------------------------------------------------------- decode
     def _grow_tables(self, k: int) -> int:
         """Allocate the blocks the next k decode positions need, per
-        active slot, drawing from each slot's reservation (so allocation
-        cannot fail mid-decode — exhaustion was settled at admission).
-        Stepping a slot past what its admission reserved raises BEFORE
-        touching the allocator (the analogue of SlotEngine's
-        positions-exhausted guard; the scheduler's burst-rounded
-        max_positions never trips it). Returns the number of blocks
-        grown (the decode-burst span's `blocks_grown` attribute)."""
+        active slot oldest-first (growth may preempt — LIFO victims must
+        still be ungrown, not half-grown). Stepping a slot past its
+        admit-time `max_positions` budget raises BEFORE touching the
+        allocator (the analogue of SlotEngine's positions-exhausted
+        guard; the scheduler's burst-rounded max_positions never trips
+        it). Returns the number of blocks grown (the decode-burst
+        span's `blocks_grown` attribute)."""
         total_grown = 0
-        for slot in np.flatnonzero(self._active):
+        order = sorted(np.flatnonzero(self._active),
+                       key=lambda s: self._seq[s])
+        for slot in order:
+            if not self._active[slot]:
+                continue  # preempted by an older slot's growth
             need = self._blocks_for(int(self._len[slot]) + k)
             grow = need - int(self._nblk[slot])
             if grow <= 0:
                 continue
-            if grow > int(self._resv[slot]) or need > self.max_blocks_per_slot:
+            if need > int(self._budget[slot]) \
+                    or need > self.max_blocks_per_slot:
                 raise RuntimeError(
                     f"slot {slot} stepped past its admit-time block "
-                    f"reservation (needs {need} blocks, has "
-                    f"{int(self._nblk[slot])} + {int(self._resv[slot])} "
-                    f"reserved) — admit with a larger max_positions"
+                    f"budget (needs {need} blocks, budget "
+                    f"{int(self._budget[slot])}) — admit with a larger "
+                    f"max_positions"
                 )
-            ids = self.blocks.alloc(grow)
-            # cannot fail: sum(_resv) <= blocks.num_free is the admission
-            # invariant, and grow <= _resv[slot] was just checked
-            assert ids is not None, "reservation accounting broke"
+            ids = self._acquire_decode(grow, protect=int(slot))
             self._pt[slot, self._nblk[slot]:need] = ids
             self._nblk[slot] = need
-            self._resv[slot] -= grow
             total_grown += grow
         return total_grown
+
+    def _cow_split(self, k: int) -> int:
+        """Copy-on-write pass before a burst: any EXISTING table block
+        the next k positions will write into (fork siblings' shared
+        tail) is first copied into a private block — a shared block is
+        never mutated, so no sibling or cached prefix ever sees another
+        request's tokens. Returns the number of splits (decode-burst
+        span attribute)."""
+        splits = 0
+        bs = self.config.block_size
+        for slot in sorted(np.flatnonzero(self._active),
+                           key=lambda s: self._seq[s]):
+            if not self._active[slot]:
+                continue
+            length = int(self._len[slot])
+            first = length // bs
+            last = min((length + k - 1) // bs, int(self._nblk[slot]) - 1)
+            for idx in range(first, last + 1):
+                b = int(self._pt[slot, idx])
+                if self.blocks.refcount(b) <= 1:
+                    continue
+                assert b != GARBAGE_BLOCK, \
+                    "garbage block can never be shared"
+                (new,) = self._acquire_decode(1, protect=int(slot))
+                # `protect` excludes this slot from the victim list, so
+                # the acquire can never have preempted it
+                assert self._active[slot], "protected slot was preempted"
+                self._cache = self._cow_jit(
+                    self._cache, jnp.int32(b), jnp.int32(new)
+                )
+                self.blocks.free([b])     # drop this slot's ref
+                self._pt[slot, idx] = new
+                splits += 1
+        return splits
 
     def step_burst(self) -> np.ndarray:
         """One dispatch of `decode_burst` steps; tokens (K, max_slots).
         Per-slot lengths advance by K for active slots; free slots emit
-        pad_id and write only the garbage block."""
+        pad_id and write only the garbage block. Growth / CoW happen
+        host-side first and may PREEMPT young slots under pressure —
+        preempted slots drop out of this burst (their rows are pads) and
+        surface via `take_preempted()`."""
         k = self.config.decode_burst
         grown = self._grow_tables(k)
+        splits = self._cow_split(k)
         tr = self.tracer
         if tr is not None and tr.enabled:
             ids = self._dispatch_ids()
             span = tr.span("decode_burst", pid=self.replica,
                            tid=ENGINE_LANE, burst=k, active=len(ids),
-                           blocks_grown=grown,
+                           blocks_grown=grown, cow_splits=splits,
                            blocks_free=self.blocks.num_free)
             ann = jax.profiler.TraceAnnotation(
                 "serve:decode[" + ",".join(ids) + "]"
@@ -765,7 +1157,7 @@ class PagedEngine(_EngineBase):
         return np.asarray(toks)
 
     def context_len(self, slot: int) -> int:
-        """The slot's current context length (bucket width + decoded
+        """The slot's current context length (prompt span + decoded
         tokens) — can exceed the model's max_len, the paged headline."""
         return int(self._len[slot])
 
@@ -774,29 +1166,46 @@ class PagedEngine(_EngineBase):
         identical contract to SlotEngine.poison_slot."""
         self._last_logits = self._last_logits.at[slot].set(jnp.nan)
 
-    def release(self, slot: int) -> None:
-        """Free the slot and return its blocks to the pool individually.
-        The page-table row is pointed back at the garbage block so the
-        batched decode keeps static shapes; stale K/V in the freed
-        blocks is invisible to the next occupant (masked to its own
-        written positions — pinned in tests/test_kv_pages.py)."""
+    def compile_stats(self) -> dict:
+        """The two PR-3 programs plus the PR-6 admission paths — all
+        four counters must stay flat under churn (prefix hits, CoW
+        splits, preempt/readmit included; conftest `compile_guard`)."""
+        return {
+            "prefill_compiles": self._prefill_jit._cache_size(),
+            "decode_compiles": self._decode_jit._cache_size(),
+            "prefix_prefill_compiles": self._prefix_jit._cache_size(),
+            "cow_compiles": self._cow_jit._cache_size(),
+        }
+
+    def _clear_slot(self, slot: int) -> None:
         n = int(self._nblk[slot])
         if n:
             self.blocks.free([int(b) for b in self._pt[slot, :n]])
         self.allocator.free(slot)
         self._pt[slot, :] = 0
         self._nblk[slot] = 0
-        self._resv[slot] = 0
+        self._budget[slot] = 0
         self._len[slot] = 0
         self._attn[slot] = 0
         self._active[slot] = False
+
+    def release(self, slot: int) -> None:
+        """Free the slot and DEREF its blocks: sole-owned blocks return
+        to the pool, blocks shared with the prefix cache or fork
+        siblings stay for their other holders. The page-table row is
+        pointed back at the garbage block so the batched decode keeps
+        static shapes; stale K/V in freed blocks is invisible to the
+        next occupant (masked to its own written positions — pinned in
+        tests/test_kv_pages.py)."""
+        self._clear_slot(slot)
         self._slot_trace.pop(slot, None)
 
     def reset_epoch(self) -> None:
         """Interface parity with SlotEngine (the router calls this in
         warmup() and replica restart()): there is no pool clock to
         rewind — every release already returned its pages — so with all
-        slots free this is a no-op; with active slots it raises, same
-        contract as the slot pool."""
+        slots free this is a no-op (the prefix cache deliberately
+        SURVIVES: warm prefixes are the point); with active slots it
+        raises, same contract as the slot pool."""
         if self.allocator.num_used:
             raise RuntimeError("reset_epoch with active slots")

@@ -11,7 +11,10 @@ resource with vLLM-style paging:
 - the flax "cache" collection of a decode-mode model is allocated as a
   POOL of fixed-size blocks: every `cached_key`/`cached_value` leaf is
   `(num_blocks, block_size, h*hd)` (same flat minor layout as the slot
-  pool — in-place TPU updates, ops/decode_attention.py);
+  pool — in-place TPU updates, ops/decode_attention.py); an int8 cache
+  model (kv_cache_dtype="int8", models/vit.py) additionally pools its
+  per-(head, position) fp32 scales as `(num_blocks, h, block_size)`
+  leaves — the per-BLOCK scale pages that halve KV bytes/token;
 - each slot owns a host-side list of blocks plus a device-side PAGE
   TABLE row (`[max_slots, max_blocks_per_slot]` int32): position `p` of
   a slot lives in pool block `page_table[slot, p // block_size]` at row
@@ -23,19 +26,43 @@ resource with vLLM-style paging:
   individually, and a request's context can outgrow the slot engine's
   `max_len` as long as blocks exist.
 
+Blocks are REFCOUNTED (PR 6): a block may be referenced by several
+slots at once (shared prompt prefix, forked sampling siblings) and by
+the radix prefix cache below; `free` is a deref and the block returns
+to the free list only at refcount zero. Copy-on-write keeps sharing
+sound: a slot about to WRITE into a block with refcount > 1 first
+copies it into a private block (`copy_block`, serve/engine.py
+`_ensure_writable`).
+
+`RadixPrefixCache` is a block-granular radix tree over the pool: each
+node is one FULL block of `block_size` prompt tokens at canonical
+slot-local positions (node depth i covers positions [i*bs, (i+1)*bs)).
+Admission walks the tree with the new prompt (`match`) and re-uses the
+matched blocks outright — those prefill chunks are never recomputed —
+then inserts its own full prompt blocks (`insert`) so later requests
+hit them. The tree holds one reference per cached block; eviction
+(`evict`) walks unreferenced LEAF nodes in LRU order, so a block is
+never reclaimed while any slot still attends through it
+(evict-while-referenced is structurally impossible — pinned in
+tests/test_kv_pages.py). Sharing requires canonical positions, so the
+prefix-cache admission path right-pads (attn_start 0) instead of the
+plain path's left-padding — RoPE makes both layouts equivalent.
+
 Block 0 is the pool's designated GARBAGE block: it is never handed out
-by the allocator, and retired slots' page-table rows point at it, so the
-batched decode step can keep scattering for every batch row (static
-shapes, zero recompiles) without a freed slot ever touching a live
-request's pages. Stale K/V inside a reused block is never visible: a new
+by the allocator, never refcounted, never a copy-on-write source or
+target, and retired slots' page-table rows point at it, so the batched
+decode step can keep scattering for every batch row (static shapes,
+zero recompiles) without a freed slot ever touching a live request's
+pages. Stale K/V inside a reused block is never visible: a new
 occupant's attention is masked to `[attn_start, length]` in its own
 slot-local coordinates, and every position it does attend was written by
-its own prefill/decode (tests/test_kv_pages.py pins this).
+its own prefill/decode — or by the SAME tokens' prefill under a cache
+hit (tests/test_kv_pages.py pins both).
 """
 
 from __future__ import annotations
 
-from typing import Any, List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -44,18 +71,26 @@ from jax import lax
 from ddp_practice_tpu.inference import make_cache
 
 # pool block index reserved as the write target of retired slots; the
-# allocator never hands it out
+# allocator never hands it out, refcounts it, or copies into it
 GARBAGE_BLOCK = 0
 
 
 class BlockAllocator:
-    """Host-side free-list over the pool's block indices.
+    """Host-side refcounted free-list over the pool's block indices.
 
     Pure bookkeeping, same idiom as kv_slots.SlotAllocator: freed blocks
     go to the BACK of the free list, so allocation order is deterministic
     and reuse is observable in tests. `alloc(n)` is all-or-nothing —
     a request either gets its blocks or None (the scheduler's admission
     gate turns None into queueing, never a crash).
+
+    Blocks carry a REFCOUNT: `alloc` hands them out at 1, `ref` adds a
+    holder (another slot sharing the block, the radix prefix cache),
+    `free` drops one — the block returns to the free list only when the
+    last holder lets go. A never-shared pool behaves exactly like the
+    PR-3 allocator. Block 0 (GARBAGE_BLOCK) is outside the economy
+    entirely: alloc never returns it and ref/free refuse it loudly (the
+    retired-slot DMA convention must never alias a live/shared block).
     """
 
     def __init__(self, num_blocks: int) -> None:
@@ -66,33 +101,254 @@ class BlockAllocator:
             )
         self.num_blocks = num_blocks
         self._free: List[int] = list(range(1, num_blocks))
-        self._used: set = set()
+        self._refs: Dict[int, int] = {}
 
     def alloc(self, n: int = 1) -> Optional[List[int]]:
-        """n blocks, or None if fewer than n are free (all-or-nothing)."""
+        """n blocks at refcount 1, or None if fewer than n are free
+        (all-or-nothing)."""
         if n < 0:
             raise ValueError(f"cannot allocate {n} blocks")
         if n > len(self._free):
             return None
         blocks = self._free[:n]
         del self._free[:n]
-        self._used.update(blocks)
+        for b in blocks:
+            assert b != GARBAGE_BLOCK, "garbage block leaked into free list"
+            self._refs[b] = 1
         return blocks
 
-    def free(self, blocks: Sequence[int]) -> None:
+    def ref(self, blocks: Sequence[int]) -> None:
+        """Add one holder to each block (prefix-cache hit, fork)."""
         for b in blocks:
-            if b not in self._used:
+            if b == GARBAGE_BLOCK:
+                raise ValueError(
+                    f"block {GARBAGE_BLOCK} is the garbage block — it can "
+                    f"never be shared or refcounted"
+                )
+            if b not in self._refs:
                 raise ValueError(f"block {b} is not allocated")
-            self._used.remove(b)
-            self._free.append(b)
+            self._refs[b] += 1
+
+    def free(self, blocks: Sequence[int]) -> None:
+        """Drop one holder per block; a block with no holders left
+        returns to the BACK of the free list."""
+        for b in blocks:
+            if b == GARBAGE_BLOCK:
+                raise ValueError(
+                    f"block {GARBAGE_BLOCK} is the garbage block — retired "
+                    f"page-table rows point at it, it is never allocated "
+                    f"or freed"
+                )
+            if b not in self._refs:
+                raise ValueError(f"block {b} is not allocated")
+            self._refs[b] -= 1
+            if self._refs[b] == 0:
+                del self._refs[b]
+                self._free.append(b)
+
+    def refcount(self, block: int) -> int:
+        """Current holder count (0 = free; garbage block reads 0)."""
+        return self._refs.get(block, 0)
 
     @property
     def num_used(self) -> int:
-        return len(self._used)
+        return len(self._refs)
 
     @property
     def num_free(self) -> int:
         return len(self._free)
+
+    @property
+    def num_shared(self) -> int:
+        """Blocks held by more than one holder — the sharing observable
+        behind the `kv_blocks_shared` gauge."""
+        return sum(1 for c in self._refs.values() if c > 1)
+
+
+class _RadixNode:
+    """One full block of the radix tree: `tokens` is the block_size-token
+    edge label, `block` the pool block holding those positions' K/V."""
+
+    __slots__ = ("tokens", "block", "children", "parent", "last_use")
+
+    def __init__(self, tokens: Tuple[int, ...], block: int, parent) -> None:
+        self.tokens = tokens
+        self.block = block
+        self.children: Dict[Tuple[int, ...], "_RadixNode"] = {}
+        self.parent = parent
+        self.last_use = 0
+
+
+class RadixPrefixCache:
+    """Block-granular radix tree mapping prompt prefixes to pool blocks.
+
+    Depth-i nodes hold slot-local positions [i*block_size, (i+1)*bs) of
+    some previously served prompt; only FULL blocks are cached (a
+    partial tail block is private to its request — it would otherwise
+    be written by that request's decode while shared). The tree holds
+    one allocator reference per node, so cached blocks survive their
+    original request's release; `evict` drops LRU leaves whose blocks
+    have no other holder, leaf-first, so nothing a slot still attends
+    through can ever be reclaimed.
+    """
+
+    def __init__(self, allocator: BlockAllocator, block_size: int) -> None:
+        self.allocator = allocator
+        self.block_size = block_size
+        self._root = _RadixNode((), GARBAGE_BLOCK, None)
+        self._clock = 0          # LRU tick, bumped per touch
+        self._nodes = 0
+        self.hit_tokens = 0      # cumulative matched / recomputed token
+        self.miss_tokens = 0     # counters (ServeMetrics exports deltas)
+
+    def __len__(self) -> int:
+        return self._nodes
+
+    def _chunks(self, tokens: Sequence[int]):
+        bs = self.block_size
+        for i in range(len(tokens) // bs):
+            yield tuple(int(t) for t in tokens[i * bs:(i + 1) * bs])
+
+    def _walk(self, tokens: Sequence[int]) -> list:
+        """Nodes along the longest cached block-chunk prefix, in order.
+        Side-effect free — `match` stamps LRU ticks and takes refs on
+        top of this, `peek` deliberately does neither."""
+        node = self._root
+        out: list = []
+        for chunk in self._chunks(tokens):
+            child = node.children.get(chunk)
+            if child is None:
+                break
+            node = child
+            out.append(node)
+        return out
+
+    def _clamp_full(self, items: list, tokens: Sequence[int]) -> list:
+        """Drop trailing matched items until at least ONE token of
+        `tokens` is left to prefill — admission must produce the last
+        prompt token's logits, which no cache holds. THE one clamp
+        shared by `match` / `peek` / `ref_prefix`: the gate, the
+        admission, and the room-making pin must agree on matched
+        length or a feasible admission desynchronizes from its gate."""
+        while items and len(items) * self.block_size >= len(tokens):
+            items.pop()
+        return items
+
+    def peek(self, tokens: Sequence[int]) -> int:
+        """Read-only longest-cached-prefix length in TOKENS, with
+        `match`'s always-leave-one-to-prefill clamp — the admission
+        gate's probe: no LRU stamp, no refs, no hit/miss accounting, so
+        gating a request never perturbs cache state."""
+        clamped = self._clamp_full(self._walk(tokens), tokens)
+        return len(clamped) * self.block_size
+
+    def ref_prefix(self, tokens: Sequence[int]) -> List[int]:
+        """Temporarily PIN the cached prefix chain of `tokens`: refs
+        every matched block (same walk + leave-one-to-prefill clamp as
+        `match`, but no LRU stamp and no hit/miss accounting) and
+        returns them — the caller MUST `allocator.free()` the list to
+        drop the pins. `make_room` uses this to spare the blocked
+        request's own prefix while aging out the rest of the cache."""
+        blocks = self._clamp_full(
+            [n.block for n in self._walk(tokens)], tokens)
+        self.allocator.ref(blocks)
+        return blocks
+
+    def match(self, tokens: Sequence[int]) -> Tuple[List[int], int]:
+        """Longest cached prefix of `tokens`: (blocks, matched_tokens).
+
+        Matching is block-granular and always leaves at least ONE prompt
+        token uncached — the admission prefill must produce the last
+        prompt token's logits, which no cache holds. The caller owns a
+        reference on each returned block (`allocator.ref` applied here),
+        so a concurrent eviction can never pull a matched block out from
+        under the admission that is about to attend through it.
+        """
+        self._clock += 1
+        nodes = self._walk(tokens)
+        blocks: List[int] = []
+        for node in nodes:
+            node.last_use = self._clock
+            blocks.append(node.block)
+        # never match the WHOLE prompt (`_clamp_full`): at least one
+        # token is left to prefill
+        blocks = self._clamp_full(blocks, tokens)
+        matched = len(blocks) * self.block_size
+        self.allocator.ref(blocks)
+        self.hit_tokens += matched
+        self.miss_tokens += len(tokens) - matched
+        return blocks, matched
+
+    def insert(self, tokens: Sequence[int], blocks: Sequence[int]) -> int:
+        """Cache `tokens`' full blocks, where `blocks[i]` holds positions
+        [i*bs, (i+1)*bs). Chunks already present keep their EXISTING
+        block (the caller's duplicate stays private to its slot); new
+        nodes take one tree reference on the caller's block. Returns the
+        number of nodes added."""
+        self._clock += 1
+        node = self._root
+        added = 0
+        for i, chunk in enumerate(self._chunks(tokens)):
+            child = node.children.get(chunk)
+            if child is None:
+                b = int(blocks[i])
+                if b == GARBAGE_BLOCK:
+                    raise ValueError(
+                        "garbage block can never enter the prefix cache"
+                    )
+                self.allocator.ref([b])
+                child = _RadixNode(chunk, b, node)
+                node.children[chunk] = child
+                self._nodes += 1
+                added += 1
+            child.last_use = self._clock
+            node = child
+        return added
+
+    def evictable(self) -> int:
+        """Blocks `evict` could free right now: leaf-reachable nodes
+        whose block has no holder beyond the tree. Admission gates count
+        these as available — evicting them is make_room's first move."""
+        return sum(
+            1 for n in self._iter_nodes()
+            if not n.children and self.allocator.refcount(n.block) == 1
+        )
+
+    def _iter_nodes(self):
+        stack = list(self._root.children.values())
+        while stack:
+            n = stack.pop()
+            yield n
+            stack.extend(n.children.values())
+
+    def evict(self, n_blocks: int) -> int:
+        """Drop up to `n_blocks` LRU unreferenced LEAF nodes (repeatedly
+        — an evicted leaf may expose its parent). Returns blocks freed.
+        Nodes whose block another holder (a slot) still references are
+        skipped: evict-while-referenced cannot happen by construction.
+        """
+        freed = 0
+        while freed < n_blocks:
+            victims = [
+                n for n in self._iter_nodes()
+                if not n.children and self.allocator.refcount(n.block) == 1
+            ]
+            if not victims:
+                break
+            victims.sort(key=lambda n: n.last_use)
+            for v in victims:
+                if freed >= n_blocks:
+                    break
+                del v.parent.children[v.tokens]
+                self.allocator.free([v.block])
+                self._nodes -= 1
+                freed += 1
+        return freed
+
+    def clear(self) -> int:
+        """Evict everything evictable (engine reset); returns blocks
+        freed. Nodes pinned by live slots stay."""
+        return self.evict(self._nodes)
 
 
 def make_paged_cache(model, num_blocks: int, block_size: int) -> Any:
@@ -101,19 +357,28 @@ def make_paged_cache(model, num_blocks: int, block_size: int) -> Any:
     Mirrors the tree structure of `inference.make_cache` — same variable
     names per attention block, so `decode_apply` threads it unchanged —
     but every K/V leaf is `(num_blocks, block_size, h*hd)` instead of
-    `(batch, max_len, h*hd)`. Scalar leaves (the flat layout's write
-    cursors) stay for tree parity; the paged path never advances them.
+    `(batch, max_len, h*hd)`. An int8 cache model's per-(head, position)
+    scale leaves pool the same way: `(1, h, block_size)` becomes
+    `(num_blocks, h, block_size)` — per-block scale pages riding the
+    same page table as the K/V they dequantize. Scalar leaves (the flat
+    layout's write cursors) stay for tree parity; the paged path never
+    advances them.
     """
-    if getattr(model, "kv_cache_dtype", None) == "int8":
-        raise ValueError(
-            "paged KV cache does not compose with kv_cache_dtype='int8' "
-            "yet (the scales would need their own page pool)"
-        )
     shapes = jax.eval_shape(lambda: make_cache(model, 1, block_size))
     return jax.tree.map(
         lambda a: jnp.zeros(a.shape, a.dtype) if a.ndim == 0
         else jnp.zeros((num_blocks,) + a.shape[1:], a.dtype),
         shapes,
+    )
+
+
+def _is_scale_leaf(path) -> bool:
+    """Scale-pool leaves ((nb, h, bs) — positions on axis 2) vs K/V
+    leaves ((nb, bs, h*hd) — positions on axis 1), told apart by the
+    cache variable NAME (`cached_key_scale` / `cached_value_scale`,
+    models/vit.py) rather than shape heuristics."""
+    return any(
+        "scale" in str(getattr(k, "key", k)) for k in path
     )
 
 
@@ -128,20 +393,50 @@ def scatter_prompt_blocks(pool: Any, scratch: Any, block_ids,
     lands in pool block `block_ids[i]`; a trailing partial chunk writes
     only its real rows, so whatever the rest of that block held stays —
     and stays invisible, because attention is masked to the slot's own
-    positions. Scalar leaves keep the POOL's value (no global clock).
+    positions. int8 scale leaves ((1, h, width) -> (nb, h, block_size))
+    chunk along their position axis (2) the same way. Scalar leaves
+    keep the POOL's value (no global clock).
     """
     n_chunks = -(-width // block_size)
 
-    def per_leaf(p, s):
+    def per_leaf(path, p, s):
         if p.ndim == 0:
             return p
+        pos_axis = 2 if _is_scale_leaf(path) else 1
         for i in range(n_chunks):
             lo = i * block_size
             rows = min(block_size, width - lo)
-            chunk = lax.dynamic_slice(
-                s, (0, lo, 0), (1, rows, s.shape[2])
-            ).astype(p.dtype)
-            p = lax.dynamic_update_slice(p, chunk, (block_ids[i], 0, 0))
+            if pos_axis == 1:
+                chunk = lax.dynamic_slice(
+                    s, (0, lo, 0), (1, rows, s.shape[2])
+                ).astype(p.dtype)
+                p = lax.dynamic_update_slice(p, chunk, (block_ids[i], 0, 0))
+            else:
+                chunk = lax.dynamic_slice(
+                    s, (0, 0, lo), (1, s.shape[1], rows)
+                ).astype(p.dtype)
+                p = lax.dynamic_update_slice(p, chunk, (block_ids[i], 0, 0))
         return p
 
-    return jax.tree.map(per_leaf, pool, scratch)
+    return jax.tree_util.tree_map_with_path(per_leaf, pool, scratch)
+
+
+def copy_block(pool: Any, src, dst) -> Any:
+    """Copy one pool block (every non-scalar leaf row `src` -> `dst`) —
+    the copy-on-write primitive: a slot about to write into a SHARED
+    block first duplicates it into a private one. `src`/`dst` may be
+    traced scalars (the engine jits one copy program, reused for every
+    split). Copying from/into the garbage block is a caller bug; the
+    engine asserts it host-side before dispatch."""
+
+    def per_leaf(p):
+        if p.ndim == 0:
+            return p
+        row = lax.dynamic_slice(
+            p, (src,) + (0,) * (p.ndim - 1), (1,) + p.shape[1:]
+        )
+        return lax.dynamic_update_slice(
+            p, row, (dst,) + (0,) * (p.ndim - 1)
+        )
+
+    return jax.tree.map(per_leaf, pool)

@@ -42,6 +42,18 @@ class ServeMetrics:
         # versa (many short requests). Stay 0 for the slot engine.
         self.block_occupancy = r.gauge("serve_block_occupancy")
         self.blocks_free = r.gauge("serve_blocks_free")
+        # prefix-sharing / preemption observables (PR 6): in-use and
+        # SHARED (refcount > 1) block gauges, cumulative prefix-cache
+        # hit/miss token counters (proof the radix cache earns its
+        # keep), and block-aware preemption count. Exported as deltas
+        # from the engine's own cumulative fields each tick, so they
+        # ride /metrics and the telemetry JSONL like everything else.
+        self.kv_blocks_in_use = r.gauge("kv_blocks_in_use")
+        self.kv_blocks_shared = r.gauge("kv_blocks_shared")
+        self.prefix_hit_tokens = r.counter("prefix_cache_hit_tokens_total")
+        self.prefix_miss_tokens = r.counter("prefix_cache_miss_tokens_total")
+        self.preemptions = r.counter("preemptions_total")
+        self._last_hit = self._last_miss = self._last_preempt = 0
         self.tokens_total = r.counter("serve_tokens_total")
         self.submitted = r.counter("serve_requests_submitted")
 
@@ -56,16 +68,31 @@ class ServeMetrics:
         self.slot_occupancy.set(eng.num_active / eng.allocator.max_slots)
         blocks = getattr(eng, "blocks", None)  # PagedEngine only
         if blocks is not None:
-            # count RESERVED blocks as occupied: admission gates on
-            # blocks_available (free minus reservations), so a gauge
-            # built from the raw allocator would show an idle pool
-            # while every new request queues
+            # blocks_available counts free + prefix-cache-evictable —
+            # what admission actually gates on; a gauge built from the
+            # raw free list would show a "full" pool whose cached
+            # prefixes are one make_room away from being promisable
             allocatable = blocks.num_blocks - 1  # minus the garbage block
             available = eng.blocks_available
             self.block_occupancy.set(
                 (allocatable - available) / allocatable
             )
             self.blocks_free.set(available)
+            self.kv_blocks_in_use.set(blocks.num_used)
+            self.kv_blocks_shared.set(blocks.num_shared)
+            preempt = getattr(eng, "preemptions", 0)
+            self.preemptions.inc(preempt - self._last_preempt)
+            self._last_preempt = preempt
+            radix = getattr(eng, "radix", None)
+            if radix is not None:
+                self.prefix_hit_tokens.inc(
+                    radix.hit_tokens - self._last_hit
+                )
+                self.prefix_miss_tokens.inc(
+                    radix.miss_tokens - self._last_miss
+                )
+                self._last_hit = radix.hit_tokens
+                self._last_miss = radix.miss_tokens
 
     def on_complete(self, completion, scheduler) -> None:
         self.registry.counter(f"serve_requests_{completion.status}").inc()

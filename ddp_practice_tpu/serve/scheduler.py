@@ -16,14 +16,22 @@ Admission control is two-tier: `submit()` SHEDS when the bounded queue
 is full (backpressure at the door — the overload answer for "heavy
 traffic from millions of users" is a fast no, not an unbounded queue),
 and the admit loop asks the ENGINE's `admit_gate` for everything
-memory-shaped: "never" (prompt outgrows every bucket, or the request
-can never fit even an empty pool) is a fast reject, "later" waits for
-running requests to release. Memory policy lives behind that gate —
-the slot engine answers from its shared-cursor headroom and frees
-positions only via `make_room` (drain + epoch rewind, kv_slots.py);
-the paged engine answers from unreserved free blocks, which release
-per-request (kv_pages.py), so nothing ever drains and its make_room is
-a no-op. This file carries no epoch logic at all.
+memory-shaped: "never" (prompt outgrows every bucket — after any
+prefix-cache match — or the request can never fit even an empty pool)
+is a fast reject, "later" waits for memory. Memory policy lives behind
+that gate — the slot engine answers from its shared-cursor headroom
+and frees positions only via `make_room` (drain + epoch rewind,
+kv_slots.py); the paged engine answers from free + prefix-cache-
+evictable blocks (kv_pages.py), which release per-request, age out of
+the radix cache (its make_room), or are taken back by BLOCK-AWARE
+PREEMPTION. This file carries no epoch logic at all — but it does own
+the preemption POLICY: when the engine evicts a slot (mid-decode
+growth exhaustion, `take_preempted`) or the admit loop evicts one for
+a blocked older request (`_preempt_victim_for` — only ever a
+strictly-younger arrival, so readmission cascades terminate), the
+victim's request re-queues at the front and re-prefills
+prompt+tokens-so-far; `_resume` folds the pre-eviction tokens back
+into the one completion the client sees.
 
 Time is injected: the real server uses the monotonic clock, tests use
 `FakeClock` (a fixed virtual step per engine tick), so a 20-request
@@ -158,6 +166,9 @@ class _Running:
     # decode_s runs from admit_t1 to the finish edge
     admit_t0: float = 0.0
     admit_t1: float = 0.0
+    # admission order — the block-aware preemption victim key (youngest
+    # admitted evicts first, vLLM-style LIFO)
+    seq: int = 0
 
 
 class Scheduler:
@@ -186,6 +197,13 @@ class Scheduler:
         self.queue: Deque[Request] = deque()
         self.running: Dict[int, _Running] = {}  # slot -> state
         self.completions: List[Completion] = []
+        self._admit_counter = 0
+        # preempted-request resume state (PagedEngine block-aware
+        # preemption): rid -> {"orig": the ORIGINAL request, "prefix":
+        # tokens generated before the eviction, "ftt": their first-token
+        # time}. The continuation re-prefills prompt+prefix; `_finish`
+        # folds the prefix back so the client sees one completion.
+        self._resume: Dict[int, dict] = {}
 
     # ------------------------------------------------------------ intake
     def submit(self, req: Request) -> bool:
@@ -216,6 +234,14 @@ class Scheduler:
                 first_token_time: Optional[float] = None,
                 admitted: Optional[tuple] = None) -> Completion:
         now = self.clock.now()
+        prior = self._resume.pop(req.rid, None)
+        if prior is not None:
+            # a continuation of a preempted request: the client asked
+            # ONE question — fold the pre-eviction tokens (and their
+            # first-token time) back into the single completion
+            tokens = prior["prefix"] + tokens
+            if prior["ftt"] is not None:
+                first_token_time = prior["ftt"]
         ttft = tpot = None
         if first_token_time is not None:
             ttft = first_token_time - req.arrival
@@ -266,6 +292,113 @@ class Scheduler:
                 kept.append(req)
         self.queue = kept
 
+    # ------------------------------------------ preemption / readmission
+    def _requeue_request(self, orig: Request, prompt: List[int],
+                         max_new: int) -> Request:
+        """Clone `orig` for a re-prefill attempt: same identity /
+        arrival / deadline / trace (one request, one timeline), new
+        prompt+budget, and `submitted` stamped NOW — without the stamp
+        the flight record books the whole prior attempt as queue_s
+        (Request.submitted exists exactly to prevent that)."""
+        creq = Request(
+            rid=orig.rid, prompt=prompt, max_new_tokens=max_new,
+            deadline=orig.deadline, seed=orig.seed, arrival=orig.arrival,
+            priority=orig.priority, trace_id=orig.trace_id,
+        )
+        creq.submitted = self.clock.now()
+        return creq
+
+    def _continuation(self, st: _Running) -> Request:
+        """Build the re-prefill request for a preempted running entry:
+        prompt + tokens-generated-so-far, the remaining token budget,
+        the ORIGINAL arrival/deadline/trace_id (one request, one
+        timeline). Falls back to regenerating from the original prompt
+        when prompt+prefix outgrows the engine (greedy reproduces the
+        same tokens — the router's failover makes the same trade)."""
+        req = st.req
+        prior = self._resume.pop(req.rid, None)
+        orig = prior["orig"] if prior else req
+        prefix = (prior["prefix"] if prior else []) + st.tokens
+        ftt = (prior["ftt"] if prior and prior["ftt"] is not None
+               else st.first_token_time)
+        new_prompt = list(orig.prompt) + prefix
+        remaining = orig.max_new_tokens - len(prefix)
+        burst = self.engine.config.decode_burst
+        needed = -(-max(remaining, 1) // burst) * burst
+        if prefix and self.engine.admit_gate(
+                len(new_prompt), needed, prompt=new_prompt) == "never":
+            prefix, ftt = [], None
+            new_prompt = list(orig.prompt)
+            remaining = orig.max_new_tokens
+        if prefix:
+            self._resume[req.rid] = {
+                "orig": orig, "prefix": prefix, "ftt": ftt,
+            }
+        creq = self._requeue_request(orig, new_prompt, remaining)
+        tr = self.tracer
+        if tr is not None and tr.enabled:
+            tr.instant("preempted", trace_id=orig.trace_id,
+                       pid=self.replica, tid=ENGINE_LANE, rid=orig.rid,
+                       tokens_salvaged=len(prefix))
+        return creq
+
+    def _drain_preempted(self) -> None:
+        """Requeue requests the ENGINE evicted during step_burst (paged
+        growth/CoW exhaustion): they re-enter at the FRONT and
+        re-prefill as room returns. No-op for engines without
+        preemption (SlotEngine)."""
+        take = getattr(self.engine, "take_preempted", None)
+        if take is None:
+            return
+        for slot in take():
+            st = self.running.pop(slot, None)
+            if st is not None:
+                self.queue.appendleft(self._continuation(st))
+
+    def _preempt_victim_for(self, req: Request) -> Optional[Request]:
+        """Admission-pressure preemption: evict the YOUNGEST-admitted
+        running request so `req` (the blocked queue head) can take its
+        blocks — but only when `req` arrived strictly EARLIER than the
+        victim. Preemption then only ever flows older-over-younger, so
+        readmission cascades terminate (a victim can never win its
+        blocks back from the request that took them). Returns the
+        victim's continuation request, or None when no fair victim
+        exists (the head just waits for releases). UNFAIR entries are
+        skipped, not a reason to bail: a readmitted continuation
+        carries a fresh (high) admission seq but its ORIGINAL arrival,
+        and it must not shield the genuinely-younger runners behind
+        it."""
+        eng = self.engine
+        if not hasattr(eng, "preempt") or not self.running:
+            return None
+        key = ((req.arrival or 0.0), req.rid)
+        fair = [(st.seq, slot) for slot, st in self.running.items()
+                if key < ((st.req.arrival or 0.0), st.req.rid)]
+        if not fair:
+            return None
+        slot = max(fair)[1]
+        st = self.running[slot]
+        eng.preempt(slot)
+        eng.take_preempted()  # consumed here, not by the post-burst drain
+        del self.running[slot]
+        return self._continuation(st)
+
+    def _preemption_can_help(self, req: Request) -> bool:
+        """Feasibility before the first eviction: even taking EVERY fair
+        (strictly-younger-arrival) victim's blocks is an upper bound on
+        what preemption surfaces — when that still cannot admit the
+        head, evicting anyone is pure churn (victims lose their decode
+        progress to re-prefill, the head stays blocked), so nobody is
+        touched and the head waits for releases instead."""
+        eng = self.engine
+        if not hasattr(eng, "preempt_headroom"):
+            return True
+        key = ((req.arrival or 0.0), req.rid)
+        fair = [s for s, st in self.running.items()
+                if key < ((st.req.arrival or 0.0), st.req.rid)]
+        return eng.preempt_headroom(fair, len(req.prompt),
+                                    prompt=req.prompt)
+
     def _admit(self) -> None:
         eng = self.engine
         burst = eng.config.decode_burst
@@ -277,14 +410,61 @@ class Scheduler:
             needed = -(-req.max_new_tokens // burst) * burst
             # memory policy is the ENGINE's: the slot engine gates on
             # global cursor headroom (make_room = drain + epoch rewind),
-            # the paged engine on unreserved free blocks (make_room is a
-            # no-op — pages free individually at release). The scheduler
-            # only distinguishes can't-yet from can't-ever.
-            gate = eng.admit_gate(len(req.prompt), needed)
-            if gate == "later" and eng.make_room():
-                gate = eng.admit_gate(len(req.prompt), needed)
+            # the paged engine on free + prefix-cache-evictable blocks
+            # (pages free per-request at release; make_room ages out
+            # cached prefixes; block-aware preemption evicts young
+            # runners for older blocked work). The scheduler only
+            # distinguishes can't-yet from can't-ever — and enforces
+            # the arrival-order fairness preemption needs.
+            gate = eng.admit_gate(len(req.prompt), needed,
+                                  prompt=req.prompt)
+            if gate == "later" and eng.make_room(len(req.prompt), needed,
+                                                 prompt=req.prompt):
+                gate = eng.admit_gate(len(req.prompt), needed,
+                                      prompt=req.prompt)
+            if gate == "later" and self._preemption_can_help(req):
+                staged: List[Request] = []
+                while gate == "later":
+                    creq = self._preempt_victim_for(req)
+                    if creq is None:
+                        break
+                    staged.append(creq)
+                    gate = eng.admit_gate(len(req.prompt), needed,
+                                          prompt=req.prompt)
+                # victims re-enter BEHIND the head (they are strictly
+                # younger by arrival — queue order stays arrival order).
+                # staged is in EVICTION order (descending admission
+                # seq), which is NOT arrival order when a victim is a
+                # readmitted continuation (fresh high seq, ORIGINAL old
+                # arrival) — sort by arrival descending so each
+                # insert(1) pushes the previous back and the oldest
+                # arrival lands first behind the head.
+                staged.sort(key=lambda r: ((r.arrival or 0.0), r.rid),
+                            reverse=True)
+                for creq in staged:
+                    self.queue.insert(1, creq)
             if gate == "never":
                 self.queue.popleft()
+                prior = self._resume.pop(req.rid, None)
+                if prior is not None:
+                    # a preempted request's continuation went STALE in
+                    # the queue: the warm prefix it was sized against
+                    # aged out of the cache, and prompt+tokens-so-far
+                    # no longer fits a bucket. Retry from the ORIGINAL
+                    # prompt (greedy/seeded decode reproduces the lost
+                    # tokens — the trade _continuation already makes at
+                    # build time) instead of rejecting a servable
+                    # request. The _resume entry is consumed, so a
+                    # genuine "never" on the retry still rejects.
+                    orig = prior["orig"]
+                    if tr is not None and tr.enabled:
+                        tr.instant("stale_retry", trace_id=req.trace_id,
+                                   pid=self.replica, tid=ENGINE_LANE,
+                                   rid=req.rid,
+                                   tokens_dropped=len(prior["prefix"]))
+                    self.queue.appendleft(self._requeue_request(
+                        orig, list(orig.prompt), orig.max_new_tokens))
+                    continue
                 if tr is not None and tr.enabled:
                     tr.instant("admit_never", trace_id=req.trace_id,
                                pid=self.replica, tid=ENGINE_LANE,
@@ -317,8 +497,10 @@ class Scheduler:
                 tr.record_async("queued", sub, t_admit0,
                                 trace_id=req.trace_id, pid=self.replica,
                                 attrs={"slot": slot})
+            self._admit_counter += 1
             self.running[slot] = _Running(
                 req=req, slot=slot, admit_t0=t_admit0, admit_t1=t_admit1,
+                seq=self._admit_counter,
             )
 
     # ------------------------------------------------------------ the tick
@@ -334,6 +516,10 @@ class Scheduler:
         if self.running:
             burst = self.engine.step_burst()  # (K, max_slots)
             finite = self.engine.last_finite  # (K, max_slots)
+            # block-aware preemption: slots the engine evicted BEFORE
+            # this dispatch produced no tokens this burst — requeue
+            # their requests (front) before mapping token rows
+            self._drain_preempted()
             eos = self.engine.config.eos_id
             for k, row in enumerate(burst):
                 self.clock.tick()
@@ -415,11 +601,25 @@ class Scheduler:
         now = self.clock.now()
         out = []
         for st in self.running.values():
-            out.append((st.req, st.tokens, st.first_token_time,
+            prior = self._resume.pop(st.req.rid, None)
+            req, toks, ftt = st.req, st.tokens, st.first_token_time
+            if prior is not None:
+                # a running CONTINUATION of a preempted request: hand
+                # the router the ORIGINAL request with all tokens so
+                # far, not the synthetic prompt+prefix one
+                req = prior["orig"]
+                toks = prior["prefix"] + toks
+                ftt = prior["ftt"] if prior["ftt"] is not None else ftt
+            out.append((req, toks, ftt,
                         _attempt_phases(st.req, now,
                                         (st.admit_t0, st.admit_t1))))
         for req in self.queue:
-            out.append((req, [], None, _attempt_phases(req, now, None)))
+            prior = self._resume.pop(req.rid, None)
+            if prior is not None:
+                out.append((prior["orig"], prior["prefix"], prior["ftt"],
+                            _attempt_phases(req, now, None)))
+            else:
+                out.append((req, [], None, _attempt_phases(req, now, None)))
         self.running.clear()
         self.queue.clear()
         return out

@@ -35,7 +35,17 @@ def main() -> int:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 2)
+    try:
+        jax.config.update("jax_num_cpu_devices", 2)
+    except AttributeError:
+        # this jax build predates the jax_num_cpu_devices option (same
+        # guard as tests/conftest.py) — fall back to the XLA flag, which
+        # works because no device has been touched yet in this process
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=2"
+            ).strip()
 
     import numpy as np
     from jax.experimental import multihost_utils
@@ -47,6 +57,19 @@ def main() -> int:
     assert jax.process_index() == pid
     assert jax.device_count() == 2 * nproc, jax.device_count()
     print(f"[{pid}] distributed up: {jax.device_count()} global devices")
+
+    # probe one tiny cross-process collective before the real scenarios:
+    # some jax builds (e.g. this image's 0.4.37) rendezvous fine but then
+    # refuse every multi-process computation on the CPU backend — that is
+    # an environment limit, not a code bug, so report it distinctly (rc
+    # 77) and let the parent test skip instead of fail
+    try:
+        multihost_utils.process_allgather(np.zeros(1))
+    except Exception as e:
+        if "Multiprocess computations aren't implemented" in str(e):
+            print(f"[{pid}] MULTIPROCESS_CPU_UNSUPPORTED: {e}")
+            return 77
+        raise
 
     # --- assert_in_sync agreeing fingerprints: passes on every process ---
     from ddp_practice_tpu.train.elastic import assert_in_sync

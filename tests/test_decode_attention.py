@@ -203,6 +203,42 @@ def test_paged_kernel_respects_attn_start():
     assert float(jnp.abs(diff[0] - got[0]).max()) > 1e-3
 
 
+def test_paged_int8_kernel_matches_dequantized_reference():
+    """INT8 block pool with per-block (num_blocks, h, block_size) scale
+    pages: the quantized page-walking kernel (interpret mode) tracks
+    the dequantizing gather reference — the numerics pin behind the
+    kv_cache_dtype='int8' paged serving path (halved KV bytes/token)."""
+    from ddp_practice_tpu.ops.decode_attention import (
+        paged_attention_reference,
+        paged_decode_attention,
+    )
+
+    rng = np.random.default_rng(7)
+    nb, bs, mb = 10, 16, 4
+    q = jnp.asarray(rng.normal(size=(B, 1, H * HD)), jnp.float32)
+    kq = jnp.asarray(rng.integers(-127, 128, size=(nb, bs, H * HD)),
+                     jnp.int8)
+    vq = jnp.asarray(rng.integers(-127, 128, size=(nb, bs, H * HD)),
+                     jnp.int8)
+    ks = jnp.asarray(np.abs(rng.normal(size=(nb, H, bs))) * 0.01 + 1e-3,
+                     jnp.float32)
+    vs = jnp.asarray(np.abs(rng.normal(size=(nb, H, bs))) * 0.01 + 1e-3,
+                     jnp.float32)
+    pt = jnp.asarray(rng.integers(1, nb, size=(B, mb)), jnp.int32)
+    lengths = jnp.asarray([0, 37, 63], jnp.int32)
+    start = jnp.asarray([0, 5, 17], jnp.int32)
+    ref = paged_attention_reference(q, kq, vq, pt, lengths, start,
+                                    n_heads=H, k_scale=ks, v_scale=vs)
+    got = paged_decode_attention(q, kq, vq, pt, lengths, start, n_heads=H,
+                                 k_scale=ks, v_scale=vs, impl="kernel")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+    # missing v_scale refuses loudly rather than serving garbage
+    with pytest.raises(ValueError, match="BOTH"):
+        paged_decode_attention(q, kq, vq, pt, lengths, None, n_heads=H,
+                               k_scale=ks)
+
+
 def test_paged_single_token_contract():
     """Multi-token queries refuse loudly (prefill is the scratch-cache
     path), and unpackable heads refuse the kernel but serve the

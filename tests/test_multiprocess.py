@@ -70,6 +70,10 @@ def test_two_process_distributed(tmp_path):
                 out = "<no output captured>"
             outputs.append(out)
         pytest.fail("multi-process workers timed out\n" + "\n".join(outputs))
+    if all(p.returncode == 77 for p in procs) and all(
+            "MULTIPROCESS_CPU_UNSUPPORTED" in out for out in outputs):
+        pytest.skip("this jax's CPU backend refuses multi-process "
+                    "computations (worker capability probe)")
     for i, (p, out) in enumerate(zip(procs, outputs)):
         assert p.returncode == 0, f"worker {i} rc={p.returncode}\n{out}"
         assert "ALL_OK" in out, f"worker {i} did not reach ALL_OK\n{out}"

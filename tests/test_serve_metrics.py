@@ -117,6 +117,62 @@ def test_serve_metrics_report(devices):
 
 
 @pytest.mark.fast
+def test_paged_pool_metrics_export(devices):
+    """The PR-6 pool observables (kv_blocks_in_use / kv_blocks_shared
+    gauges, prefix-cache hit/miss token counters, preemptions_total)
+    flow from the engine's cumulative fields into the registry as
+    DELTAS per tick — and therefore onto /metrics (render_text) and the
+    telemetry JSONL like every other metric. Host-pure via a stub
+    engine mirroring PagedEngine's observable surface."""
+    from ddp_practice_tpu.serve.metrics import ServeMetrics
+
+    class _Blocks:
+        num_blocks, num_used, num_shared, num_free = 9, 5, 2, 3
+
+    class _Radix:
+        hit_tokens, miss_tokens = 24, 8
+
+        def evictable(self):
+            return 1
+
+    class _Alloc:
+        max_slots = 4
+
+    class _Eng:
+        allocator = _Alloc()
+        blocks = _Blocks()
+        radix = _Radix()
+        num_active = 2
+        blocks_available = 4   # free + evictable
+        preemptions = 3
+
+    class _Sched:
+        engine = _Eng()
+        queue = ()
+
+    m = ServeMetrics()
+    m.on_tick(_Sched())
+    rep = m.report()
+    assert rep["kv_blocks_in_use"] == 5
+    assert rep["kv_blocks_shared"] == 2
+    assert rep["prefix_cache_hit_tokens_total"] == 24
+    assert rep["prefix_cache_miss_tokens_total"] == 8
+    assert rep["preemptions_total"] == 3
+    # a second tick with no movement adds NOTHING (delta export, so the
+    # counters stay counters even though the engine fields are gauges
+    # of cumulative state)
+    m.on_tick(_Sched())
+    rep = m.report()
+    assert rep["prefix_cache_hit_tokens_total"] == 24
+    assert rep["preemptions_total"] == 3
+    # and the names render on the Prometheus exposition
+    text = m.registry.render_text()
+    for name in ("kv_blocks_in_use", "kv_blocks_shared",
+                 "prefix_cache_hit_tokens_total", "preemptions_total"):
+        assert name in text
+
+
+@pytest.mark.fast
 def test_render_text_exposition(devices):
     """Prometheus text format: TYPE lines per family, labelled() names
     re-rendered as name{k="v"}, histograms as summaries with exact

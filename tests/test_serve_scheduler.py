@@ -223,3 +223,155 @@ def test_epoch_reset_keeps_serving(devices, lm):
     assert engine.compile_stats() == {
         "prefill_compiles": 1, "decode_compiles": 1,
     }
+
+
+# --------------------------------------------------- preemption policy
+# Host-pure: the staging/requeue logic runs entirely scheduler-side, so
+# a stub engine that always gates "later" exercises it without a
+# compile. The engine-side preemption mechanics (blocks actually
+# freeing, token identity across evict/readmit) are pinned with real
+# engines in tests/test_kv_pages.py and test_serve_equivalence.py.
+
+class _BlockedEngine:
+    """Minimal PagedEngine protocol surface for the admit loop: every
+    gate says "later", every fair victim can be preempted."""
+
+    class config:
+        decode_burst = 1
+
+    num_free = 1
+
+    def __init__(self, feasible=True):
+        self.feasible = feasible
+        self.preempts = []
+
+    def admit_gate(self, prompt_len, needed, prompt=None):
+        return "later"
+
+    def make_room(self, *a, **k):
+        return False
+
+    def preempt_headroom(self, slots, prompt_len, prompt=None):
+        return self.feasible and len(slots) > 0
+
+    def preempt(self, slot):
+        self.preempts.append(slot)
+
+    def take_preempted(self):
+        return []
+
+
+def _blocked_sched(feasible=True):
+    from ddp_practice_tpu.serve.scheduler import _Running
+
+    eng = _BlockedEngine(feasible)
+    sched = Scheduler(eng, clock=FakeClock())
+    sched.queue.append(
+        Request(rid=0, prompt=[1, 2, 3], max_new_tokens=4, arrival=0.0))
+    # two running victims, both strictly younger by arrival; slot 7
+    # (seq 11) is the youngest-ADMITTED and must be evicted first
+    for slot, (rid, arr, seq) in {5: (1, 1.0, 10), 7: (2, 2.0, 11)}.items():
+        sched.running[slot] = _Running(
+            req=Request(rid=rid, prompt=[rid, rid], max_new_tokens=4,
+                        arrival=arr),
+            slot=slot, seq=seq)
+    return eng, sched
+
+
+def test_preempted_victims_requeue_in_arrival_order(devices):
+    """Multi-victim preemption requeues victims behind the blocked head
+    in ARRIVAL order — the older victim readmits first, so it can never
+    turn around and (fairly) re-preempt the younger one it now leads."""
+    eng, sched = _blocked_sched()
+    sched._admit()
+    assert eng.preempts == [7, 5]          # youngest-admitted evicts first
+    assert not sched.running
+    assert [r.rid for r in sched.queue] == [0, 1, 2]   # arrival order
+
+
+def test_no_preemption_when_it_cannot_admit_the_head(devices):
+    """Feasibility gate: when even evicting EVERY fair victim cannot
+    surface enough blocks, nobody is preempted — the victims keep their
+    decode progress and the head waits for releases."""
+    eng, sched = _blocked_sched(feasible=False)
+    sched._admit()
+    assert eng.preempts == []
+    assert sorted(sched.running) == [5, 7]             # untouched
+    assert [r.rid for r in sched.queue] == [0]
+
+
+def test_unfair_high_seq_runner_does_not_shield_fair_victims(devices):
+    """A readmitted continuation (fresh high admission seq, ORIGINAL old
+    arrival) is skipped, not a reason to bail: the youngest FAIR victim
+    behind it is still evicted for an older blocked head."""
+    from ddp_practice_tpu.serve.scheduler import _Running
+
+    eng = _BlockedEngine()
+    sched = Scheduler(eng, clock=FakeClock())
+    sched.queue.append(
+        Request(rid=0, prompt=[1, 2, 3], max_new_tokens=4, arrival=2.0))
+    # slot 5: continuation of an OLD request (arrival 1.0) readmitted
+    # after a preemption — highest seq, but unfair for this head
+    sched.running[5] = _Running(
+        req=Request(rid=1, prompt=[1, 1], max_new_tokens=4, arrival=1.0),
+        slot=5, seq=9)
+    sched.running[7] = _Running(
+        req=Request(rid=2, prompt=[2, 2], max_new_tokens=4, arrival=3.0),
+        slot=7, seq=4)
+    sched._admit()
+    assert eng.preempts == [7]             # the fair victim, despite seq 4
+    assert sorted(sched.running) == [5]    # the old continuation survives
+    assert [r.rid for r in sched.queue] == [0, 2]
+
+
+def test_stale_continuation_falls_back_to_original_prompt(devices):
+    """A continuation whose warm prefix aged out of the cache while it
+    queued (prompt+prefix no longer fits a bucket -> gate "never") is
+    retried from the ORIGINAL prompt instead of being rejected."""
+
+    class _Eng(_BlockedEngine):
+        def admit_gate(self, prompt_len, needed, prompt=None):
+            return "never" if prompt_len > 4 else "later"
+
+    eng = _Eng()
+    sched = Scheduler(eng, clock=FakeClock())
+    orig = Request(rid=0, prompt=[1, 2, 3], max_new_tokens=6, arrival=0.0,
+                   trace_id="t0")
+    sched._resume[0] = {"orig": orig, "prefix": [9, 9], "ftt": 0.5}
+    sched.queue.append(Request(          # the stale continuation
+        rid=0, prompt=[1, 2, 3, 9, 9], max_new_tokens=4, arrival=0.0,
+        trace_id="t0"))
+    sched._admit()
+    assert sched.completions == []       # NOT rejected
+    assert len(sched.queue) == 1
+    retry = sched.queue[0]
+    assert list(retry.prompt) == [1, 2, 3]         # original prompt
+    assert retry.max_new_tokens == 6               # full budget restored
+    assert retry.trace_id == "t0" and retry.arrival == 0.0
+    assert retry.submitted is not None   # prior attempt not booked as queue_s
+    assert 0 not in sched._resume        # prefix dropped: regenerated
+
+
+def test_continuation_victims_requeue_by_arrival_not_seq(devices):
+    """A readmitted continuation carries a fresh HIGH admission seq but
+    its ORIGINAL arrival — staged eviction order (descending seq) must
+    not leak into the queue, or the younger victim readmits first and
+    gets fairly re-preempted by the older one: churn the sort by
+    arrival prevents."""
+    from ddp_practice_tpu.serve.scheduler import _Running
+
+    eng = _BlockedEngine()
+    sched = Scheduler(eng, clock=FakeClock())
+    sched.queue.append(
+        Request(rid=0, prompt=[1, 2, 3], max_new_tokens=4, arrival=0.5))
+    # slot 5: continuation (arrival 1.0, readmitted -> seq 100); slot 7:
+    # plain younger runner (arrival 2.0, seq 50). Both fair for the head.
+    sched.running[5] = _Running(
+        req=Request(rid=1, prompt=[1, 1], max_new_tokens=4, arrival=1.0),
+        slot=5, seq=100)
+    sched.running[7] = _Running(
+        req=Request(rid=2, prompt=[2, 2], max_new_tokens=4, arrival=2.0),
+        slot=7, seq=50)
+    sched._admit()
+    assert eng.preempts == [5, 7]          # evicted in seq order (LIFO)
+    assert [r.rid for r in sched.queue] == [0, 1, 2]   # ARRIVAL order
