@@ -21,6 +21,15 @@ Examples:
       --fault-plan '{"faults": [{"kind": "crash", "tick": 40}]}'
                                        # fault-tolerant router fleet:
                                        # goodput under injected faults
+  python -m ddp_practice_tpu.cli serve --procs 2  # CROSS-PROCESS fleet:
+                                       # real worker OS processes behind
+                                       # the RPC seam (serve/worker.py,
+                                       # supervised + federated telemetry)
+  python -m ddp_practice_tpu.cli serve --procs 2 --rate 100 \\
+      --fault-plan '{"faults": [{"kind": "kill", "at_s": 1.0}]}'
+                                       # chaos with teeth: SIGKILL a live
+                                       # worker mid-decode, goodput +
+                                       # zero-lost measured for real
 """
 
 from __future__ import annotations

@@ -29,7 +29,25 @@ Layers (each its own module, composable and separately testable):
 - router.py    — fault-tolerant least-loaded dispatch over N replicas:
   bounded retries with backoff+jitter, crash failover that migrates
   in-flight requests (prompt + tokens-so-far re-prefill,
-  token-identical under greedy), brown-out degradation;
+  token-identical under greedy), brown-out degradation. The router
+  drives a NARROW replica interface (submit/step/poll/evacuate +
+  observables) — in-process handles and worker processes are
+  indistinguishable to it;
+- rpc.py       — the transport seam under that interface:
+  length-prefixed JSON frames over localhost TCP, idempotent ops,
+  per-call timeouts, shared-backoff reconnects, and a push-stream
+  mode (the worker pushes completion/heartbeat snapshots; the
+  router select()s on the stream fds — no polling in steady state);
+- worker.py    — one replica as a real OS PROCESS: own single-process
+  jax runtime, Scheduler+Slot/PagedEngine built from a JSON
+  WorkerSpec, warmed before its WORKER_READY line, serving the RPC
+  seam plus its own /metrics /healthz /flight endpoints;
+- supervisor.py— worker lifecycles: spawn/waitpid, restart with
+  exponential backoff + a restart-budget circuit breaker, graceful
+  drain, orphan reaping (atexit + pytest fixture), the router-facing
+  RemoteReplicaHandle (salvage-point failover, stale-heartbeat
+  SIGKILL), and the fleet builder / telemetry federation glue
+  (utils/telemetry.py ScrapeFederator, tools/check_fleet.py verdict);
 - metrics.py   — TTFT/TPOT/queue-depth/occupancy per replica plus the
   fleet counters (retries, failovers, sheds-by-reason, breaker state,
   brown-out), emitted through the process-0 gate (utils/metrics.py
@@ -79,7 +97,20 @@ from ddp_practice_tpu.serve.scheduler import (
     Request,
     Scheduler,
 )
+from ddp_practice_tpu.serve.rpc import (
+    RpcClient,
+    RpcError,
+    RpcServer,
+    RpcTimeout,
+)
 from ddp_practice_tpu.serve.slo import SLOConfig, SLOWatchdog
+from ddp_practice_tpu.serve.supervisor import (
+    RemoteReplicaHandle,
+    Supervisor,
+    SupervisorConfig,
+    make_fleet_router,
+)
+from ddp_practice_tpu.serve.worker import WorkerSpec
 
 __all__ = [
     "BlockAllocator",
@@ -95,17 +126,26 @@ __all__ = [
     "MonotonicClock",
     "PagedEngine",
     "RadixPrefixCache",
+    "RemoteReplicaHandle",
     "ReplicaCrashed",
     "ReplicaHealth",
     "Request",
     "Router",
     "RouterConfig",
     "RouterMetrics",
+    "RpcClient",
+    "RpcError",
+    "RpcServer",
+    "RpcTimeout",
     "SLOConfig",
     "SLOWatchdog",
     "Scheduler",
     "ServeMetrics",
     "SlotAllocator",
     "SlotEngine",
+    "Supervisor",
+    "SupervisorConfig",
+    "WorkerSpec",
+    "make_fleet_router",
     "make_router",
 ]

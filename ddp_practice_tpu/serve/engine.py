@@ -154,6 +154,24 @@ def _decode_donate(pool_argnum: int = 1) -> tuple:
     return (pool_argnum,) if jax.default_backend() == "tpu" else ()
 
 
+def warm_engine(engine, widths=None) -> None:
+    """Compile an engine's programs outside any timed/traced window:
+    one admit per bucket width in play + one decode burst, then release
+    and rewind. THE one warmup recipe — the in-process router's
+    ReplicaHandle and the worker process (serve/worker.py) both call
+    it, so a restarted replica re-warms exactly like a fresh one.
+    The admit budgets only the one warmup burst: a paged engine's
+    default admit reserves its whole per-slot capacity, which an
+    oversubscribed block pool can't cover even though the gated
+    scheduler path serves it fine."""
+    for w in widths or engine.buckets:
+        slot = engine.admit([1] * w,
+                            max_positions=engine.config.decode_burst)
+        engine.step_burst()
+        engine.release(slot)
+    engine.reset_epoch()
+
+
 class _EngineBase:
     """What the two memory layouts share: the prompt-bucket map, slot
     accounting over a SlotAllocator at `self.allocator`, the

@@ -24,12 +24,23 @@ Kinds (each one real failure the fleet must survive):
   plan cannot silently miss because the queue happened to be empty that
   tick. The scheduler finishes the victim with status "error" and the
   router retries it elsewhere.
+- ``kill`` — a REAL signal (`sig`: SIGKILL / SIGSTOP / SIGTERM) to a
+  live worker OS process at `at_s` clock seconds into the run. Unlike
+  the simulated kinds above, this one is not injected into a
+  scheduler: the fleet-side `FleetFaultDriver` delivers it through the
+  supervisor (serve/supervisor.py) to the replica's current pid. The
+  sim `crash` path stays for FakeClock determinism; `kill` is the one
+  that proves the failover story against actual process death
+  (SIGKILL: no goodbye, in-flight decode lost; SIGSTOP: the process is
+  alive but silent — the stale-heartbeat detection path).
 
 Wiring: the injector is an optional `fault_hook` on Scheduler — one
 `is not None` check per tick when unset, so the production path pays
 nothing. Ticks are per-replica scheduler ticks (deterministic under
 FakeClock); crash windows are measured in clock seconds so a downed
-replica's recovery interacts with the breaker's probe backoff.
+replica's recovery interacts with the breaker's probe backoff. ``kill``
+specs are ignored by `injector()` — they target processes, not
+schedulers — and fire from `FleetFaultDriver.poll` instead.
 """
 
 from __future__ import annotations
@@ -46,18 +57,22 @@ class ReplicaCrashed(RuntimeError):
     """Raised out of Scheduler.step when an injected crash fires."""
 
 
-_KINDS = ("crash", "latency", "nan_logits", "admit_fail")
+_KINDS = ("crash", "latency", "nan_logits", "admit_fail", "kill")
+_SIGNALS = ("SIGKILL", "SIGSTOP", "SIGTERM")
 
 
 @dataclasses.dataclass(frozen=True)
 class FaultSpec:
     kind: str
-    tick: int                # per-replica scheduler tick (1-based)
+    tick: int = 1            # per-replica scheduler tick (1-based);
+    #                          unused by "kill" (which fires on at_s)
     replica: int = 0
     slot: int = 0            # nan_logits: which slot to poison
     delay_s: float = 0.0     # latency: stall length
     down_s: float = 0.0      # crash: clock time until probeable again
     #                          (0 = permanent)
+    at_s: float = 0.0        # kill: seconds into the run to deliver
+    sig: str = "SIGKILL"     # kill: which signal
 
     def __post_init__(self):
         if self.kind not in _KINDS:
@@ -65,6 +80,9 @@ class FaultSpec:
                              f"one of {_KINDS}")
         if self.tick < 1:
             raise ValueError("tick is 1-based (first Scheduler.step)")
+        if self.kind == "kill" and self.sig not in _SIGNALS:
+            raise ValueError(f"kill signal must be one of {_SIGNALS}, "
+                             f"got {self.sig!r}")
 
 
 class FaultPlan:
@@ -105,9 +123,17 @@ class FaultPlan:
     # ----------------------------------------------------------- wiring
     def injector(self, replica: int) -> Optional["FaultInjector"]:
         """The per-replica hook, or None (= zero scheduler overhead)
-        when no fault in the plan targets this replica."""
-        mine = [f for f in self.faults if f.replica == replica]
+        when no fault in the plan targets this replica. ``kill`` specs
+        are excluded — they are delivered to OS processes by the
+        fleet-side FleetFaultDriver, not raised inside a scheduler."""
+        mine = [f for f in self.faults
+                if f.replica == replica and f.kind != "kill"]
         return FaultInjector(mine) if mine else None
+
+    def kills(self) -> List[FaultSpec]:
+        """The real-signal specs, in firing order (FleetFaultDriver)."""
+        return sorted((f for f in self.faults if f.kind == "kill"),
+                      key=lambda f: f.at_s)
 
 
 class FaultInjector:
@@ -173,3 +199,38 @@ class FaultInjector:
             advance(delay_s)
         else:
             time.sleep(delay_s)
+
+
+class FleetFaultDriver:
+    """Fires a plan's ``kill`` specs at REAL worker processes.
+
+    `kill_fn(replica, sig_name)` is injected (the supervisor's `kill`,
+    which resolves the replica's CURRENT pid — a restarted worker has a
+    new one) so the firing logic is host-pure testable. `poll(elapsed)`
+    is called from the fleet's drive loop with seconds since the run
+    started; each spec fires exactly once, at the first poll at or
+    after its `at_s`. Misses are impossible by construction (a late
+    poll still fires everything due), which keeps a kill plan as
+    replayable as the simulated ones — modulo the OS scheduling the
+    run is there to expose.
+    """
+
+    def __init__(self, plan: FaultPlan, kill_fn) -> None:
+        self.pending: List[FaultSpec] = plan.kills()
+        self.kill_fn = kill_fn
+        self.fired: List[FaultSpec] = []
+
+    def poll(self, elapsed_s: float) -> List[FaultSpec]:
+        """Deliver every not-yet-fired kill with at_s <= elapsed_s;
+        returns the specs fired by THIS poll."""
+        fired_now: List[FaultSpec] = []
+        while self.pending and self.pending[0].at_s <= elapsed_s:
+            spec = self.pending.pop(0)
+            self.kill_fn(spec.replica, spec.sig)
+            fired_now.append(spec)
+        self.fired.extend(fired_now)
+        return fired_now
+
+    @property
+    def done(self) -> bool:
+        return not self.pending

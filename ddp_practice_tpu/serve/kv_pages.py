@@ -102,6 +102,12 @@ class BlockAllocator:
         self.num_blocks = num_blocks
         self._free: List[int] = list(range(1, num_blocks))
         self._refs: Dict[int, int] = {}
+        # optional refcount-transition hook: called as on_refcount(block,
+        # count) after every ref/free. The radix prefix cache subscribes
+        # to keep its evictable-blocks counter O(1) — a cached leaf flips
+        # between evictable and pinned exactly when its refcount crosses
+        # the 1 <-> 2 boundary, which only the allocator can see.
+        self.on_refcount = None
 
     def alloc(self, n: int = 1) -> Optional[List[int]]:
         """n blocks at refcount 1, or None if fewer than n are free
@@ -128,6 +134,8 @@ class BlockAllocator:
             if b not in self._refs:
                 raise ValueError(f"block {b} is not allocated")
             self._refs[b] += 1
+            if self.on_refcount is not None:
+                self.on_refcount(b, self._refs[b])
 
     def free(self, blocks: Sequence[int]) -> None:
         """Drop one holder per block; a block with no holders left
@@ -142,9 +150,12 @@ class BlockAllocator:
             if b not in self._refs:
                 raise ValueError(f"block {b} is not allocated")
             self._refs[b] -= 1
-            if self._refs[b] == 0:
+            count = self._refs[b]
+            if count == 0:
                 del self._refs[b]
                 self._free.append(b)
+            if self.on_refcount is not None:
+                self.on_refcount(b, count)
 
     def refcount(self, block: int) -> int:
         """Current holder count (0 = free; garbage block reads 0)."""
@@ -200,9 +211,49 @@ class RadixPrefixCache:
         self._nodes = 0
         self.hit_tokens = 0      # cumulative matched / recomputed token
         self.miss_tokens = 0     # counters (ServeMetrics exports deltas)
+        # O(1) evictable accounting: `_leaf_index` maps block -> its LEAF
+        # node (a block appears at most once in the tree — insert only
+        # ever refs a freshly allocated, caller-owned block), and
+        # `_evictable` is the subset whose allocator refcount is exactly
+        # 1 (the tree is the only holder). admit_gate probes evictable()
+        # on EVERY blocked admission; before this counter each probe
+        # walked the whole tree — linear in a big warm cache. Structural
+        # transitions (insert/evict) are maintained here; refcount
+        # transitions (a slot attaching to or releasing a cached block)
+        # arrive through the allocator's on_refcount hook.
+        self._leaf_index: Dict[int, _RadixNode] = {}
+        self._evictable: set = set()
+        allocator.on_refcount = self._on_refcount
 
     def __len__(self) -> int:
         return self._nodes
+
+    # ------------------------------------------ evictable bookkeeping
+    def _on_refcount(self, block: int, count: int) -> None:
+        """Allocator hook: a leaf's block crossed a refcount boundary.
+        count == 1 with the tree holding the block means evictable;
+        anything else (a slot still attends through it, or the block
+        is not a leaf/not cached) means not."""
+        if block in self._leaf_index:
+            if count == 1:
+                self._evictable.add(block)
+            else:
+                self._evictable.discard(block)
+
+    def _leaf_gained(self, node: "_RadixNode") -> None:
+        """`node` just became a leaf (inserted, or its last child was
+        evicted): index it and classify its evictability."""
+        if node is self._root:
+            return
+        self._leaf_index[node.block] = node
+        if self.allocator.refcount(node.block) == 1:
+            self._evictable.add(node.block)
+
+    def _leaf_lost(self, node: "_RadixNode") -> None:
+        """`node` is no longer a leaf (gained a child) or no longer in
+        the tree (evicted): drop it from the evictable accounting."""
+        self._leaf_index.pop(node.block, None)
+        self._evictable.discard(node.block)
 
     def _chunks(self, tokens: Sequence[int]):
         bs = self.block_size
@@ -297,10 +348,13 @@ class RadixPrefixCache:
                         "garbage block can never enter the prefix cache"
                     )
                 self.allocator.ref([b])
+                if not node.children:
+                    self._leaf_lost(node)  # interior now, not evictable
                 child = _RadixNode(chunk, b, node)
                 node.children[chunk] = child
                 self._nodes += 1
                 added += 1
+                self._leaf_gained(child)
             child.last_use = self._clock
             node = child
         return added
@@ -308,7 +362,16 @@ class RadixPrefixCache:
     def evictable(self) -> int:
         """Blocks `evict` could free right now: leaf-reachable nodes
         whose block has no holder beyond the tree. Admission gates count
-        these as available — evicting them is make_room's first move."""
+        these as available — evicting them is make_room's first move.
+        O(1): the counter is maintained incrementally (insert/evict
+        structural edges here, slot ref/deref edges via the allocator's
+        on_refcount hook) instead of walking the tree per probe."""
+        return len(self._evictable)
+
+    def _evictable_walk(self) -> int:
+        """The full-tree definition of `evictable()` — O(nodes). Kept as
+        the oracle the incremental counter is pinned against
+        (tests/test_kv_pages.py randomized op sequence)."""
         return sum(
             1 for n in self._iter_nodes()
             if not n.children and self.allocator.refcount(n.block) == 1
@@ -328,18 +391,21 @@ class RadixPrefixCache:
         skipped: evict-while-referenced cannot happen by construction.
         """
         freed = 0
-        while freed < n_blocks:
-            victims = [
-                n for n in self._iter_nodes()
-                if not n.children and self.allocator.refcount(n.block) == 1
-            ]
-            if not victims:
-                break
-            victims.sort(key=lambda n: n.last_use)
+        while freed < n_blocks and self._evictable:
+            # snapshot this round's victims from the incremental set (an
+            # eviction below may expose a parent — it joins the NEXT
+            # round, same order the full-walk loop gave)
+            victims = sorted(
+                (self._leaf_index[b] for b in self._evictable),
+                key=lambda n: n.last_use,
+            )
             for v in victims:
                 if freed >= n_blocks:
                     break
                 del v.parent.children[v.tokens]
+                self._leaf_lost(v)
+                if not v.parent.children:
+                    self._leaf_gained(v.parent)
                 self.allocator.free([v.block])
                 self._nodes -= 1
                 freed += 1

@@ -125,18 +125,65 @@ class _Tracked:
 
 
 class ReplicaHandle:
-    """One replica: engine + scheduler + gauges + health, as the router
-    sees it. The scheduler/engine pair is exactly the PR-1 single-replica
-    serving stack — the router composes, it does not reimplement."""
+    """One IN-PROCESS replica: engine + scheduler + gauges + health, as
+    the router sees it. The scheduler/engine pair is exactly the PR-1
+    single-replica serving stack — the router composes, it does not
+    reimplement.
+
+    This class also DEFINES the narrow replica interface the Router
+    drives — `submit` / `step` / `poll` / `evacuate` / `shed_queued`
+    (the Scheduler.submit / completions-watermark seam) plus the
+    load/capacity observables (`load`, `has_queue_space`, `max_slots`,
+    `queue_len`, `active`, `fits_prompt`) and lifecycle edges
+    (`probe_ok`, `restart`, `warmup`, `compile_stats`). The in-process
+    implementation is direct calls; serve/supervisor.py's
+    RemoteReplicaHandle implements the SAME interface over the
+    serve/rpc.py wire to a worker OS process — the router cannot tell
+    them apart, which is the whole point of the seam."""
 
     def __init__(self, rid: int, scheduler: Scheduler,
-                 breaker: BreakerConfig) -> None:
+                 breaker: BreakerConfig = BreakerConfig()) -> None:
         self.id = rid
         self.scheduler = scheduler
         self.engine: SlotEngine = scheduler.engine
         self.health = ReplicaHealth(breaker)
         self.consumed = 0  # completions watermark (survives restarts)
 
+    # --------------- the seam: submit down, completions watermark up
+    def submit(self, req: Request) -> None:
+        """Hand one (sub-)request to the replica. A shed/reject lands
+        as a completion in the next poll — never an exception."""
+        self.scheduler.submit(req)
+
+    def step(self) -> None:
+        """Advance the replica one tick. May raise ReplicaCrashed. A
+        remote replica self-steps; its step() is the heartbeat/poll."""
+        self.scheduler.step()
+
+    def poll(self) -> List[Completion]:
+        """Completions since the watermark (consume-once)."""
+        comps = self.scheduler.completions
+        new, self.consumed = comps[self.consumed:], len(comps)
+        return new
+
+    def evacuate(self) -> List[tuple]:
+        """(request, tokens_so_far, ftt, phases) for everything this
+        replica held — the failover harvest (Scheduler.evacuate)."""
+        return self.scheduler.evacuate()
+
+    def shed_queued(self, min_priority: int) -> List[int]:
+        """Shed queued requests with priority >= min_priority (the
+        brown-out lever); returns their rids. The shed completions are
+        consumed HERE (watermark advanced): the router finalizes from
+        the returned rids, so replaying them from poll() would
+        double-book — worse, the rid may have been reused by then."""
+        shed = self.scheduler.shed_queued(
+            lambda r: r.priority >= min_priority
+        )
+        self.consumed = len(self.scheduler.completions)
+        return [r.rid for r in shed]
+
+    # ------------------------------------------------- observables
     @property
     def load(self) -> float:
         """Least-loaded dispatch signal: queue depth + occupied slots,
@@ -153,6 +200,28 @@ class ReplicaHandle:
     def has_queue_space(self) -> bool:
         return len(self.scheduler.queue) < self.scheduler.max_queue
 
+    @property
+    def max_slots(self) -> int:
+        return self.engine.config.max_slots
+
+    @property
+    def queue_len(self) -> int:
+        return len(self.scheduler.queue)
+
+    @property
+    def active(self) -> int:
+        return self.engine.num_active
+
+    def fits_prompt(self, n_tokens: int) -> bool:
+        """Can a prompt of n_tokens prefill here (any bucket holds it,
+        counting a warm prefix where the engine supports one)?"""
+        try:
+            self.engine.bucket_for(n_tokens)
+            return True
+        except ValueError:
+            return False
+
+    # --------------------------------------------------- lifecycle
     def probe_ok(self, now: float) -> bool:
         """Half-open probe: is the replica reachable again? With an
         injected fault plan the answer is the plan's crash window; a
@@ -174,17 +243,35 @@ class ReplicaHandle:
         if inj is not None:
             inj.revive()
 
+    def warmup(self, widths: Optional[Sequence[int]] = None) -> None:
+        """Compile this replica's programs outside any timed window
+        (engine.warm_engine — the one recipe workers also use)."""
+        from ddp_practice_tpu.serve.engine import warm_engine
+
+        warm_engine(self.engine, widths)
+
+    def compile_stats(self) -> dict:
+        return self.engine.compile_stats()
+
 
 class Router:
     """Least-loaded, health-checked dispatch over a replica fleet."""
 
-    def __init__(self, schedulers: Sequence[Scheduler], *, clock=None,
+    def __init__(self, schedulers: Sequence, *, clock=None,
                  config: RouterConfig = RouterConfig(),
                  metrics: Optional[RouterMetrics] = None,
                  tracer=None, slo=None, telemetry=None) -> None:
+        """`schedulers` is the replica fleet: Scheduler objects (the
+        in-process fleet — wrapped in ReplicaHandle here) and/or
+        prebuilt handle objects implementing ReplicaHandle's replica
+        interface (serve/supervisor.py RemoteReplicaHandle for worker
+        OS processes). The router owns breaker POLICY either way: it
+        (re)arms each handle's ReplicaHealth from its own config."""
         if not schedulers:
             raise ValueError("need at least one replica")
-        self.clock = clock or schedulers[0].clock
+        self.clock = clock or getattr(schedulers[0], "clock", None)
+        if self.clock is None:
+            raise ValueError("pass clock= when building from handles")
         self.config = config
         self.metrics = metrics or RouterMetrics()
         self.tracer = tracer
@@ -198,17 +285,22 @@ class Router:
         self.telemetry = telemetry
         if tracer is not None:
             label_router(tracer)
-        self.handles = [
-            ReplicaHandle(i, s, BreakerConfig(
+        self.handles = []
+        for i, item in enumerate(schedulers):
+            bcfg = BreakerConfig(
                 trip_after=config.trip_after,
                 probe_base_s=config.probe_base_s,
                 probe_factor=config.probe_factor,
                 probe_max_s=config.probe_max_s,
                 probe_jitter=config.probe_jitter,
                 seed=config.seed + i,
-            ))
-            for i, s in enumerate(schedulers)
-        ]
+            )
+            if isinstance(item, Scheduler):
+                h = ReplicaHandle(i, item, bcfg)
+            else:
+                h = item
+                h.health = ReplicaHealth(bcfg)
+            self.handles.append(h)
         self.tracked: Dict[int, _Tracked] = {}
         self.completions: List[Completion] = []
         self.brownout = False
@@ -288,9 +380,7 @@ class Router:
         ))
         req = tr.req
         if tr.prefix:
-            try:
-                h.engine.bucket_for(len(req.prompt) + len(tr.prefix))
-            except ValueError:
+            if not h.fits_prompt(len(req.prompt) + len(tr.prefix)):
                 # prompt+prefix outgrew every prefill bucket (a long
                 # generation migrated late): drop the salvage and
                 # regenerate from the original prompt — it fit once, it
@@ -322,7 +412,7 @@ class Router:
                 replica=h.id, attempt=tr.retries + tr.failovers,
                 salvaged=len(tr.prefix),
             )
-        h.scheduler.submit(sub)
+        h.submit(sub)
         return True
 
     def _requeue(self, tr: _Tracked, delay_s: float) -> None:
@@ -350,7 +440,7 @@ class Router:
             if not h.health.alive:
                 continue
             try:
-                h.scheduler.step()
+                h.step()
             except ReplicaCrashed:
                 self._kill(h)
         for h in self.handles:
@@ -390,7 +480,7 @@ class Router:
         rec = self.tracer
         if rec is not None and rec.enabled:
             rec.instant("replica_dead", pid=ROUTER_PID, replica=h.id)
-        for req, tokens, ftt, phases in h.scheduler.evacuate():
+        for req, tokens, ftt, phases in h.evacuate():
             tr = self.tracked.get(req.rid)
             if tr is None or tr.done:
                 continue
@@ -414,10 +504,8 @@ class Router:
                 self._park_or_shed(tr)
 
     def _consume(self, h: ReplicaHandle) -> None:
-        comps = h.scheduler.completions
-        new, h.consumed = comps[h.consumed:], len(comps)
         now = self.clock.now()
-        for c in new:
+        for c in h.poll():
             tr = self.tracked.get(c.rid)
             if tr is None or tr.done:
                 continue  # e.g. brown-out sheds already finalized
@@ -508,10 +596,8 @@ class Router:
         asymmetry compose, so neither trigger can flap the mode."""
         cfg = self.config
         alive = self._alive()
-        slots = sum(h.engine.config.max_slots for h in alive)
-        work = sum(
-            len(h.scheduler.queue) + h.engine.num_active for h in alive
-        )
+        slots = sum(h.max_slots for h in alive)
+        work = sum(h.queue_len + h.active for h in alive)
         pressure = (work / slots) if slots else float("inf")
         self.metrics.fleet_pressure.set(min(pressure, 1e9))
         slo_burning = self.slo is not None and self.slo.active
@@ -526,24 +612,19 @@ class Router:
                                              if pressure >= cfg.brownout_on
                                              else "slo"))
             # shed low-priority WAITERS too, not just new arrivals — the
-            # queue backlog is exactly the overload being answered
+            # queue backlog is exactly the overload being answered.
+            # (shed_queued consumes its own sub-completions — replaying
+            # them from poll() would double-book against whatever
+            # request is tracked under the rid by then.)
             for h in alive:
-                for req in h.scheduler.shed_queued(
-                    lambda r: r.priority >= cfg.shed_priority
-                ):
-                    tr = self.tracked.get(req.rid)
+                for rid in h.shed_queued(cfg.shed_priority):
+                    tr = self.tracked.get(rid)
                     if tr is not None and not tr.done:
                         # slo_exempt: see submit() — the brown-out's own
                         # sheds must not burn the SLO that drives it
                         self._finalize(tr, list(tr.prefix), "shed",
                                        slo_exempt=True)
                         self.metrics.on_shed("brownout")
-                # the sheds just appended sub-completions we have already
-                # accounted for — advance the watermark NOW, or next
-                # tick's _consume would replay them against whatever
-                # request is tracked under the rid by then (the rid may
-                # have been reused after _finalize dropped it)
-                h.consumed = len(h.scheduler.completions)
         elif self.brownout and pressure <= cfg.brownout_off \
                 and not slo_burning:
             self.brownout = False
@@ -618,22 +699,13 @@ class Router:
         window: one admit per bucket width in play + one decode burst.
         After this, request churn (and failover re-prefills, which land
         in the same buckets) causes zero new compiles — the chaos tests
-        pin that via compile_stats()."""
+        pin that via compile_stats(). (Worker processes warm themselves
+        before signalling ready — their handle's warmup is a no-op.)"""
         for h in self.handles:
-            eng = h.engine
-            for w in widths or eng.buckets:
-                # budget only the one warmup burst: a paged replica's
-                # default admit reserves its whole per-slot capacity,
-                # which an oversubscribed block pool can't cover even
-                # though the gated scheduler path serves it fine
-                slot = eng.admit([1] * w,
-                                 max_positions=eng.config.decode_burst)
-                eng.step_burst()
-                eng.release(slot)
-            eng.reset_epoch()
+            h.warmup(widths)
 
     def compile_stats(self) -> Dict[int, dict]:
-        return {h.id: h.engine.compile_stats() for h in self.handles}
+        return {h.id: h.compile_stats() for h in self.handles}
 
     def states(self) -> Dict[int, str]:
         return {h.id: h.health.state.value for h in self.handles}

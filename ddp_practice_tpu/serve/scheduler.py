@@ -586,40 +586,56 @@ class Scheduler:
         self.queue = kept
         return shed
 
-    def evacuate(self) -> List[tuple]:
-        """Pull every queued and in-flight request off this scheduler —
-        the failover harvest after a crash. Returns (request,
-        tokens_so_far, first_token_time, phases) tuples; tokens_so_far
-        were already read back to the host before the crash, so the
-        router can re-admit prompt+tokens on a surviving replica.
-        `phases` is the attempt's flight-record fragment (queue_s /
-        prefill_s / decode_s up to the evacuation edge) — no Completion
-        is ever appended for an evacuated attempt, so without this the
-        pre-crash work would be misreported as stall time. Touches no
-        device state (the replica may be gone); `restart()` on the
-        handle resets the engine when the replica comes back."""
+    def inflight_snapshot(self) -> List[tuple]:
+        """Non-destructive view of every queued and running request:
+        (request, tokens_so_far, first_token_time, phases) — the same
+        tuples `evacuate` harvests, WITHOUT clearing anything. The
+        cross-process worker (serve/worker.py) ships this per poll so
+        the router always holds a recent salvage point: when the worker
+        is later SIGKILLed there is no scheduler left to evacuate, and
+        the last snapshot is what failover re-admits on a survivor
+        (prompt + tokens-so-far, token-identical under greedy)."""
         now = self.clock.now()
         out = []
         for st in self.running.values():
-            prior = self._resume.pop(st.req.rid, None)
+            prior = self._resume.get(st.req.rid)
             req, toks, ftt = st.req, st.tokens, st.first_token_time
             if prior is not None:
                 # a running CONTINUATION of a preempted request: hand
-                # the router the ORIGINAL request with all tokens so
+                # the caller the ORIGINAL request with all tokens so
                 # far, not the synthetic prompt+prefix one
                 req = prior["orig"]
                 toks = prior["prefix"] + toks
                 ftt = prior["ftt"] if prior["ftt"] is not None else ftt
-            out.append((req, toks, ftt,
+            out.append((req, list(toks), ftt,
                         _attempt_phases(st.req, now,
                                         (st.admit_t0, st.admit_t1))))
         for req in self.queue:
-            prior = self._resume.pop(req.rid, None)
+            prior = self._resume.get(req.rid)
             if prior is not None:
-                out.append((prior["orig"], prior["prefix"], prior["ftt"],
+                out.append((prior["orig"], list(prior["prefix"]),
+                            prior["ftt"],
                             _attempt_phases(req, now, None)))
             else:
                 out.append((req, [], None, _attempt_phases(req, now, None)))
+        return out
+
+    def evacuate(self) -> List[tuple]:
+        """Pull every queued and in-flight request off this scheduler —
+        the failover harvest after a crash. Returns the
+        `inflight_snapshot` tuples; tokens_so_far were already read
+        back to the host before the crash, so the router can re-admit
+        prompt+tokens on a surviving replica. `phases` is the attempt's
+        flight-record fragment (queue_s / prefill_s / decode_s up to
+        the evacuation edge) — no Completion is ever appended for an
+        evacuated attempt, so without this the pre-crash work would be
+        misreported as stall time. Touches no device state (the replica
+        may be gone); `restart()` on the handle resets the engine when
+        the replica comes back."""
+        out = self.inflight_snapshot()
+        # every live rid is in queue/running, so their _resume entries
+        # (already folded into the snapshot) go with them
+        self._resume.clear()
         self.running.clear()
         self.queue.clear()
         return out

@@ -1,0 +1,889 @@
+"""Supervisor: worker-process lifecycles for the cross-process fleet.
+
+The in-process router's "restart" was a lie a real fleet can't tell:
+`ReplicaHandle.restart()` reused the same Python objects, so every
+recovery the chaos suite proved was a simulated one. This module owns
+REAL lifecycles:
+
+- **spawn**: `python -m ddp_practice_tpu.serve.worker --spec @file` with
+  stdout routed to a log file; the supervisor tails the log for the
+  ``WORKER_READY`` line (ports + pid), connects the RPC client, and
+  health-probes it — a worker is only ever visible to dispatch warm and
+  answering.
+- **liveness**: `poll()` waitpid-checks every child (a SIGKILLed worker
+  is seen the tick after it dies) — heartbeat staleness (the SIGSTOP
+  case: alive but silent) is judged by the RemoteReplicaHandle, which
+  owns the RPC cadence and puts the zombie down with a real SIGKILL
+  before failing over.
+- **restart with backoff + budget**: a dead slot respawns after
+  utils/backoff.py delays (exponential, capped, per-slot seeded); after
+  `restart_budget` restarts the slot's circuit breaks to FAILED — a
+  crash-looping replica must page an operator, not burn CPU forever.
+  Respawns run on a background thread: a surviving fleet keeps serving
+  through a ~15 s jax-import+compile, it does not stop to watch.
+- **graceful drain on stop()**: RPC ``shutdown`` first, then SIGTERM,
+  then SIGKILL, then ALWAYS waitpid — no test run ever leaks a child.
+
+Every spawned pid is registered in a module-level table with an atexit
+reaper (`reap_all`), and tests add a session-scoped fixture on top
+(tests/conftest.py) so even a SIGSTOPped orphan cannot outlive — or
+hang — a pytest run.
+
+`RemoteReplicaHandle` is the router-facing half: the same narrow
+replica interface as serve/router.py's in-process ReplicaHandle
+(`submit`/`step`/`poll`/`evacuate`/`shed_queued` + observables), spoken
+over serve/rpc.py. Its `step()` is the heartbeat: one watermark poll
+that also refreshes the SALVAGE POINT — each outstanding request's
+tokens-so-far — so a later SIGKILL re-admits prompt+tokens on a
+survivor exactly like the PR-2 in-process failover (token-identical
+under greedy, original trace_id preserved).
+"""
+
+from __future__ import annotations
+
+import atexit
+import dataclasses
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from ddp_practice_tpu.serve.faults import ReplicaCrashed
+from ddp_practice_tpu.serve.health import ReplicaHealth
+from ddp_practice_tpu.serve.rpc import (
+    RpcClient,
+    RpcError,
+    RpcRemoteError,
+    open_stream,
+)
+from ddp_practice_tpu.serve.scheduler import (
+    Completion,
+    MonotonicClock,
+    Request,
+)
+from ddp_practice_tpu.serve.worker import READY_PREFIX, WorkerSpec
+from ddp_practice_tpu.utils.backoff import backoff_delay
+
+# ------------------------------------------------------------ pid registry
+# every child this module ever spawns, alive until explicitly reaped —
+# the belt under the supervisor's own bookkeeping. tests/conftest.py's
+# session fixture asserts this drains; atexit is the suspenders.
+_CHILDREN: Dict[int, subprocess.Popen] = {}
+_CHILDREN_LOCK = threading.Lock()
+
+
+def _register_child(proc: subprocess.Popen) -> None:
+    with _CHILDREN_LOCK:
+        _CHILDREN[proc.pid] = proc
+
+
+def _unregister_child(pid: int) -> None:
+    with _CHILDREN_LOCK:
+        _CHILDREN.pop(pid, None)
+
+
+def live_worker_pids() -> List[int]:
+    """Registered children still running (reaped ones drop out)."""
+    with _CHILDREN_LOCK:
+        procs = list(_CHILDREN.values())
+    return [p.pid for p in procs if p.poll() is None]
+
+
+def reap_all() -> List[int]:
+    """SIGKILL + waitpid every still-live registered child; returns the
+    pids that were alive (= leaked — a clean run returns []). SIGKILL
+    works on SIGSTOPped processes too, which is the whole point: a
+    stopped orphan would otherwise hang any wait()er forever."""
+    with _CHILDREN_LOCK:
+        procs = list(_CHILDREN.values())
+    leaked = []
+    for p in procs:
+        if p.poll() is None:
+            leaked.append(p.pid)
+            try:
+                p.kill()
+            except OSError:
+                pass
+            try:
+                p.wait(timeout=5.0)
+            except subprocess.TimeoutExpired:
+                pass
+        _unregister_child(p.pid)
+    return leaked
+
+
+atexit.register(reap_all)
+
+
+# ---------------------------------------------------------------- spawning
+class SpawnedWorker:
+    """One live worker process attempt: Popen + ready info + RPC client."""
+
+    def __init__(self, proc: subprocess.Popen, ready: dict,
+                 client: RpcClient, log_path: str,
+                 spec_path: str) -> None:
+        self.proc = proc
+        self.pid = proc.pid
+        self.rpc_port = ready["rpc_port"]
+        self.telemetry_port = ready["telemetry_port"]
+        self.client = client
+        self.log_path = log_path
+        self._spec_path = spec_path
+
+    def poll(self) -> Optional[int]:
+        """None while running, else the exit code (waitpid, WNOHANG)."""
+        return self.proc.poll()
+
+    def kill_signal(self, sig: str) -> None:
+        os.kill(self.pid, getattr(signal, sig))
+
+    def reap(self, timeout_s: float = 5.0) -> None:
+        """Ensure the process is collected and the registry is clean."""
+        self.client.close()
+        if self.proc.poll() is None:
+            try:
+                self.proc.kill()
+            except OSError:
+                pass
+        try:
+            self.proc.wait(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            pass
+        _unregister_child(self.pid)
+        try:
+            os.unlink(self._spec_path)
+        except OSError:
+            pass
+
+
+def spawn_worker(spec: WorkerSpec, *, log_dir: Optional[str] = None,
+                 ready_timeout_s: float = 300.0,
+                 rpc_timeout_s: float = 5.0) -> SpawnedWorker:
+    """Spawn one worker process and block until it is READY and
+    answering pings (raises RuntimeError with the log tail otherwise).
+    stdout/stderr go to a LOG FILE, not a pipe — a chatty worker can
+    never deadlock against a parent that stopped reading."""
+    log_dir = log_dir or tempfile.mkdtemp(prefix="ddp_worker_")
+    os.makedirs(log_dir, exist_ok=True)
+    fd, spec_path = tempfile.mkstemp(
+        suffix=".json", prefix=f"spec_r{spec.replica}_", dir=log_dir
+    )
+    with os.fdopen(fd, "w") as f:
+        f.write(spec.to_json())
+    log_path = os.path.join(
+        log_dir, f"worker_r{spec.replica}_{int(time.time()*1e3)}.log"
+    )
+    log_fh = open(log_path, "wb")
+    try:
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ddp_practice_tpu.serve.worker",
+             "--spec", "@" + spec_path],
+            stdout=log_fh, stderr=subprocess.STDOUT,
+        )
+    finally:
+        log_fh.close()  # the child holds its own descriptor
+    _register_child(proc)
+    ready = None
+    deadline = time.monotonic() + ready_timeout_s
+    while time.monotonic() < deadline:
+        try:
+            with open(log_path, errors="replace") as f:
+                for line in f:
+                    if line.startswith(READY_PREFIX):
+                        ready = json.loads(line[len(READY_PREFIX):])
+                        break
+        except OSError:
+            pass
+        if ready is not None:
+            break
+        if proc.poll() is not None:
+            break
+        time.sleep(0.1)
+    if ready is None:
+        rc = proc.poll()
+        tail = ""
+        try:
+            with open(log_path, errors="replace") as f:
+                tail = f.read()[-2000:]
+        except OSError:
+            pass
+        # never leave a half-booted child behind
+        try:
+            proc.kill()
+        except OSError:
+            pass
+        try:
+            proc.wait(timeout=5.0)
+        except subprocess.TimeoutExpired:
+            pass
+        _unregister_child(proc.pid)
+        raise RuntimeError(
+            f"worker {spec.replica} never became ready "
+            f"(rc={rc}); log tail:\n{tail}"
+        )
+    client = RpcClient("127.0.0.1", ready["rpc_port"],
+                       timeout_s=rpc_timeout_s, seed=spec.replica)
+    # the health probe: ready AND answering before anyone dispatches
+    client.call("ping", timeout_s=rpc_timeout_s)
+    return SpawnedWorker(proc, ready, client, log_path, spec_path)
+
+
+# --------------------------------------------------------------- supervisor
+@dataclasses.dataclass(frozen=True)
+class SupervisorConfig:
+    # restart backoff schedule (per slot, utils/backoff.py)
+    restart_base_s: float = 0.25
+    restart_factor: float = 2.0
+    restart_max_s: float = 10.0
+    restart_jitter: float = 0.0
+    seed: int = 0
+    # restart-budget circuit breaker: after this many restarts a slot
+    # goes FAILED for good (operator territory — a crash loop must not
+    # burn the machine forever). Counts spawn FAILURES too.
+    restart_budget: int = 5
+    # how long a spawn may take to reach READY (jax import + compile)
+    ready_timeout_s: float = 300.0
+    rpc_timeout_s: float = 5.0
+    # stop(): how long to wait after a graceful rpc shutdown before
+    # escalating to SIGTERM, then SIGKILL
+    drain_timeout_s: float = 5.0
+
+
+# slot states
+RUNNING = "running"
+BACKOFF = "backoff"      # dead, respawn scheduled at _next_at
+SPAWNING = "spawning"    # respawn in flight on the spawn thread
+FAILED = "failed"        # restart budget exhausted — breaker open
+STOPPED = "stopped"
+
+
+class Supervisor:
+    """Owns N worker slots: spawn, liveness, backoff restarts, drain.
+
+    `spawn_fn(spec)` is injectable (defaults to `spawn_worker`) so the
+    restart state machine is host-pure testable with fakes;
+    `spawn_in_thread=False` makes respawns synchronous inside `poll()`
+    for deterministic tests (the default keeps the fleet serving while
+    a replacement compiles)."""
+
+    def __init__(self, specs: List[WorkerSpec],
+                 config: SupervisorConfig = SupervisorConfig(), *,
+                 spawn_fn: Optional[Callable] = None,
+                 spawn_in_thread: bool = True,
+                 clock=None) -> None:
+        self.specs = list(specs)
+        self.config = config
+        self.spawn_fn = spawn_fn or self._default_spawn
+        self.spawn_in_thread = spawn_in_thread
+        self.clock = clock or MonotonicClock()
+        self._log_dir = None  # lazily created by _default_spawn
+        n = len(specs)
+        self.workers: List[Optional[object]] = [None] * n
+        self.states: List[str] = [STOPPED] * n
+        self.restarts: List[int] = [0] * n    # lifetime restarts/slot
+        self._next_at: List[float] = [0.0] * n
+        self._spawn_threads: List[Optional[threading.Thread]] = [None] * n
+        self._spawn_results: List[Optional[tuple]] = [None] * n
+        self._lock = threading.Lock()
+
+    def _default_spawn(self, spec: WorkerSpec):
+        if self._log_dir is None:
+            self._log_dir = tempfile.mkdtemp(prefix="ddp_fleet_")
+        return spawn_worker(
+            spec, log_dir=self._log_dir,
+            ready_timeout_s=self.config.ready_timeout_s,
+            rpc_timeout_s=self.config.rpc_timeout_s,
+        )
+
+    # ----------------------------------------------------------- lifecycle
+    def start(self) -> None:
+        """Spawn every slot, synchronously (first boot is setup, not
+        serving — the fleet exists only once all replicas are warm)."""
+        for slot in range(len(self.specs)):
+            self.workers[slot] = self.spawn_fn(self.specs[slot])
+            self.states[slot] = RUNNING
+
+    def worker(self, slot: int):
+        """The slot's CURRENT process (None while down) — callers must
+        re-resolve per use; a restarted slot has a new pid/client."""
+        return self.workers[slot] if self.states[slot] == RUNNING else None
+
+    def alive(self, slot: int) -> bool:
+        return self.states[slot] == RUNNING
+
+    def state(self, slot: int) -> str:
+        return self.states[slot]
+
+    def kill(self, slot: int, sig: str = "SIGKILL") -> None:
+        """Deliver a REAL signal to the slot's current process (the
+        chaos driver's kill_fn, and the handle's stale-heartbeat
+        put-down). No-op when the slot is already down."""
+        if not 0 <= slot < len(self.workers):
+            raise ValueError(
+                f"kill targets slot {slot}; this fleet has "
+                f"{len(self.workers)} (a kill plan naming a replica "
+                f"the fleet doesn't have is a plan bug)"
+            )
+        w = self.workers[slot]
+        if w is not None and w.poll() is None:
+            w.kill_signal(sig)
+
+    # ------------------------------------------------------ the state loop
+    def poll(self, now: Optional[float] = None) -> None:
+        """One liveness pass: waitpid every RUNNING slot (dead ->
+        schedule restart with backoff, or FAILED past the budget),
+        launch due respawns, collect finished spawn attempts."""
+        now = self.clock.now() if now is None else now
+        with self._lock:
+            for slot in range(len(self.specs)):
+                st = self.states[slot]
+                if st == RUNNING:
+                    w = self.workers[slot]
+                    if w is None or w.poll() is not None:
+                        self._on_death(slot, now)
+                elif st == BACKOFF and now >= self._next_at[slot]:
+                    self._begin_spawn(slot, now)
+                elif st == SPAWNING:
+                    self._collect_spawn(slot, now)
+
+    def _on_death(self, slot: int, now: float) -> None:
+        w = self.workers[slot]
+        if w is not None:
+            w.reap()
+        self.workers[slot] = None
+        if self.restarts[slot] >= self.config.restart_budget:
+            # the restart-budget circuit breaker: slot is done
+            self.states[slot] = FAILED
+            return
+        c = self.config
+        delay = backoff_delay(
+            self.restarts[slot], base_s=c.restart_base_s,
+            factor=c.restart_factor, max_s=c.restart_max_s,
+            jitter=c.restart_jitter, seed=c.seed + slot,
+        )
+        self.restarts[slot] += 1
+        self._next_at[slot] = now + delay
+        self.states[slot] = BACKOFF
+
+    def _begin_spawn(self, slot: int, now: float) -> None:
+        self.states[slot] = SPAWNING
+        self._spawn_results[slot] = None
+
+        def attempt():
+            try:
+                self._spawn_results[slot] = ("ok",
+                                             self.spawn_fn(self.specs[slot]))
+            except BaseException as e:
+                self._spawn_results[slot] = ("err", e)
+
+        if self.spawn_in_thread:
+            t = threading.Thread(
+                target=attempt, name=f"spawn-w{slot}", daemon=True
+            )
+            t.start()
+            self._spawn_threads[slot] = t
+        else:
+            attempt()
+            self._collect_spawn(slot, now)
+
+    def _collect_spawn(self, slot: int, now: float) -> None:
+        res = self._spawn_results[slot]
+        if res is None:
+            return  # still compiling/importing on the spawn thread
+        self._spawn_results[slot] = None
+        self._spawn_threads[slot] = None
+        kind, val = res
+        if kind == "ok":
+            self.workers[slot] = val
+            self.states[slot] = RUNNING
+        else:
+            # a failed spawn consumes restart budget like a death —
+            # a spec that cannot boot must trip the breaker, not spin
+            self.states[slot] = RUNNING  # let _on_death do the math
+            self.workers[slot] = None
+            self._on_death(slot, now)
+
+    # -------------------------------------------------------------- stop
+    def stop(self) -> None:
+        """Graceful drain: rpc shutdown -> wait -> SIGTERM -> SIGKILL ->
+        ALWAYS waitpid. Also joins any in-flight spawn attempt and
+        reaps its result, so no child survives a stop() however
+        mid-restart it was called."""
+        with self._lock:
+            for slot, t in enumerate(self._spawn_threads):
+                if t is not None:
+                    t.join(timeout=self.config.ready_timeout_s)
+                    self._collect_spawn(slot, self.clock.now())
+            for slot in range(len(self.specs)):
+                w = self.workers[slot]
+                self.states[slot] = STOPPED
+                self.workers[slot] = None
+                if w is None:
+                    continue
+                try:
+                    w.client.call("shutdown", timeout_s=2.0, retries=0)
+                except (RpcError, RpcRemoteError):
+                    pass
+                try:
+                    w.proc.wait(timeout=self.config.drain_timeout_s)
+                except (subprocess.TimeoutExpired, AttributeError):
+                    if w.poll() is None:
+                        try:
+                            w.kill_signal("SIGTERM")
+                            w.proc.wait(timeout=2.0)
+                        except (subprocess.TimeoutExpired, OSError,
+                                AttributeError):
+                            pass
+                w.reap()
+
+    def __enter__(self) -> "Supervisor":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+# ------------------------------------------------------ router-facing handle
+_ZERO_PHASES = {"queue_s": 0.0, "prefill_s": 0.0, "decode_s": 0.0}
+
+
+class RemoteReplicaHandle:
+    """serve/router.py's replica interface over the RPC wire.
+
+    `step()` is the heartbeat/watermark poll (fail-fast timeout, no
+    transport retries — staleness accounting judges); `submit` rides
+    the retry budget (the worker dedups by rid, so a replayed frame is
+    safe). Outstanding requests carry their last-polled tokens-so-far:
+    `evacuate()` after a real death hands the router the same
+    (request, tokens, ftt, phases) tuples the in-process scheduler
+    harvest gives, built from the last salvage point instead of a
+    scheduler that no longer exists."""
+
+    def __init__(self, slot: int, supervisor: Supervisor,
+                 spec: WorkerSpec, *, clock=None,
+                 heartbeat_timeout_s: float = 2.0,
+                 poll_timeout_s: float = 1.0,
+                 poll_interval_s: float = 0.005) -> None:
+        self.id = slot
+        self.supervisor = supervisor
+        self.spec = spec
+        self.clock = clock or supervisor.clock
+        self.health = ReplicaHealth()   # re-armed by the Router
+        self.heartbeat_timeout_s = heartbeat_timeout_s
+        self.poll_timeout_s = poll_timeout_s
+        # min spacing between heartbeat RPCs: the router ticks as fast
+        # as it can, but hammering the worker's lock with a poll per
+        # tick steals the very core the decode needs (measured: the
+        # unthrottled loop costs the fleet ~25% decode p50 on a 1-core
+        # box). Liveness (waitpid) is still checked EVERY step.
+        self.poll_interval_s = poll_interval_s
+        self._last_poll = -1e18
+        self._pub_version = None   # worker snapshot version (poll dedup)
+        # push stream (rpc.py FrameStream): the worker pushes every
+        # published snapshot; step() drains it without blocking, so
+        # steady-state completion delivery costs no round trips. The
+        # poll op demotes to a slow reconciliation heartbeat while the
+        # stream is up, and is the sole path when it is not.
+        self._stream = None
+        self.stream_poll_interval_s = 0.25
+        self.consumed = 0               # watermark into the CURRENT
+        #                                 process's completions list
+        self.outstanding: Dict[int, dict] = {}
+        self._pending: List[Completion] = []
+        # rids shed via shed_queued(): their worker-side sub-completions
+        # are already finalized by the router from the op's reply, so
+        # when they replay through the push stream / poll they must be
+        # DROPPED — the rid may have been legitimately reused by then
+        # (the same double-booking the in-process handle's watermark
+        # advance prevents)
+        self._shed_skip: set = set()
+        self._stats: dict = {}
+        self._last_heartbeat: Optional[float] = None
+        self._broken = False            # rpc failed since last step
+        buckets = spec.engine.get("prompt_buckets") or (8, 16, 32, 64)
+        self._max_bucket = max(buckets)
+        self._max_slots = spec.engine.get("max_slots", 4)
+        self._max_queue = spec.max_queue
+
+    # ------------------------------------------------------------ plumbing
+    def _client(self) -> Optional[RpcClient]:
+        w = self.supervisor.worker(self.id)
+        return w.client if w is not None else None
+
+    @staticmethod
+    def _request_dict(req: Request) -> dict:
+        return {
+            "rid": req.rid, "prompt": list(req.prompt),
+            "max_new_tokens": req.max_new_tokens,
+            "deadline": req.deadline, "seed": req.seed,
+            "arrival": req.arrival, "priority": req.priority,
+            "trace_id": req.trace_id,
+        }
+
+    @staticmethod
+    def _to_completion(d: dict) -> Completion:
+        return Completion(
+            rid=d["rid"], tokens=list(d["tokens"]), status=d["status"],
+            arrival=d["arrival"], finish=d["finish"],
+            ttft=d.get("ttft"), tpot=d.get("tpot"),
+            flight=d.get("flight"),
+        )
+
+    # ---------------- the seam: submit down, completions watermark up
+    def submit(self, req: Request) -> None:
+        if req.trace_id is None:
+            req.trace_id = f"r{req.rid}"
+        # track BEFORE the wire: if the call fails mid-flight the
+        # request is outstanding either way, and evacuate() re-admits
+        # it on a survivor (the worker-side dedup absorbs the case
+        # where the frame did land)
+        self.outstanding[req.rid] = {
+            "req": req, "tokens": [], "ftt": None,
+            "phases": dict(_ZERO_PHASES),
+        }
+        c = self._client()
+        if c is None:
+            self._broken = True
+            return
+        try:
+            r = c.call("submit", request=self._request_dict(req))
+        except (RpcError, RpcRemoteError):
+            self._broken = True
+            return
+        if not r.get("accepted", False):
+            # refused at the door (a draining worker): the request must
+            # not strand in `outstanding` with no completion ever coming
+            # — treat like a replica failure, so the next step() raises
+            # and the evacuation re-dispatches it on a survivor
+            self._broken = True
+
+    def _apply_snapshot(self, *, version, from_wm, completions, upto,
+                        inflight, stats) -> None:
+        """Fold one published worker snapshot (push frame or poll
+        reply) into client state. `from_wm` is where the payload's
+        completion slice starts — anything below our own watermark is a
+        replay (stream/poll overlap) and is skipped, never re-pended."""
+        self._pub_version = version
+        if upto > self.consumed:
+            start = max(0, self.consumed - from_wm)
+            for d in completions[start:]:
+                if d["rid"] in self._shed_skip:
+                    self._shed_skip.discard(d["rid"])
+                    continue  # already finalized from the shed reply
+                self._pending.append(self._to_completion(d))
+            self.consumed = upto
+        for item in inflight:
+            st = self.outstanding.get(item["rid"])
+            if st is not None:
+                st["tokens"] = list(item["tokens"])
+                st["ftt"] = item["ftt"]
+                st["phases"] = {
+                    k: item["phases"].get(k, 0.0) for k in _ZERO_PHASES
+                }
+        if stats is not None:
+            self._stats = stats
+
+    def _ensure_stream(self) -> None:
+        if self._stream is not None:
+            return
+        w = self.supervisor.worker(self.id)
+        port = getattr(w, "rpc_port", None)  # fakes have no stream plane
+        if port is None:
+            return
+        try:
+            self._stream = open_stream(
+                "127.0.0.1", port, watermark=self.consumed,
+                timeout_s=self.poll_timeout_s,
+            )
+        except (RpcError, RpcRemoteError):
+            self._stream = None  # poll path carries on
+
+    def _drop_stream(self) -> None:
+        if self._stream is not None:
+            self._stream.close()
+            self._stream = None
+
+    def step(self) -> None:
+        """Heartbeat + completion intake + salvage refresh. Fast path:
+        drain the push stream (no blocking, no round trips); slow path:
+        the poll op, per `poll_interval_s` when the stream is down and
+        per `stream_poll_interval_s` as reconciliation when it is up.
+        Raises ReplicaCrashed on process death, on a broken submit, or
+        when heartbeats stayed stale past the budget (the SIGSTOP case
+        — after SIGKILLing the silent process so the supervisor's
+        waitpid sees a real corpse and schedules the restart)."""
+        now = self.clock.now()
+        self.supervisor.poll(now)
+        if not self.supervisor.alive(self.id):
+            raise ReplicaCrashed(f"worker {self.id}: process down")
+        if self._broken:
+            self._broken = False
+            raise ReplicaCrashed(f"worker {self.id}: rpc failed")
+        self._ensure_stream()
+        if self._stream is not None:
+            try:
+                frames = self._stream.drain()
+            except RpcError:
+                self._drop_stream()
+                frames = []
+            for f in frames:
+                self._last_heartbeat = now
+                if f.get("kind") == "pub":
+                    self._apply_snapshot(
+                        version=f.get("version"), from_wm=f["from"],
+                        completions=f["completions"],
+                        upto=f["watermark"], inflight=f["inflight"],
+                        stats=f["stats"],
+                    )
+        interval = (self.stream_poll_interval_s
+                    if self._stream is not None
+                    else self.poll_interval_s)
+        if now - self._last_poll < interval:
+            return  # stream current / throttled; liveness was checked
+        self._last_poll = now
+        c = self._client()
+        sent_wm = self.consumed
+        try:
+            r = c.call("poll", watermark=sent_wm,
+                       version=self._pub_version,
+                       timeout_s=self.poll_timeout_s, retries=0)
+        except (RpcError, RpcRemoteError):
+            hb = self._last_heartbeat
+            if hb is None:
+                self._last_heartbeat = hb = now
+            if now - hb > self.heartbeat_timeout_s:
+                # alive by waitpid but silent on the wire: put it down
+                # for real so restart machinery takes over
+                self.supervisor.kill(self.id, "SIGKILL")
+                raise ReplicaCrashed(
+                    f"worker {self.id}: heartbeat stale "
+                    f"({now - hb:.2f}s)"
+                )
+            return  # transient blip: skip the tick, keep the salvage
+        self._last_heartbeat = now
+        if r.get("unchanged"):
+            self._pub_version = r.get("version", self._pub_version)
+            return  # heartbeat only: salvage/stats still current
+        self._apply_snapshot(
+            version=r.get("version"), from_wm=sent_wm,
+            completions=r["completions"], upto=r["watermark"],
+            inflight=r["inflight"], stats=r["stats"],
+        )
+
+    def poll(self) -> List[Completion]:
+        out, self._pending = self._pending, []
+        for comp in out:
+            self.outstanding.pop(comp.rid, None)
+        return out
+
+    def evacuate(self) -> List[tuple]:
+        out = [
+            (st["req"], list(st["tokens"]), st["ftt"], st["phases"])
+            for st in self.outstanding.values()
+        ]
+        self.outstanding.clear()
+        return out
+
+    def shed_queued(self, min_priority: int) -> List[int]:
+        c = self._client()
+        if c is None:
+            return []
+        try:
+            r = c.call("shed", min_priority=min_priority)
+        except (RpcError, RpcRemoteError):
+            self._broken = True
+            return []
+        for rid in r["rids"]:
+            self.outstanding.pop(rid, None)
+            self._shed_skip.add(rid)
+        return list(r["rids"])
+
+    # ------------------------------------------------------- observables
+    @property
+    def load(self) -> float:
+        # `outstanding` is this handle's live work SYNCHRONOUSLY (the
+        # polled stats lag one heartbeat — a submit burst between polls
+        # would otherwise all pile onto the same replica)
+        return float(max(
+            len(self.outstanding),
+            self._stats.get("queue", 0) + self._stats.get("active", 0),
+        ))
+
+    @property
+    def has_queue_space(self) -> bool:
+        return len(self.outstanding) < self._max_queue + self._max_slots
+
+    @property
+    def max_slots(self) -> int:
+        return self._stats.get("max_slots", self._max_slots)
+
+    @property
+    def queue_len(self) -> int:
+        return self._stats.get("queue", 0)
+
+    @property
+    def active(self) -> int:
+        return self._stats.get("active", 0)
+
+    def fits_prompt(self, n_tokens: int) -> bool:
+        # conservative client-side mirror of engine.bucket_for — the
+        # client knows the spec's buckets (it wrote them)
+        return n_tokens <= self._max_bucket
+
+    def stream_fileno(self) -> Optional[int]:
+        """Push-stream fd for select()-driven drive loops (None while
+        the stream is down — callers fall back to a timed nap)."""
+        if self._stream is None:
+            return None
+        try:
+            return self._stream.fileno()
+        except OSError:
+            return None
+
+    def heartbeat_age(self, now: Optional[float] = None) -> Optional[float]:
+        if self._last_heartbeat is None:
+            return None
+        now = self.clock.now() if now is None else now
+        return max(0.0, now - self._last_heartbeat)
+
+    # --------------------------------------------------------- lifecycle
+    def probe_ok(self, now: float) -> bool:
+        """Health probe for re-admission: a NEW process exists AND
+        answers a ping. The router's breaker gates how often this runs
+        (half-open backoff)."""
+        self.supervisor.poll(now)
+        c = self._client()
+        if c is None:
+            return False
+        try:
+            c.call("ping", timeout_s=self.poll_timeout_s, retries=0)
+            return True
+        except (RpcError, RpcRemoteError):
+            return False
+
+    def restart(self) -> None:
+        """Join a freshly probed process. Usually that is a NEW
+        incarnation (fresh completions list -> watermark 0), but after
+        a transport-blip 'death' the SAME process may still be alive —
+        then the rpc `reset` drops its stale work (already
+        re-dispatched on survivors; letting it finish would
+        double-spend the engine) and hands back the completions
+        watermark, so the client resyncs instead of replaying the
+        whole history against possibly-reused rids. Heartbeat clock
+        restarts; outstanding was already evacuated at death."""
+        self.consumed = 0
+        c = self._client()
+        if c is not None:
+            try:
+                r = c.call("reset", timeout_s=self.poll_timeout_s,
+                           retries=0)
+                self.consumed = int(r.get("completions", 0))
+            except (RpcError, RpcRemoteError):
+                pass  # probe_ok just passed; a blip here resolves via
+                #       the normal poll path (worst case: a fresh
+                #       process replays nothing anyway)
+        self._stats = {}
+        self._pub_version = None   # a fresh process numbers its own
+        #                            snapshots — never alias the old one's
+        self._drop_stream()        # re-subscribes to the NEW process
+        self._shed_skip.clear()    # the old process's stream died with it
+        self._last_heartbeat = self.clock.now()
+        self._broken = False
+
+    def warmup(self, widths=None) -> None:
+        pass  # workers warm before READY; nothing to do from here
+
+    def compile_stats(self) -> dict:
+        return self._stats.get("compile_stats", {})
+
+
+# ------------------------------------------------------------ fleet builder
+def make_fleet_router(
+    base_spec: WorkerSpec,
+    n_workers: int,
+    *,
+    clock=None,
+    config=None,
+    sup_config: SupervisorConfig = SupervisorConfig(),
+    registry=None,
+    tracer=None,
+    slo=None,
+    telemetry=None,
+    heartbeat_timeout_s: float = 2.0,
+    spawn_fn: Optional[Callable] = None,
+):
+    """Spawn `n_workers` worker processes from `base_spec` (replica ids
+    stamped per slot) and build a Router over their RemoteReplicaHandles
+    — the cross-process mirror of serve/router.py `make_router`.
+    Returns (router, supervisor, handles); the caller owns
+    `supervisor.stop()` (use `with supervisor:`)."""
+    from ddp_practice_tpu.serve.metrics import RouterMetrics
+    from ddp_practice_tpu.serve.router import Router, RouterConfig
+
+    clock = clock or MonotonicClock()
+    specs = [
+        dataclasses.replace(base_spec, replica=i) for i in range(n_workers)
+    ]
+    supervisor = Supervisor(specs, sup_config, spawn_fn=spawn_fn,
+                            clock=clock)
+    supervisor.start()
+    handles = [
+        RemoteReplicaHandle(
+            i, supervisor, specs[i], clock=clock,
+            heartbeat_timeout_s=heartbeat_timeout_s,
+        )
+        for i in range(n_workers)
+    ]
+    router = Router(
+        handles, clock=clock, config=config or RouterConfig(),
+        metrics=RouterMetrics(registry), tracer=tracer,
+        slo=slo, telemetry=telemetry,
+    )
+    return router, supervisor, handles
+
+
+def make_federated_server(supervisor: Supervisor,
+                          handles: List["RemoteReplicaHandle"], *,
+                          port: int = 0, stale_after_s: float = 5.0):
+    """One fleet-level TelemetryServer over every worker's endpoints:
+    /metrics re-labels each worker's exposition with worker="N" plus
+    fleet_worker_up / heartbeat-age / restart series, /healthz renders
+    the verdict tools/check_fleet.py judges. Returns (federator,
+    server); caller owns server.close()."""
+    from ddp_practice_tpu.utils.telemetry import (
+        ScrapeFederator,
+        TelemetryServer,
+    )
+
+    fed = ScrapeFederator(
+        lambda: fleet_targets(supervisor, handles),
+        stale_after_s=stale_after_s,
+    )
+    server = TelemetryServer(registry=fed, healthz_fn=fed.healthz,
+                             port=port)
+    return fed, server
+
+
+def fleet_targets(supervisor: Supervisor,
+                  handles: List[RemoteReplicaHandle]) -> Dict[int, dict]:
+    """The scrape federator's view of the fleet: per slot, where the
+    worker's telemetry endpoints live and how fresh its heartbeat is
+    (utils/telemetry.py ScrapeFederator consumes this)."""
+    out: Dict[int, dict] = {}
+    for h in handles:
+        w = supervisor.worker(h.id)
+        out[h.id] = {
+            "host": "127.0.0.1",
+            "port": w.telemetry_port if w is not None else None,
+            "pid": w.pid if w is not None else None,
+            "up": w is not None,
+            "state": supervisor.state(h.id),
+            "restarts": supervisor.restarts[h.id],
+            "heartbeat_age_s": h.heartbeat_age(),
+        }
+    return out

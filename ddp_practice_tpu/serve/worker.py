@@ -1,0 +1,490 @@
+"""Worker process: one serving replica as a real OS process.
+
+`python -m ddp_practice_tpu.serve.worker --spec <json|@path>` boots a
+complete single-replica serving stack — its own single-process JAX
+runtime and devices, its own model/params (deterministic init from the
+spec, or a checkpoint), its own Scheduler + SlotEngine/PagedEngine —
+and serves two planes:
+
+- the serve/rpc.py seam (``submit`` / ``poll`` / ``ping`` / ``shed`` /
+  ``drain`` / ``shutdown``), cut at exactly Scheduler.submit and the
+  completions watermark, for the router in the supervisor process;
+- the PR-5 telemetry endpoints (``/metrics`` ``/healthz`` ``/flight``,
+  utils/telemetry.py TelemetryServer) for the fleet-level scrape
+  federator.
+
+The worker drives its own serve loop (a scheduler tick whenever work is
+queued) — the router does NOT tick remote replicas; its per-tick call
+is the heartbeat+watermark ``poll``. Every RPC op is IDEMPOTENT so the
+client may retry transport failures: submit dedups by rid, poll reads
+from a client-held watermark, ping/shed/drain repeat safely.
+
+Ready protocol: after the engine warms its prefill/decode programs, the
+worker prints one line ``WORKER_READY {json}`` (pid + bound ports) to
+stdout and flushes. The supervisor tails the worker's log file for that
+line — compile time is paid BEFORE the worker joins dispatch, so a
+restarted replica re-warms from scratch and rejoins only after a
+passing health probe, never cold.
+
+NOTE this is a plain OS process with single-process JAX — no
+jax.distributed rendezvous, no cross-process collectives (this image's
+CPU backend refuses them anyway, tests/mp_worker.py rc-77 probe).
+Workers share nothing but the RPC wire; params are replicated by
+construction (same spec, same PRNGKey init — or the same checkpoint),
+which is exactly the replicated-fleet contract the in-process router
+had. Sharded-params replicas (one logical replica spanning a mesh)
+remain a ROADMAP item.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+import threading
+import time
+from typing import Optional
+
+
+@dataclasses.dataclass
+class WorkerSpec:
+    """Everything a worker needs to become a replica, JSON-serializable
+    (passed on argv — the spec IS the replica's identity, so a
+    supervisor restart rebuilds a bit-identical one)."""
+
+    # model architecture kwargs (deterministic PRNGKey(0) init — every
+    # worker with the same spec holds byte-identical params)
+    model: dict = dataclasses.field(default_factory=dict)
+    # EngineConfig kwargs, plus "paged": true to build a PagedEngine
+    engine: dict = dataclasses.field(default_factory=dict)
+    replica: int = 0            # id in fleet telemetry / lane labels
+    max_queue: int = 64
+    rpc_port: int = 0           # 0 = ephemeral, reported in READY
+    telemetry_port: int = 0
+    warmup: bool = True
+    platform: str = "cpu"       # jax platform pin ("" = leave alone)
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self))
+
+    @classmethod
+    def from_json(cls, text: str) -> "WorkerSpec":
+        return cls(**json.loads(text))
+
+
+READY_PREFIX = "WORKER_READY "
+
+
+def build_model(model_kw: dict):
+    """The bench's tiny-LM recipe (serve/bench.py), spec-driven: same
+    kwargs + PRNGKey(0) init in every process -> replicated params."""
+    import jax
+    import jax.numpy as jnp
+
+    from ddp_practice_tpu.models import create_model
+
+    kw = dict(model_kw)
+    name = kw.pop("name", "lm_tiny")
+    model = create_model(name, **kw)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    return model, params
+
+
+class WorkerServer:
+    """The replica's in-process wiring: scheduler + engine behind the
+    RPC handlers, telemetry on the side, one lock serializing every
+    state mutation against the serve loop."""
+
+    def __init__(self, spec: WorkerSpec) -> None:
+        from ddp_practice_tpu.serve.engine import (
+            EngineConfig,
+            PagedEngine,
+            SlotEngine,
+        )
+        from ddp_practice_tpu.serve.metrics import ServeMetrics
+        from ddp_practice_tpu.serve.rpc import RpcServer
+        from ddp_practice_tpu.serve.scheduler import Scheduler
+        from ddp_practice_tpu.utils.metrics import MetricsRegistry
+        from ddp_practice_tpu.utils.telemetry import (
+            FlightStats,
+            TelemetryServer,
+        )
+
+        self.spec = spec
+        model, params = build_model(spec.model)
+        eng_kw = dict(spec.engine)
+        paged = bool(eng_kw.pop("paged", False))
+        if "prompt_buckets" in eng_kw:
+            eng_kw["prompt_buckets"] = tuple(eng_kw["prompt_buckets"])
+        cfg = EngineConfig(**eng_kw)
+        engine_cls = PagedEngine if paged else SlotEngine
+        self.engine = engine_cls(model, params, cfg)
+        self.registry = MetricsRegistry()
+        self.flight = FlightStats()
+        self.scheduler = Scheduler(
+            self.engine, max_queue=spec.max_queue,
+            metrics=ServeMetrics(self.registry),
+            telemetry=self.flight, replica=spec.replica,
+        )
+        # two-lock discipline so the RPC plane NEVER waits out a decode
+        # burst: `_lock` (the big one) serializes scheduler/engine
+        # mutation and is held across a whole step(); `_io_lock` guards
+        # only the intake list and the published snapshot, held for
+        # microseconds. submit appends to intake, poll reads the last
+        # published snapshot — both return in ~an RTT while the burst
+        # runs. (Measured: handler-behind-the-burst cost the RPC seam
+        # most of its latency overhead at 8 rps.)
+        self._lock = threading.Lock()
+        self._io_lock = threading.Lock()
+        self._intake: list = []
+        self._published: dict = {
+            "completions_len": 0, "inflight": [], "stats": None,
+        }
+        self._pub_version = 0
+        # push subscribers: [{"q": Queue, "watermark": int}] — _publish
+        # enqueues one frame per snapshot, the RpcServer push loop owns
+        # the socket. Queues are bounded; a slow/stuck subscriber drops
+        # frames (its poll path reconciles) rather than stalling steps.
+        self._subscribers: list = []
+        self._last_push = 0.0
+        self._last_pushed_upto = 0
+        self._stop = threading.Event()
+        self._wake = threading.Event()   # submit -> serve loop, no spin
+        self._draining = False
+        self._seen_rids: dict = {}   # rid -> accepted (submit dedup)
+        self._t0 = time.monotonic()
+        if spec.warmup:
+            self._warm()
+        with self._lock:
+            self._publish()   # ping/poll answer before the first step
+        # planes come up only after warmup: a worker is dispatchable
+        # the moment its ports are visible, so visible == warm
+        self.telemetry = TelemetryServer(
+            registry=self.registry,
+            health_fn=lambda: {spec.replica: "healthy"},
+            flight_fn=self.flight.report,
+            port=spec.telemetry_port,
+        )
+        self.rpc = RpcServer({
+            "ping": self._op_ping,
+            "submit": self._op_submit,
+            "poll": self._op_poll,
+            "subscribe": self._op_subscribe,
+            "reset": self._op_reset,
+            "shed": self._op_shed,
+            "drain": self._op_drain,
+            "shutdown": self._op_shutdown,
+        }, port=spec.rpc_port)
+
+    def _warm(self) -> None:
+        from ddp_practice_tpu.serve.engine import warm_engine
+
+        warm_engine(self.engine)
+
+    # ------------------------------------------------------------- ops
+    def _stats(self) -> dict:
+        return {
+            "replica": self.spec.replica,
+            "pid": os.getpid(),
+            "t": time.monotonic(),
+            "uptime_s": time.monotonic() - self._t0,
+            "queue": len(self.scheduler.queue),
+            "active": self.engine.num_active,
+            "max_slots": self.engine.config.max_slots,
+            "max_queue": self.scheduler.max_queue,
+            "completions": len(self.scheduler.completions),
+            "draining": self._draining,
+            # post-warmup these are CONSTANT under churn (the
+            # compile_guard invariant) — refreshed per publish anyway,
+            # it is two dict-len reads
+            "compile_stats": self.engine.compile_stats(),
+        }
+
+    def _op_ping(self, req: dict) -> dict:
+        with self._io_lock:
+            stats = self._published["stats"]
+        if stats is None:
+            with self._lock:
+                stats = self._stats()
+        return {"stats": stats}
+
+    def _op_submit(self, req: dict) -> dict:
+        from ddp_practice_tpu.serve.scheduler import Request
+
+        r = req["request"]
+        rid = r["rid"]
+        with self._io_lock:
+            if rid in self._seen_rids:
+                # transport-retry replay: answer what we answered
+                return {"accepted": self._seen_rids[rid], "dedup": True}
+            if self._draining:
+                self._seen_rids[rid] = False
+                return {"accepted": False, "draining": True}
+            # intake only — the serve loop drains into the scheduler at
+            # the top of its next iteration (exactly when an in-process
+            # scheduler would admit a just-queued request). A shed or
+            # reject still lands as a completion in a later poll.
+            self._intake.append(Request(
+                rid=rid,
+                prompt=list(r["prompt"]),
+                max_new_tokens=r.get("max_new_tokens", 32),
+                deadline=r.get("deadline"),
+                seed=r.get("seed", 0),
+                arrival=r.get("arrival"),
+                priority=r.get("priority", 0),
+                trace_id=r.get("trace_id"),
+            ))
+            self._seen_rids[rid] = True
+            # the dedup window only needs to outlive a transport retry
+            # (seconds) — cap the map so a long-lived worker doesn't
+            # retain every rid it ever served (dicts iterate in
+            # insertion order: the popped entries are the oldest)
+            while len(self._seen_rids) > 8192:
+                del self._seen_rids[next(iter(self._seen_rids))]
+        self._wake.set()
+        return {"accepted": True}
+
+    @staticmethod
+    def _completion_dict(c) -> dict:
+        return {
+            "rid": c.rid, "tokens": list(c.tokens), "status": c.status,
+            "arrival": c.arrival, "finish": c.finish,
+            "ttft": c.ttft, "tpot": c.tpot, "flight": c.flight,
+        }
+
+    def _publish(self) -> None:
+        """Snapshot scheduler state for the RPC plane — called by the
+        serve loop under the BIG lock after every mutation, read by
+        handlers under the io lock only. Completion dicts are built
+        lazily at read (the list is append-only; a published length
+        bounds what a poll may see)."""
+        inflight = [
+            {"rid": r.rid, "tokens": list(toks), "ftt": ftt,
+             "phases": phases}
+            for r, toks, ftt, phases in self.scheduler.inflight_snapshot()
+        ]
+        stats = self._stats()
+        comps = self.scheduler.completions
+        upto = len(comps)
+        with self._io_lock:
+            self._pub_version += 1
+            version = self._pub_version
+            self._published = {
+                "completions_len": upto,
+                "inflight": inflight,
+                "stats": stats,
+            }
+            subs = list(self._subscribers)
+        # push to subscribers only when a COMPLETION moved (the
+        # latency-critical event) or the 50 ms freshness beat is due:
+        # pushing every decode step taxed the same single core the
+        # decode runs on, for frames that carried nothing new
+        if subs and upto == self._last_pushed_upto \
+                and time.monotonic() - self._last_push < 0.05:
+            return
+        # (outside the io lock — the queues are thread-safe; completion
+        # dicts are built per subscriber from its own watermark)
+        for sub in subs:
+            wm = sub["watermark"]
+            frame = {
+                "kind": "pub", "version": version,
+                "from": wm, "watermark": upto,
+                "completions": [
+                    self._completion_dict(c) for c in comps[wm:upto]
+                ],
+                "inflight": inflight, "stats": stats,
+            }
+            try:
+                sub["q"].put_nowait(frame)
+                sub["watermark"] = upto
+            except Exception:
+                pass  # full queue: this frame drops, poll reconciles
+        self._last_push = time.monotonic()
+        self._last_pushed_upto = upto
+
+    def _op_poll(self, req: dict) -> dict:
+        """The heartbeat + completions-watermark read. `watermark` is
+        CLIENT-held (an index into this process's completions list —
+        a restarted worker starts at 0 and the client resets with it).
+        `inflight` is the live salvage point: rid / tokens-so-far /
+        first-token-time for everything queued or decoding, so a later
+        SIGKILL costs the router at most one poll interval of tokens —
+        and greedy re-decode reproduces even those. Served from the
+        post-step published snapshot: a poll never waits out a burst."""
+        watermark = int(req.get("watermark", 0))
+        seen_version = req.get("version")
+        with self._io_lock:
+            version = self._pub_version
+            pub = self._published
+            upto = pub["completions_len"]
+            inflight = pub["inflight"]
+            stats = pub["stats"]
+        if seen_version == version and watermark >= upto:
+            # nothing moved since the client's last poll: answer with a
+            # frame small enough that a high-rate heartbeat costs the
+            # decode loop (same single core!) close to nothing
+            return {"version": version, "unchanged": True}
+        comps = self.scheduler.completions  # append-only list
+        new = [self._completion_dict(c) for c in comps[watermark:upto]]
+        if stats is None:
+            with self._lock:
+                stats = self._stats()
+        return {"version": version,
+                "completions": new,
+                "watermark": upto,
+                "inflight": inflight,
+                "stats": stats}
+
+    def _drain_intake_locked(self) -> int:
+        """Move intake into the scheduler (big lock held by caller)."""
+        with self._io_lock:
+            intake, self._intake = self._intake, []
+        for r in intake:
+            self.scheduler.submit(r)
+        return len(intake)
+
+    def _op_subscribe(self, req: dict) -> dict:
+        """Switch this connection into a push stream (rpc.py push
+        mode): every published snapshot lands as a frame, no polling.
+        `watermark` is where the client's completion stream currently
+        stands (a resubscribe after a stream hiccup must not replay).
+        The push loop unregisters the subscriber when the stream dies —
+        reconnect churn must not leave _publish building frames for a
+        graveyard of dead queues."""
+        import queue
+
+        q: "queue.Queue" = queue.Queue(maxsize=256)
+        sub = {"q": q, "watermark": int(req.get("watermark", 0))}
+        with self._io_lock:
+            self._subscribers.append(sub)
+
+        def closed():
+            with self._io_lock:
+                try:
+                    self._subscribers.remove(sub)
+                except ValueError:
+                    pass
+
+        return {"_stream_queue": q, "_stream_closed": closed}
+
+    def _op_reset(self, req: dict) -> dict:
+        """The remote mirror of the in-process ReplicaHandle.restart():
+        a handle rejoining an incarnation it had written off (a
+        transport-blip 'death' — the process never died) must find a
+        CLEAN replica: stale queue/running work dropped (its requests
+        were already re-dispatched on survivors; finishing them here
+        would double-spend the engine and replay rid history), slots
+        released, dedup history forgotten. Returns the completions
+        watermark so the client resyncs instead of replaying the whole
+        history from 0."""
+        with self._lock:
+            self._drain_intake_locked()
+            slots = list(self.scheduler.running.keys())
+            self.scheduler.evacuate()   # clears queue/running/_resume
+            for s in slots:
+                self.engine.release(s)
+            with self._io_lock:
+                self._seen_rids.clear()
+            self._publish()
+            return {"completions": len(self.scheduler.completions)}
+
+    def _op_shed(self, req: dict) -> dict:
+        min_priority = int(req["min_priority"])
+        with self._lock:
+            # intake items are queued-but-not-drained: shed sees them too
+            self._drain_intake_locked()
+            shed = self.scheduler.shed_queued(
+                lambda r: r.priority >= min_priority
+            )
+            self._publish()
+            return {"rids": [r.rid for r in shed]}
+
+    def _op_drain(self, req: dict) -> dict:
+        with self._io_lock:
+            self._draining = True
+        with self._lock:
+            return {"queue": len(self.scheduler.queue),
+                    "active": self.engine.num_active}
+
+    def _op_shutdown(self, req: dict) -> dict:
+        self._stop.set()
+        return {"bye": True}
+
+    # ------------------------------------------------------- the loop
+    def serve_forever(self) -> None:
+        """Self-driven serve loop: tick whenever work exists; otherwise
+        nap. RPC handlers mutate scheduler state under the same lock a
+        tick holds, so a submit lands between (not inside) bursts."""
+        while not self._stop.is_set():
+            with self._lock:
+                moved = self._drain_intake_locked()
+                idle = self.scheduler.idle
+                if not idle:
+                    self.scheduler.step()
+                if moved or not idle:
+                    self._publish()
+            if idle and not moved:
+                # a truly idle replica SLEEPS (an 0.5 ms spin here
+                # measurably taxed every OTHER process on a small box);
+                # a submit sets the event, so admission latency stays
+                # ~one RPC, not one timeout. While sleeping, keep the
+                # push subscribers' heartbeat warm.
+                if time.monotonic() - self._last_push > 0.1:
+                    with self._io_lock:
+                        subs = list(self._subscribers)
+                    for sub in subs:
+                        try:
+                            sub["q"].put_nowait(
+                                {"kind": "hb", "t": time.monotonic()}
+                            )
+                        except Exception:
+                            pass
+                    self._last_push = time.monotonic()
+                self._wake.wait(0.05)
+                self._wake.clear()
+        # give the shutdown reply a beat to flush before teardown
+        time.sleep(0.1)
+
+    def close(self) -> None:
+        self._stop.set()
+        self.rpc.close()
+        self.telemetry.close()
+
+    def ready_line(self) -> str:
+        return READY_PREFIX + json.dumps({
+            "pid": os.getpid(),
+            "replica": self.spec.replica,
+            "rpc_port": self.rpc.port,
+            "telemetry_port": self.telemetry.port,
+        })
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser("ddp_practice_tpu.serve.worker")
+    p.add_argument("--spec", required=True,
+                   help="WorkerSpec JSON, or @path to a JSON file")
+    args = p.parse_args(argv)
+    text = args.spec
+    if text.startswith("@"):
+        with open(text[1:]) as f:
+            text = f.read()
+    spec = WorkerSpec.from_json(text)
+    if spec.platform:
+        # pin the platform BEFORE jax initializes a backend (the heavy
+        # imports all hide inside WorkerServer)
+        os.environ.setdefault("JAX_PLATFORMS", spec.platform)
+    server = WorkerServer(spec)
+    print(server.ready_line(), flush=True)
+    try:
+        server.serve_forever()
+    finally:
+        server.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -307,11 +307,19 @@ class TelemetryServer:
     def __init__(self, *, registry: Optional[MetricsRegistry] = None,
                  health_fn: Optional[Callable[[], Dict]] = None,
                  flight_fn: Optional[Callable[[], dict]] = None,
+                 healthz_fn: Optional[Callable[[], dict]] = None,
                  port: int = 0, host: str = "127.0.0.1",
                  start: bool = True) -> None:
+        # `registry` is duck-typed: anything with render_text() serves
+        # /metrics (a MetricsRegistry, or a ScrapeFederator rolling a
+        # whole fleet up). `healthz_fn`, when set, returns the FULL
+        # /healthz body (the federated shape carries per-worker
+        # heartbeat ages — richer than health_fn's flat state map);
+        # the 503-on-DEAD contract is keyed off its "status" field.
         self.registry = registry
         self.health_fn = health_fn
         self.flight_fn = flight_fn
+        self.healthz_fn = healthz_fn
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -345,6 +353,12 @@ class TelemetryServer:
                     if self.registry is not None else "")
             return text.encode(), 200, "text/plain; version=0.0.4"
         if path == "/healthz":
+            if self.healthz_fn is not None:
+                body_obj = self.healthz_fn()
+                overall = str(body_obj.get("status", "")).upper()
+                return (json.dumps(body_obj).encode(),
+                        503 if overall == "DEAD" else 200,
+                        "application/json")
             states = dict(self.health_fn()) if self.health_fn else {}
             overall = _overall_health(states)
             body = json.dumps({"status": overall, "replicas": states})
@@ -379,6 +393,164 @@ class TelemetryServer:
 
     def __exit__(self, *exc) -> None:
         self.close()
+
+
+# --------------------------------------------------------- fleet federation
+def _relabel_metric_line(line: str, extra: str) -> str:
+    """Inject `extra` (e.g. worker="0") as the FIRST label of one
+    Prometheus exposition line; comments/blank lines pass through. The
+    value is everything after the last space (a float, never spaced),
+    so escaped label values cannot confuse the split."""
+    if not line or line.startswith("#"):
+        return line
+    head, _, val = line.rpartition(" ")
+    if not head:
+        return line
+    if "{" in head:
+        name, rest = head.split("{", 1)
+        return f"{name}{{{extra},{rest} {val}"
+    return f"{head}{{{extra}}} {val}"
+
+
+class ScrapeFederator:
+    """Roll N workers' /metrics + /healthz into ONE fleet registry.
+
+    `targets_fn()` describes the fleet (serve/supervisor.py
+    `fleet_targets`): per worker id, where its TelemetryServer lives
+    (host/port — None while the worker is down), its pid, supervisor
+    state, restart count, and heartbeat age. Scrapes happen at READ
+    time (a federated /metrics GET fans out to the live workers), so
+    the federator holds no thread and no staleness of its own beyond
+    the per-scrape timeout.
+
+    Duck-types both TelemetryServer hooks: `render_text()` (pass it AS
+    the server's `registry`) rewrites every worker metric line with a
+    ``worker="N"`` label and prepends fleet-level series
+    (``fleet_worker_up`` / ``fleet_heartbeat_age_s`` /
+    ``fleet_worker_restarts_total``); `healthz()` (pass as
+    `healthz_fn`) renders the fleet verdict tools/check_fleet.py
+    judges: DEAD only when every worker is down, per-worker status
+    dead / stale / healthy with heartbeat ages attached.
+    """
+
+    def __init__(self, targets_fn: Callable[[], Dict], *,
+                 timeout_s: float = 1.0,
+                 stale_after_s: float = 5.0) -> None:
+        self.targets_fn = targets_fn
+        self.timeout_s = timeout_s
+        self.stale_after_s = stale_after_s
+
+    def _get(self, host: str, port: int, path: str) -> Optional[str]:
+        import http.client
+
+        try:
+            conn = http.client.HTTPConnection(
+                host, port, timeout=self.timeout_s
+            )
+            conn.request("GET", path)
+            body = conn.getresponse().read().decode("utf-8", "replace")
+            conn.close()
+            return body
+        except Exception:
+            return None  # a dead worker is a verdict, not a crash
+
+    def _get_many(self, targets: Dict, path: str) -> Dict:
+        """Scrape every up-target CONCURRENTLY (one thread each, joined
+        on a shared deadline): a stalled worker — the SIGSTOP chaos
+        case, which still counts as `up` by waitpid — must cost one
+        timeout for the whole fan-out, not one timeout per remaining
+        worker serially inside the scrape handler."""
+        results: Dict = {}
+        threads = []
+        for wid, t in targets.items():
+            if not (bool(t.get("up")) and t.get("port") is not None):
+                continue
+
+            def fetch(wid=wid, t=t):
+                results[wid] = self._get(
+                    t.get("host", "127.0.0.1"), t["port"], path
+                )
+
+            th = threading.Thread(target=fetch, daemon=True,
+                                  name=f"scrape-w{wid}")
+            th.start()
+            threads.append(th)
+        deadline = time.monotonic() + self.timeout_s + 0.5
+        for th in threads:
+            th.join(timeout=max(0.0, deadline - time.monotonic()))
+        return results
+
+    # ------------------------------------------------ /metrics rollup
+    def render_text(self) -> str:
+        targets = self.targets_fn()
+        scraped = self._get_many(targets, "/metrics")
+        out = []
+        for wid in sorted(targets):
+            t = targets[wid]
+            extra = f'worker="{wid}"'
+            up = bool(t.get("up")) and t.get("port") is not None
+            out.append(f"fleet_worker_up{{{extra}}} {1 if up else 0}")
+            hb = t.get("heartbeat_age_s")
+            if hb is not None:
+                out.append(f"fleet_heartbeat_age_s{{{extra}}} {hb}")
+            out.append(
+                f"fleet_worker_restarts_total{{{extra}}} "
+                f"{t.get('restarts', 0)}"
+            )
+            if not up:
+                continue
+            text = scraped.get(wid)
+            if text is None:
+                out.append(f"fleet_scrape_failed{{{extra}}} 1")
+                continue
+            for line in text.splitlines():
+                if line and not line.startswith("#"):
+                    out.append(_relabel_metric_line(line, extra))
+        return "\n".join(out) + "\n"
+
+    # ------------------------------------------------ /healthz verdict
+    def healthz(self) -> dict:
+        targets = self.targets_fn()
+        scraped = self._get_many(targets, "/healthz")
+        workers: Dict[str, dict] = {}
+        for wid in sorted(targets):
+            t = targets[wid]
+            up = bool(t.get("up")) and t.get("port") is not None
+            hb = t.get("heartbeat_age_s")
+            inner = None
+            if up:
+                body = scraped.get(wid)
+                if body is not None:
+                    try:
+                        inner = json.loads(body)
+                    except ValueError:
+                        inner = None
+            if not up or inner is None:
+                status = "dead"
+            elif hb is not None and hb > self.stale_after_s:
+                # answering scrapes but the serving heartbeat is old:
+                # the router can't dispatch to it — degraded, loudly
+                status = "stale"
+            else:
+                status = str(inner.get("status", "dead")).lower()
+                status = {"healthy": "healthy",
+                          "degraded": "degraded"}.get(status, "dead")
+            workers[str(wid)] = {
+                "status": status,
+                "pid": t.get("pid"),
+                "state": t.get("state"),
+                "restarts": t.get("restarts", 0),
+                "heartbeat_age_s": hb,
+                "replicas": (inner or {}).get("replicas", {}),
+            }
+        vals = [w["status"] for w in workers.values()]
+        if vals and all(v == "dead" for v in vals):
+            overall = "DEAD"
+        elif not vals or any(v != "healthy" for v in vals):
+            overall = "DEGRADED" if vals else "DEAD"
+        else:
+            overall = "HEALTHY"
+        return {"status": overall, "fleet": True, "workers": workers}
 
 
 # ------------------------------------------------------- train-side rolling

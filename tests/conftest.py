@@ -78,6 +78,28 @@ def compile_guard():
     return guard
 
 
+@pytest.fixture(scope="session", autouse=True)
+def _reap_fleet_workers():
+    """No spawned worker process survives the session — and a leak is a
+    FAILURE, not a silent cleanup. The fleet tests spawn real OS
+    workers (serve/supervisor.py registers every child pid); a test
+    that leaks one — especially a SIGSTOPped one, which would hang any
+    naive wait — gets it SIGKILLed+reaped here, then the assert makes
+    the leak loud. Lazy import: sessions that never touch serve/ pay
+    one module lookup."""
+    yield
+    import sys
+
+    sup = sys.modules.get("ddp_practice_tpu.serve.supervisor")
+    if sup is None:
+        return  # nothing that can spawn was ever imported
+    leaked = sup.reap_all()
+    assert not leaked, (
+        f"fleet worker processes leaked by the suite (now killed): "
+        f"{leaked}"
+    )
+
+
 @pytest.fixture(autouse=True)
 def _reset_mesh_registry():
     """Tests that set the framework's current mesh (directly or via

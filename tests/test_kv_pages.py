@@ -221,6 +221,48 @@ def test_ref_prefix_pins_the_chain_against_eviction():
     assert a.refcount(bb[0]) == 1          # B survived
 
 
+def test_evictable_counter_matches_full_walk_on_random_ops():
+    """The O(1) evictable counter (insert/evict structural edges +
+    allocator refcount hook) must agree with the full-tree walk after
+    EVERY operation of a randomized admit/release/evict/pin history —
+    the admit-gate probe reads the counter, so a drifting counter would
+    silently admit into blocks that cannot actually be freed."""
+    rng = np.random.default_rng(7)
+    a = BlockAllocator(64)
+    r = RadixPrefixCache(a, 2)
+    held = []      # (blocks, tokens) a live "slot" still references
+    for step in range(400):
+        op = int(rng.integers(0, 4))
+        if op == 0:
+            # an admission: match the cached prefix, alloc own blocks,
+            # publish the prompt (duplicate chunks stay private)
+            plen = int(rng.integers(1, 11))
+            tokens = [int(t) for t in rng.integers(0, 4, plen)]
+            blocks = a.alloc(-(-plen // r.block_size))
+            if blocks is not None:
+                got, _ = r.match(tokens)
+                r.insert(tokens, blocks)
+                held.append((blocks + got, tokens))
+        elif op == 1 and held:
+            # a release: the slot drops every block it held
+            blocks, _ = held.pop(int(rng.integers(0, len(held))))
+            a.free(blocks)
+        elif op == 2:
+            r.evict(int(rng.integers(1, 5)))
+        elif op == 3 and held:
+            # a make_room-style pin/unpin cycle
+            pins = r.ref_prefix(
+                held[int(rng.integers(0, len(held)))][1]
+            )
+            a.free(pins)
+        assert r.evictable() == r._evictable_walk(), f"drift at {step}"
+    for blocks, _ in held:
+        a.free(blocks)
+    assert r.evictable() == r._evictable_walk()
+    r.clear()
+    assert r.evictable() == r._evictable_walk() == 0
+
+
 # ------------------------------------------------------- engine (compiles)
 @pytest.fixture(scope="module")
 def lm():

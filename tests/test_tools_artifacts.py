@@ -23,6 +23,12 @@ import sys
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 TRACE = os.path.join(ROOT, "tests", "data", "bench_trace.json")
 TELEMETRY = os.path.join(ROOT, "tests", "data", "bench_telemetry.jsonl")
+# federated-fleet /healthz snapshots (ScrapeFederator output shape):
+# _ok is a 2-worker healthy fleet (full-plane wrapper form, metrics
+# included); _bad has one FAILED slot (restart budget spent) and one
+# heartbeat-stale worker — the two verdicts check_fleet exists to catch
+FLEET_OK = os.path.join(ROOT, "tests", "data", "fleet_healthz_ok.json")
+FLEET_BAD = os.path.join(ROOT, "tests", "data", "fleet_healthz_bad.json")
 
 # the SLO the artifact run was recorded against (it violates this one)
 TIGHT_SLO = json.dumps({
@@ -96,6 +102,54 @@ def test_check_slo_cli_json_mode_and_bad_inputs(tmp_path):
                 str(tmp_path / "missing.jsonl")).returncode == 2
     assert _run("tools/check_slo.py", "--slo", "{not json",
                 TELEMETRY).returncode == 2
+
+
+def test_check_fleet_cli_exit_codes_over_artifacts(tmp_path):
+    """ISSUE-7 CI satellite: both verdicts pinned through the real CLI.
+    exit 0 = healthy fleet, 1 = dead/stale/FAILED worker, 2 =
+    unreadable probe input — an operator's cron can tell a broken
+    fleet from a broken probe."""
+    r = _run("tools/check_fleet.py", FLEET_OK)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert ": OK" in r.stdout
+    r = _run("tools/check_fleet.py", FLEET_BAD)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "FLEET UNHEALTHY" in r.stdout
+    assert "restart budget exhausted" in r.stdout
+    assert "heartbeat stale" in r.stdout
+    # a generous heartbeat budget forgives staleness but NOT the
+    # failed slot — the exit code stays 1
+    r = _run("tools/check_fleet.py", "--max-heartbeat-age", "100",
+             FLEET_BAD)
+    assert r.returncode == 1 and "restart budget" in r.stdout
+    # --json is machine-readable and keeps the code
+    r = _run("tools/check_fleet.py", "--json", FLEET_BAD)
+    assert r.returncode == 1
+    rep = json.loads(r.stdout)[FLEET_BAD]
+    assert rep["ok"] is False and rep["workers"]["0"] == "dead"
+    # unreadable inputs are exit 2, not a fake verdict
+    assert _run("tools/check_fleet.py",
+                str(tmp_path / "missing.json")).returncode == 2
+    garbage = tmp_path / "garbage.json"
+    garbage.write_text("{not json")
+    assert _run("tools/check_fleet.py", str(garbage)).returncode == 2
+    notfleet = tmp_path / "notfleet.json"
+    notfleet.write_text('{"status": "HEALTHY"}')
+    assert _run("tools/check_fleet.py", str(notfleet)).returncode == 2
+
+
+def test_check_fleet_verdict_as_library_too():
+    from tools.check_fleet import fleet_verdict, load_snapshot
+
+    ok, problems = fleet_verdict(load_snapshot(FLEET_OK))
+    assert ok and problems == []
+    ok, problems = fleet_verdict(load_snapshot(FLEET_BAD))
+    assert not ok and len(problems) >= 3  # dead + failed + stale
+    # the OK artifact also carries the federated /metrics text: the
+    # worker relabel is pinned so the rollup format can't drift
+    snap = json.load(open(FLEET_OK))
+    assert 'fleet_worker_up{worker="0"} 1' in snap["metrics"]
+    assert 'serve_tokens_total{worker="1"}' in snap["metrics"]
 
 
 def test_artifacts_validate_as_library_too():

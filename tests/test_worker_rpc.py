@@ -1,0 +1,255 @@
+"""serve/rpc.py transport seam — host-pure (no jax, no subprocesses).
+
+The framing and failure semantics the cross-process fleet stands on:
+length-prefixed JSON round trips, per-call timeouts raise RpcTimeout
+instead of hanging (the SIGSTOP containment primitive), transport
+retries reconnect with the shared backoff schedule, and a handler
+error answers on the wire instead of killing the connection.
+"""
+
+import json
+import socket
+import threading
+import time
+
+import pytest
+
+from ddp_practice_tpu.serve.rpc import (
+    MAX_FRAME_BYTES,
+    RpcClient,
+    RpcError,
+    RpcRemoteError,
+    RpcServer,
+    RpcTimeout,
+    recv_frame,
+    send_frame,
+)
+
+
+# ----------------------------------------------------------------- framing
+def test_frame_roundtrip_including_unicode_and_nesting():
+    a, b = socket.socketpair()
+    try:
+        msg = {"op": "x", "tokens": list(range(500)),
+               "s": "naïve — ünïcödé", "nested": {"a": [1, {"b": None}]}}
+        send_frame(a, msg)
+        assert recv_frame(b) == msg
+        # frames alternate cleanly: a second message on the same pipe
+        send_frame(b, {"ok": True})
+        assert recv_frame(a) == {"ok": True}
+    finally:
+        a.close()
+        b.close()
+
+
+def test_frame_rejects_oversize_and_truncation():
+    a, b = socket.socketpair()
+    try:
+        with pytest.raises(RpcError):
+            send_frame(a, {"big": "x" * (MAX_FRAME_BYTES + 1)})
+        # a corrupt length prefix refuses before allocating
+        a.sendall(b"\xff\xff\xff\xff")
+        with pytest.raises(RpcError):
+            recv_frame(b)
+    finally:
+        a.close()
+        b.close()
+    # peer closing mid-frame is an RpcError, not a hang
+    a, b = socket.socketpair()
+    a.sendall(b"\x00\x00\x00\x10half")
+    a.close()
+    try:
+        with pytest.raises(RpcError):
+            recv_frame(b)
+    finally:
+        b.close()
+
+
+# ------------------------------------------------------- client <-> server
+def test_server_dispatch_and_remote_error():
+    calls = []
+
+    def echo(req):
+        calls.append(req)
+        return {"echo": req.get("payload")}
+
+    def boom(req):
+        raise ValueError("handler exploded")
+
+    with RpcServer({"echo": echo, "boom": boom}) as srv:
+        with RpcClient("127.0.0.1", srv.port, timeout_s=5.0) as c:
+            r = c.call("echo", payload=[1, 2, 3])
+            assert r["ok"] and r["echo"] == [1, 2, 3]
+            # handler exception -> error reply -> RpcRemoteError, and
+            # the CONNECTION survives for the next call
+            with pytest.raises(RpcRemoteError, match="handler exploded"):
+                c.call("boom")
+            with pytest.raises(RpcRemoteError, match="unknown op"):
+                c.call("nope")
+            assert c.call("echo", payload="still alive")["echo"] \
+                == "still alive"
+    assert len(calls) == 2
+
+
+def test_call_times_out_on_stalled_handler():
+    """A handler that never answers (the SIGSTOP stand-in) must raise
+    RpcTimeout within the per-call budget, not hang the caller."""
+    release = threading.Event()
+
+    def stall(req):
+        release.wait(10.0)
+        return {}
+
+    with RpcServer({"stall": stall}) as srv:
+        c = RpcClient("127.0.0.1", srv.port, timeout_s=0.2, retries=0)
+        t0 = time.monotonic()
+        with pytest.raises(RpcTimeout):
+            c.call("stall")
+        assert time.monotonic() - t0 < 5.0
+        c.close()
+        release.set()
+
+
+def test_transport_retry_reconnects_with_backoff():
+    """Kill the first server; the client's retry budget reconnects to a
+    replacement on the same port and the call SUCCEEDS — the sleep hook
+    records the deterministic backoff schedule."""
+    srv = RpcServer({"ping": lambda req: {"pong": 1}})
+    port = srv.port
+    slept = []
+    c = RpcClient("127.0.0.1", port, timeout_s=2.0, retries=3,
+                  retry_base_s=0.01, sleep=slept.append)
+    assert c.call("ping")["pong"] == 1
+    srv.close()
+    # connection now points at a dead listener; next call must retry.
+    # A replacement comes up on the same port mid-retry:
+    replacement = {}
+
+    def bring_back():
+        time.sleep(0.05)
+        replacement["srv"] = RpcServer(
+            {"ping": lambda req: {"pong": 2}}, port=port
+        )
+
+    t = threading.Thread(target=bring_back)
+    t.start()
+    try:
+        deadline = time.monotonic() + 5.0
+        while True:
+            try:
+                r = c.call("ping")
+                break
+            except RpcError:
+                assert time.monotonic() < deadline
+        assert r["pong"] == 2
+        assert slept, "no backoff sleeps recorded on the retry path"
+    finally:
+        t.join()
+        c.close()
+        replacement["srv"].close()
+
+
+# ------------------------------------------------------------- federation
+def test_scrape_federator_relabels_and_judges_live_servers():
+    """Two real (in-process) TelemetryServers federated: /metrics lines
+    gain worker="N" labels plus the fleet_* series, /healthz rolls the
+    per-worker verdicts up — and a worker going away flips the verdict
+    without crashing the scrape."""
+    from ddp_practice_tpu.utils.metrics import MetricsRegistry
+    from ddp_practice_tpu.utils.telemetry import (
+        ScrapeFederator,
+        TelemetryServer,
+        _relabel_metric_line,
+    )
+
+    # the relabel helper alone, incl. labelled and unlabelled lines
+    assert _relabel_metric_line('x_total 3', 'worker="1"') \
+        == 'x_total{worker="1"} 3'
+    assert _relabel_metric_line('x{a="b c"} 3.5', 'worker="0"') \
+        == 'x{worker="0",a="b c"} 3.5'
+    assert _relabel_metric_line("# HELP x y", 'worker="0"') \
+        == "# HELP x y"
+
+    regs = [MetricsRegistry(), MetricsRegistry()]
+    regs[0].counter("serve_tokens_total").inc(7)
+    regs[1].gauge("serve_queue_depth").set(2)
+    servers = [
+        TelemetryServer(registry=regs[i],
+                        health_fn=lambda i=i: {i: "healthy"}, port=0)
+        for i in range(2)
+    ]
+    state = {
+        i: {"host": "127.0.0.1", "port": servers[i].port, "pid": 100 + i,
+            "up": True, "state": "running", "restarts": 0,
+            "heartbeat_age_s": 0.1}
+        for i in range(2)
+    }
+    fed = ScrapeFederator(lambda: state, stale_after_s=5.0)
+    text = fed.render_text()
+    assert 'serve_tokens_total{worker="0"} 7' in text
+    assert 'serve_queue_depth{worker="1"} 2' in text
+    assert 'fleet_worker_up{worker="0"} 1' in text
+    body = fed.healthz()
+    assert body["status"] == "HEALTHY"
+    assert body["workers"]["0"]["status"] == "healthy"
+    # stale heartbeat degrades even while the scrape answers
+    state[1]["heartbeat_age_s"] = 60.0
+    body = fed.healthz()
+    assert body["workers"]["1"]["status"] == "stale"
+    assert body["status"] == "DEGRADED"
+    # a dead worker (server gone, target down) is a verdict, not a crash
+    servers[0].close()
+    state[0]["up"] = False
+    state[0]["port"] = None
+    body = fed.healthz()
+    assert body["workers"]["0"]["status"] == "dead"
+    text = fed.render_text()
+    assert 'fleet_worker_up{worker="0"} 0' in text
+    state[1]["heartbeat_age_s"] = 0.1
+    # all dead -> DEAD (the federated server would then serve 503)
+    servers[1].close()
+    state[1]["up"] = False
+    assert fed.healthz()["status"] == "DEAD"
+
+
+def test_federated_healthz_fn_serves_503_on_dead():
+    """TelemetryServer's healthz_fn hook: the federated body rides
+    /healthz verbatim and the 503-on-DEAD orchestrator contract keys
+    off its status field."""
+    import http.client
+
+    from ddp_practice_tpu.utils.telemetry import TelemetryServer
+
+    verdict = {"status": "HEALTHY", "fleet": True, "workers": {}}
+    srv = TelemetryServer(healthz_fn=lambda: verdict, port=0)
+    try:
+        def get():
+            conn = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                              timeout=5)
+            conn.request("GET", "/healthz")
+            r = conn.getresponse()
+            body = json.loads(r.read())
+            conn.close()
+            return r.status, body
+
+        status, body = get()
+        assert status == 200 and body["fleet"] is True
+        verdict["status"] = "DEAD"
+        status, body = get()
+        assert status == 503 and body["status"] == "DEAD"
+    finally:
+        srv.close()
+
+
+def test_connect_refused_raises_after_retries():
+    slept = []
+    # a port nothing listens on: bind-then-close to find a free one
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    port = probe.getsockname()[1]
+    probe.close()
+    c = RpcClient("127.0.0.1", port, timeout_s=0.5, retries=2,
+                  retry_base_s=0.001, sleep=slept.append)
+    with pytest.raises(RpcError):
+        c.call("ping")
+    assert len(slept) == 2  # one backoff per extra attempt

@@ -1,0 +1,180 @@
+"""Fleet health verdict over the federated /metrics + /healthz plane.
+
+The cross-process fleet (serve/supervisor.py) rolls every worker's
+telemetry into one registry (utils/telemetry.py ScrapeFederator); this
+tool turns that rollup into an exit code a CI step or an operator's
+probe can act on:
+
+- as a CLI over a LIVE federated endpoint::
+
+      python tools/check_fleet.py http://127.0.0.1:9100
+      python tools/check_fleet.py --max-heartbeat-age 10 http://...
+
+- or over a SNAPSHOT file — the federated /healthz JSON body saved to
+  disk (the checked-in artifacts in tests/data/ pin both exit codes,
+  the PR-5 test_tools_artifacts.py pattern)::
+
+      python tools/check_fleet.py tests/data/fleet_healthz_ok.json
+
+exit 0 = every worker healthy and fresh; 1 = the fleet has a problem
+(a dead/stale worker, a FAILED slot whose restart budget is spent, or
+an overall DEAD verdict); 2 = input unreadable/malformed — a broken
+probe must be distinguishable from a broken fleet.
+
+The verdict logic is a pure function (`fleet_verdict`) shared by the
+CLI and the tests, judging exactly the fields the federator publishes:
+per-worker ``status`` (healthy / degraded / stale / dead), supervisor
+``state`` (a ``failed`` slot is an operator page even while its peers
+serve), and ``heartbeat_age_s`` against the staleness budget.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Tuple
+
+OK, PROBLEM, UNREADABLE = 0, 1, 2
+
+
+def fetch_healthz(url: str, timeout_s: float = 3.0) -> dict:
+    """GET <url>/healthz from a live federated TelemetryServer."""
+    import http.client
+    from urllib.parse import urlparse
+
+    u = urlparse(url)
+    if u.scheme == "https":
+        conn = http.client.HTTPSConnection(
+            u.hostname, u.port or 443, timeout=timeout_s
+        )
+    else:
+        conn = http.client.HTTPConnection(
+            u.hostname, u.port or 80, timeout=timeout_s
+        )
+    conn.request("GET", "/healthz")
+    body = conn.getresponse().read().decode("utf-8", "replace")
+    conn.close()
+    return json.loads(body)
+
+
+def load_snapshot(path: str) -> dict:
+    """A saved federated /healthz body, optionally wrapped as
+    {"healthz": {...}, "metrics": "..."} (a full-plane snapshot)."""
+    with open(path) as f:
+        data = json.load(f)
+    if isinstance(data, dict) and "healthz" in data:
+        data = data["healthz"]
+    if not isinstance(data, dict) or "workers" not in data:
+        raise ValueError("not a federated healthz body "
+                         "(no 'workers' key)")
+    return data
+
+
+def fleet_verdict(healthz: dict,
+                  max_heartbeat_age_s: float = 5.0
+                  ) -> Tuple[bool, List[str]]:
+    """(ok, problems): ok only when every worker is healthy, no slot's
+    restart budget is spent, and no heartbeat is older than the
+    budget."""
+    problems: List[str] = []
+    workers = healthz.get("workers", {})
+    if not workers:
+        problems.append("no workers in the fleet")
+    overall = str(healthz.get("status", "")).upper()
+    if overall == "DEAD":
+        problems.append("overall verdict DEAD (no worker can serve)")
+    for wid in sorted(workers):
+        w = workers[wid]
+        status = str(w.get("status", "dead")).lower()
+        if status != "healthy":
+            problems.append(f"worker {wid}: status {status}")
+        if str(w.get("state", "")).lower() == "failed":
+            problems.append(
+                f"worker {wid}: restart budget exhausted "
+                f"(supervisor slot FAILED after "
+                f"{w.get('restarts', '?')} restarts)"
+            )
+        hb = w.get("heartbeat_age_s")
+        if hb is not None and hb > max_heartbeat_age_s:
+            problems.append(
+                f"worker {wid}: heartbeat stale "
+                f"({hb:.2f}s > {max_heartbeat_age_s}s)"
+            )
+    return (not problems, problems)
+
+
+def render(source: str, healthz: dict, ok: bool,
+           problems: List[str]) -> str:
+    lines = [f"{source}: fleet {healthz.get('status', '?')}"]
+    for wid in sorted(healthz.get("workers", {})):
+        w = healthz["workers"][wid]
+        hb = w.get("heartbeat_age_s")
+        lines.append(
+            f"  worker {wid}: {w.get('status', '?'):>8}"
+            f"  pid {str(w.get('pid', '-')):>7}"
+            f"  state {str(w.get('state', '-')):>8}"
+            f"  restarts {w.get('restarts', 0)}"
+            f"  heartbeat "
+            + (f"{hb:.2f}s" if hb is not None else "-")
+        )
+    if ok:
+        lines.append(f"{source}: OK")
+    else:
+        for p in problems:
+            lines.append(f"  PROBLEM: {p}")
+        lines.append(f"{source}: FLEET UNHEALTHY")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        "check_fleet",
+        description="verdict over the federated fleet "
+                    "/healthz (live URL or snapshot file)",
+    )
+    p.add_argument("targets", nargs="+",
+                   help="http://host:port of the federated "
+                        "TelemetryServer, or a JSON snapshot path")
+    p.add_argument("--max-heartbeat-age", type=float, default=5.0,
+                   metavar="S", dest="max_age",
+                   help="heartbeats older than this are a failure "
+                        "(default 5s)")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable report per target")
+    args = p.parse_args(argv)
+    rc = OK
+    reports = {}
+    for target in args.targets:
+        try:
+            if target.startswith(("http://", "https://")):
+                healthz = fetch_healthz(target)
+            else:
+                healthz = load_snapshot(target)
+        except Exception as e:
+            if args.json:
+                reports[target] = {"error": str(e)}
+            else:
+                print(f"{target}: UNREADABLE ({e})")
+            rc = max(rc, UNREADABLE)
+            continue
+        ok, problems = fleet_verdict(healthz, args.max_age)
+        reports[target] = {
+            "ok": ok, "status": healthz.get("status"),
+            "problems": problems,
+            "workers": {
+                wid: w.get("status")
+                for wid, w in healthz.get("workers", {}).items()
+            },
+        }
+        if not args.json:
+            print(render(target, healthz, ok, problems))
+        if not ok:
+            rc = max(rc, PROBLEM)
+    if args.json:
+        print(json.dumps(reports))
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
