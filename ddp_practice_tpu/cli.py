@@ -30,6 +30,11 @@ Examples:
                                        # chaos with teeth: SIGKILL a live
                                        # worker mid-decode, goodput +
                                        # zero-lost measured for real
+  python -m ddp_practice_tpu.cli serve --procs 2 --trace-out fleet.json
+                                       # FLEET tracing: worker spans
+                                       # stream back + merge into ONE
+                                       # clock-aligned timeline; validate
+                                       # with check_traces.py --fleet
 """
 
 from __future__ import annotations
@@ -179,6 +184,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "watchdog over the step-time straggler "
                         "detector; alerts land in the telemetry "
                         "stream and the metrics registry")
+    p.add_argument("--alert-sink", "--alert_sink", dest="alert_sink",
+                   action="append", default=None, metavar="KIND:TARGET",
+                   help="repeatable; PUSH SLO alert edges to an "
+                        "operator sink (command:..., webhook:http://..., "
+                        "jsonl:path) with retry backoff and a dead-sink "
+                        "breaker (serve/slo.py AlertSinks); needs --slo")
     p.add_argument("--loader", default="auto", choices=["auto", "native", "python"])
     p.add_argument("--steps_per_call", type=int, default=1,
                    help="K optimizer steps per jitted call (amortizes host "
@@ -215,6 +226,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "rrc: random resized crop (the ImageNet rung)")
     p.add_argument("--json", action="store_true", help="print summary as JSON")
     return p
+
+
+def _alert_sinks_from(args):
+    if not args.alert_sink:
+        return None
+    if not args.slo:
+        # the sinks only ever carry the watchdog's edges — accepting
+        # them without --slo would arm a pager that can never fire
+        raise SystemExit("--alert-sink needs --slo (the sinks carry "
+                         "the watchdog's trip/resolve edges)")
+    return tuple(args.alert_sink)
 
 
 def config_from_args(args) -> TrainConfig:
@@ -281,6 +303,7 @@ def config_from_args(args) -> TrainConfig:
         metrics_port=args.metrics_port,
         telemetry_out=args.telemetry_out,
         slo=args.slo,
+        alert_sinks=_alert_sinks_from(args),
         loader_backend=args.loader,
         steps_per_call=args.steps_per_call,
         data_placement=args.data_placement,

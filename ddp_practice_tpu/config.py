@@ -281,6 +281,12 @@ class TrainConfig:
     # watchdog over the straggler detector's verdicts — sustained
     # anomalous step times trip an alert into the telemetry stream
     slo: Optional[str] = None
+    # push-alert sinks ("kind:target" specs, serve/slo.py AlertSinkSpec:
+    # command:... / webhook:http://... / jsonl:path): SLO trip/resolve
+    # edges are PUSHED to an operator, with per-sink retry backoff and a
+    # dead-sink breaker — a burning SLO that only lands in a scrape
+    # endpoint pages nobody
+    alert_sinks: Optional[tuple] = None
 
     # input pipeline
     loader_backend: str = "auto"       # auto | native | python

@@ -23,9 +23,12 @@ Layers (each its own module, composable and separately testable):
   consecutive-failure circuit breaker and backoff half-open probes;
 - slo.py       — declarative SLO targets (TTFT/TPOT p99, error rate,
   availability) evaluated as multi-window burn rates; alerts feed the
-  router's brown-out and the telemetry stream (utils/telemetry.py
-  exports the plane: JSONL streaming + /metrics /healthz /flight HTTP
-  scrape endpoints; tools/check_slo.py is the offline verdict);
+  router's brown-out, the telemetry stream, and PUSH sinks
+  (AlertSinks: command/webhook/jsonl with retry backoff + a dead-sink
+  breaker; FleetAlerts raises the same edges for dead/stale workers)
+  (utils/telemetry.py exports the plane: JSONL streaming + /metrics
+  /healthz /flight HTTP scrape endpoints; tools/check_slo.py is the
+  offline verdict);
 - router.py    — fault-tolerant least-loaded dispatch over N replicas:
   bounded retries with backoff+jitter, crash failover that migrates
   in-flight requests (prompt + tokens-so-far re-prefill,
@@ -103,7 +106,13 @@ from ddp_practice_tpu.serve.rpc import (
     RpcServer,
     RpcTimeout,
 )
-from ddp_practice_tpu.serve.slo import SLOConfig, SLOWatchdog
+from ddp_practice_tpu.serve.slo import (
+    AlertSinks,
+    AlertSinkSpec,
+    FleetAlerts,
+    SLOConfig,
+    SLOWatchdog,
+)
 from ddp_practice_tpu.serve.supervisor import (
     RemoteReplicaHandle,
     Supervisor,
@@ -113,10 +122,13 @@ from ddp_practice_tpu.serve.supervisor import (
 from ddp_practice_tpu.serve.worker import WorkerSpec
 
 __all__ = [
+    "AlertSinkSpec",
+    "AlertSinks",
     "BlockAllocator",
     "BreakerConfig",
     "CircuitBreaker",
     "Completion",
+    "FleetAlerts",
     "EngineConfig",
     "FakeClock",
     "FaultInjector",

@@ -97,10 +97,15 @@ class ServeMetrics:
     def on_complete(self, completion, scheduler) -> None:
         self.registry.counter(f"serve_requests_{completion.status}").inc()
         self.tokens_total.inc(len(completion.tokens))
+        # exemplar = the completion's trace_id: the latency histograms
+        # in /metrics carry a per-bucket pointer back into the trace
+        # timeline (render_text emits OpenMetrics `# {trace_id=...}`)
         if completion.ttft is not None:
-            self.ttft.observe(completion.ttft)
+            self.ttft.observe(completion.ttft,
+                              exemplar=completion.trace_id)
         if completion.tpot is not None:
-            self.tpot.observe(completion.tpot)
+            self.tpot.observe(completion.tpot,
+                              exemplar=completion.trace_id)
 
     # reporting ------------------------------------------------------------
     def report(self, elapsed_s: Optional[float] = None) -> dict:
@@ -142,6 +147,11 @@ class RouterMetrics:
         self.fleet_pressure = r.gauge("serve_fleet_pressure")
         self.tokens_total = r.counter("serve_router_tokens_total")
         self.submitted = r.counter("serve_router_requests_submitted")
+        # client-perceived latency ACROSS attempts (the per-replica
+        # ServeMetrics only see their own attempt) — exemplar-fed, so
+        # the fleet /metrics p99 bucket names an offending trace_id
+        self.ttft = r.histogram("serve_router_ttft_s")
+        self.tpot = r.histogram("serve_router_tpot_s")
 
     def on_shed(self, reason: str) -> None:
         self.registry.counter(
@@ -158,6 +168,12 @@ class RouterMetrics:
             f"serve_router_requests_{completion.status}"
         ).inc()
         self.tokens_total.inc(len(completion.tokens))
+        if completion.ttft is not None:
+            self.ttft.observe(completion.ttft,
+                              exemplar=completion.trace_id)
+        if completion.tpot is not None:
+            self.tpot.observe(completion.tpot,
+                              exemplar=completion.trace_id)
 
     def report(self) -> dict:
         return self.registry.snapshot()

@@ -658,7 +658,7 @@ class Router:
         c = Completion(
             rid=req.rid, tokens=tokens, status=status,
             arrival=req.arrival, finish=now, ttft=ttft, tpot=tpot,
-            flight=flight,
+            flight=flight, trace_id=req.trace_id,
         )
         tr.done = True
         self._pending -= 1

@@ -295,6 +295,13 @@ class RpcServer:
     sentinel, the peer goes away, or the server closes. The producer
     (serve/worker.py `_publish`) never touches the socket — one thread
     owns it for life, so pushes cannot interleave with replies.
+
+    Pushed frames are kind-tagged dicts; the worker currently emits
+    ``pub`` (completions watermark + inflight salvage + stats), ``hb``
+    (idle heartbeat), and ``trace`` (batched span records for the
+    fleet TraceCollector, seq-numbered with a cumulative drop count).
+    The transport is deliberately agnostic: new kinds ride for free,
+    and unknown kinds are skipped by consumers.
     """
 
     def __init__(self, handlers: Dict[str, Callable[[dict], dict]], *,

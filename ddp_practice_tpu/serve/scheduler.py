@@ -135,6 +135,10 @@ class Completion:
     # The scheduler fills the phase keys (retries/failovers stay 0);
     # the router re-derives them summed across attempts (router.py).
     flight: Optional[dict] = None
+    # the request's trace_id, carried onto the completion so metric
+    # exemplars (utils/metrics.py) and telemetry flight lines can point
+    # BACK into the trace timeline — a p99 bucket names the offender
+    trace_id: Optional[str] = None
 
 
 def _attempt_phases(req: Request, now: float,
@@ -256,7 +260,7 @@ class Scheduler:
         c = Completion(
             rid=req.rid, tokens=tokens, status=status,
             arrival=req.arrival, finish=now, ttft=ttft, tpot=tpot,
-            flight=flight,
+            flight=flight, trace_id=req.trace_id,
         )
         tr = self.tracer
         if tr is not None and tr.enabled:

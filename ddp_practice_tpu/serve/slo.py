@@ -33,6 +33,17 @@ telemetry plane sees them: tracer instants (``slo_alert`` /
 brown-out trigger: degradation driven by measured SLO violation, not
 just occupancy (serve/router.py _update_brownout).
 
+PUSH delivery (`AlertSinks`): edges additionally fan out to operator
+sinks — a command to run, a webhook URL to POST, a JSONL file to append
+— because a burning SLO that only lands in a scrape endpoint pages
+nobody. Per sink: bounded pending queue, exponential backoff between
+delivery retries (utils/backoff.py), and a DEAD-SINK BREAKER — after
+`max_failures` consecutive failures the sink is abandoned for good
+(``alert_sink_dead`` gauge; a flapping webhook must not hold the serve
+loop's alert path hostage forever). `FleetAlerts` federates the same
+edges at fleet level: a worker the ScrapeFederator judges dead/stale
+raises a trip through the same sinks, its recovery a resolve.
+
 tools/check_slo.py evaluates the same objectives OFFLINE over a
 telemetry JSONL (bench artifacts, post-mortems), sharing
 `SLOConfig` and the percentile implementation.
@@ -46,6 +57,7 @@ import os
 from collections import deque
 from typing import Dict, List, Optional, Tuple
 
+from ddp_practice_tpu.utils.backoff import backoff_delay
 from ddp_practice_tpu.utils.metrics import labelled
 from ddp_practice_tpu.utils.trace import ROUTER_PID, _resolve_clock
 
@@ -161,12 +173,15 @@ class SLOWatchdog:
 
     def __init__(self, config: SLOConfig, *, clock=None,
                  registry=None, tracer=None, telemetry=None,
-                 pid: int = ROUTER_PID) -> None:
+                 sinks=None, pid: int = ROUTER_PID) -> None:
         self.config = config
         self.budgets = config.objectives()
         self.tracer = tracer
         self.telemetry = telemetry
         self.registry = registry
+        # optional AlertSinks: every trip/resolve edge is also PUSHED
+        # (command/webhook/jsonl); evaluate() drives the retry backoff
+        self.sinks = sinks
         self.pid = pid
         # default time source when a caller omits `now`/`t` (the router
         # always passes its own clock reading explicitly — same domain)
@@ -236,6 +251,8 @@ class SLOWatchdog:
             now = self._now()
         if (not force and self._last_eval is not None
                 and now - self._last_eval < self._eval_interval):
+            if self.sinks is not None:
+                self.sinks.flush(now)   # retry backoffs ride the tick
             return self._last_report
         self._last_eval = now
         cfg = self.config
@@ -272,6 +289,11 @@ class SLOWatchdog:
                 self.registry.gauge(labelled(
                     "slo_alert_active", objective=objective,
                 )).set(float(active))
+        if self.sinks is not None:
+            # both paths flush: a backed-off retry must come due even
+            # when every evaluate() lands on the full-evaluation branch
+            # (low-rate traffic spaced past the throttle interval)
+            self.sinks.flush(now)
         self._last_report = report
         return report
 
@@ -291,9 +313,284 @@ class SLOWatchdog:
                 "alert", event=edge, objective=objective,
                 burn_fast=fast, burn_slow=slow,
             )
+        if self.sinks is not None:
+            self.sinks.send({
+                "kind": "alert", "t": now, "scope": "slo",
+                "event": edge, "objective": objective,
+                "burn_fast": fast, "burn_slow": slow,
+            })
 
     @property
     def active(self) -> bool:
         """Any objective currently alerting — the router's brown-out
         trigger."""
         return any(self.alerts.values())
+
+
+# ------------------------------------------------------------- push alerts
+@dataclasses.dataclass(frozen=True)
+class AlertSinkSpec:
+    """One push destination. `kind` is ``command`` (run it, the alert
+    JSON on stdin, exit 0 = delivered), ``webhook`` (POST the JSON to
+    the URL, 2xx/3xx = delivered), or ``jsonl`` (append one line to the
+    file)."""
+
+    kind: str
+    target: str
+    timeout_s: float = 3.0
+
+    _KINDS = ("command", "webhook", "jsonl")
+
+    def __post_init__(self):
+        if self.kind not in self._KINDS:
+            raise ValueError(
+                f"unknown alert sink kind {self.kind!r} "
+                f"(one of {self._KINDS})"
+            )
+
+    @classmethod
+    def parse(cls, text: str) -> "AlertSinkSpec":
+        """``kind:target`` — e.g. ``jsonl:/var/log/alerts.jsonl``,
+        ``webhook:http://pager.example/hook``, ``command:notify-team``.
+        A bare ``http(s)://...`` is a webhook; anything else is
+        rejected loudly (a silently-misparsed pager is no pager)."""
+        if text.startswith(("http://", "https://")):
+            return cls("webhook", text)
+        kind, sep, target = text.partition(":")
+        if not sep or not target:
+            raise ValueError(
+                f"alert sink wants kind:target, got {text!r}"
+            )
+        return cls(kind, target)
+
+
+class AlertSinks:
+    """Fan alert edges out to N sinks — bounded queue, retry backoff,
+    dead-sink breaker per sink.
+
+    `send(event)` enqueues on every live sink and attempts delivery;
+    `flush(now)` retries sinks whose backoff came due (the SLO
+    watchdog's evaluate() drives it, so retries ride the serve tick and
+    nothing here owns a thread). Delivery failures back off
+    exponentially and, after `max_failures` CONSECUTIVE failures, trip
+    the sink's breaker for good: its pending alerts drop, the
+    ``alert_sink_dead`` gauge flips, and the process stops burning
+    timeouts on a pager that is gone. `deliver` is injectable so the
+    state machine is host-pure testable (the real one shells out /
+    POSTs / appends).
+    """
+
+    PENDING_CAP = 64
+
+    def __init__(self, specs, *, clock=None, registry=None,
+                 max_failures: int = 5, base_s: float = 0.5,
+                 max_s: float = 30.0, seed: int = 0,
+                 deliver=None) -> None:
+        self._now = _resolve_clock(clock)
+        self.registry = registry
+        self.max_failures = max_failures
+        self.base_s = base_s
+        self.max_s = max_s
+        self.seed = seed
+        self._deliver_fn = deliver
+        self.sinks: List[dict] = []
+        for spec in specs:
+            if isinstance(spec, str):
+                spec = AlertSinkSpec.parse(spec)
+            self.sinks.append({
+                "spec": spec, "pending": deque(maxlen=self.PENDING_CAP),
+                "failures": 0, "next_at": 0.0, "dead": False,
+                "delivered": 0, "dropped": 0,
+            })
+
+    def _metric(self, kind: str, sink: dict):
+        if self.registry is None:
+            return None
+        # labelled() keys cannot represent "," or "=" (its documented
+        # limit — they would shear into fabricated labels at exposition
+        # time), and sink targets are operator strings that may carry
+        # both (webhook query params, comma-joined command args)
+        label = (f"{sink['spec'].kind}:{sink['spec'].target}"
+                 .replace(",", "_").replace("=", "_"))
+        return self.registry.counter(
+            labelled(f"alert_sink_{kind}_total", sink=label)
+        ) if kind != "dead" else self.registry.gauge(
+            labelled("alert_sink_dead", sink=label)
+        )
+
+    # ------------------------------------------------------------ intake
+    def send(self, event: dict) -> None:
+        now = self._now()
+        for s in self.sinks:
+            if s["dead"]:
+                continue
+            if len(s["pending"]) == s["pending"].maxlen:
+                s["dropped"] += 1  # oldest falls off the bounded deque
+            s["pending"].append(dict(event))
+        self.flush(now)
+
+    # ---------------------------------------------------------- delivery
+    def flush(self, now: Optional[float] = None) -> int:
+        """Attempt delivery on every live sink whose backoff is due;
+        returns events delivered this call."""
+        now = self._now() if now is None else now
+        delivered = 0
+        for s in self.sinks:
+            if s["dead"] or not s["pending"] or now < s["next_at"]:
+                continue
+            while s["pending"]:
+                ev = s["pending"][0]
+                if self._try_deliver(s["spec"], ev):
+                    s["pending"].popleft()
+                    s["failures"] = 0
+                    s["delivered"] += 1
+                    delivered += 1
+                    m = self._metric("delivered", s)
+                    if m is not None:
+                        m.inc()
+                    continue
+                s["failures"] += 1
+                m = self._metric("failures", s)
+                if m is not None:
+                    m.inc()
+                if s["failures"] >= self.max_failures:
+                    # the dead-sink breaker: no half-open probes — an
+                    # operator replaces a dead pager, the serve loop
+                    # must not keep paying its timeout forever
+                    s["dead"] = True
+                    s["dropped"] += len(s["pending"])
+                    s["pending"].clear()
+                    g = self._metric("dead", s)
+                    if g is not None:
+                        g.set(1)
+                else:
+                    s["next_at"] = now + backoff_delay(
+                        s["failures"] - 1, base_s=self.base_s,
+                        max_s=self.max_s, seed=self.seed,
+                    )
+                break
+        return delivered
+
+    def _try_deliver(self, spec: AlertSinkSpec, event: dict) -> bool:
+        try:
+            if self._deliver_fn is not None:
+                return bool(self._deliver_fn(spec, event))
+            return _deliver_real(spec, event)
+        except Exception:
+            return False
+
+    # --------------------------------------------------------- observing
+    @property
+    def any_alive(self) -> bool:
+        return any(not s["dead"] for s in self.sinks)
+
+    def state(self) -> List[dict]:
+        return [
+            {"sink": f"{s['spec'].kind}:{s['spec'].target}",
+             "dead": s["dead"], "failures": s["failures"],
+             "pending": len(s["pending"]),
+             "delivered": s["delivered"], "dropped": s["dropped"]}
+            for s in self.sinks
+        ]
+
+
+def _deliver_real(spec: AlertSinkSpec, event: dict) -> bool:
+    """The three transports. Failures return False (or raise — the
+    caller treats both as a failed attempt)."""
+    line = json.dumps(event)
+    if spec.kind == "jsonl":
+        with open(spec.target, "a") as f:
+            f.write(line + "\n")
+        return True
+    if spec.kind == "webhook":
+        import urllib.request
+
+        req = urllib.request.Request(
+            spec.target, data=line.encode("utf-8"),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=spec.timeout_s) as r:
+            return r.status < 400
+    if spec.kind == "command":
+        import shlex
+        import subprocess
+
+        r = subprocess.run(
+            shlex.split(spec.target), input=line + "\n",
+            capture_output=True, text=True, timeout=spec.timeout_s,
+        )
+        return r.returncode == 0
+    return False
+
+
+class FleetAlerts:
+    """Fleet-level alert edges from the federated health verdict.
+
+    The SLO watchdog judges request outcomes; this judges the FLEET —
+    the ScrapeFederator's per-worker status (healthy / degraded /
+    stale / dead). Feed it each federated healthz body (`observe`):
+    a worker leaving ``healthy`` raises a trip edge (objective
+    ``worker_dead`` / ``worker_stale`` / ...), its return a resolve —
+    through the same sinks/tracer/telemetry/counter paths as SLO
+    edges, so a dead worker pages exactly like a burning SLO. Host-pure
+    (callers do the scraping; tests feed dicts).
+    """
+
+    def __init__(self, sinks: Optional[AlertSinks] = None, *,
+                 tracer=None, telemetry=None, registry=None,
+                 clock=None, pid: int = ROUTER_PID) -> None:
+        self.sinks = sinks
+        self.tracer = tracer
+        self.telemetry = telemetry
+        self.pid = pid
+        self._now = _resolve_clock(clock)
+        self._last: Dict[str, str] = {}
+        self.alert_log: List[Tuple[float, str, str, str]] = []
+        self._ctr = (registry.counter("fleet_alerts_total")
+                     if registry is not None else None)
+
+    def observe(self, healthz: dict,
+                now: Optional[float] = None) -> List[dict]:
+        """Fold one federated /healthz body in; returns the edge events
+        raised (empty when nothing changed)."""
+        now = self._now() if now is None else now
+        edges: List[dict] = []
+        for wid, w in (healthz.get("workers") or {}).items():
+            wid = str(wid)
+            status = str(w.get("status", "dead")).lower()
+            prev = self._last.get(wid, "healthy")
+            if status == prev:
+                continue
+            self._last[wid] = status
+            if status != "healthy":
+                edges.append({"event": "trip",
+                              "objective": f"worker_{status}",
+                              "worker": wid})
+            if prev != "healthy":
+                # whatever it was before has ended — resolve it even
+                # when moving between two bad states (stale -> dead),
+                # so trips and resolves always pair per objective
+                edges.append({"event": "resolve",
+                              "objective": f"worker_{prev}",
+                              "worker": wid})
+        for e in edges:
+            self.alert_log.append(
+                (now, e["event"], e["objective"], e["worker"])
+            )
+            if e["event"] == "trip" and self._ctr is not None:
+                self._ctr.inc()
+            if self.tracer is not None and self.tracer.enabled:
+                self.tracer.instant(
+                    ("fleet_alert" if e["event"] == "trip"
+                     else "fleet_resolve"),
+                    pid=self.pid, objective=e["objective"],
+                    worker=e["worker"],
+                )
+            if self.telemetry is not None:
+                self.telemetry.emit("alert", **e)
+            if self.sinks is not None:
+                self.sinks.send({"kind": "alert", "t": now,
+                                 "scope": "fleet", **e})
+        if self.sinks is not None:
+            self.sinks.flush(now)
+        return edges

@@ -468,7 +468,8 @@ class RemoteReplicaHandle:
                  spec: WorkerSpec, *, clock=None,
                  heartbeat_timeout_s: float = 2.0,
                  poll_timeout_s: float = 1.0,
-                 poll_interval_s: float = 0.005) -> None:
+                 poll_interval_s: float = 0.005,
+                 trace_collector=None) -> None:
         self.id = slot
         self.supervisor = supervisor
         self.spec = spec
@@ -476,6 +477,11 @@ class RemoteReplicaHandle:
         self.health = ReplicaHealth()   # re-armed by the Router
         self.heartbeat_timeout_s = heartbeat_timeout_s
         self.poll_timeout_s = poll_timeout_s
+        # optional utils/trace.py TraceCollector: `trace` push frames
+        # (the worker's streamed spans) merge through it into the fleet
+        # recorder, and every timestamped ping/poll round trip feeds its
+        # per-worker clock-offset estimator
+        self.trace_collector = trace_collector
         # min spacing between heartbeat RPCs: the router ticks as fast
         # as it can, but hammering the worker's lock with a poll per
         # tick steals the very core the decode needs (measured: the
@@ -531,7 +537,7 @@ class RemoteReplicaHandle:
             rid=d["rid"], tokens=list(d["tokens"]), status=d["status"],
             arrival=d["arrival"], finish=d["finish"],
             ttft=d.get("ttft"), tpot=d.get("tpot"),
-            flight=d.get("flight"),
+            flight=d.get("flight"), trace_id=d.get("trace_id"),
         )
 
     # ---------------- the seam: submit down, completions watermark up
@@ -640,6 +646,11 @@ class RemoteReplicaHandle:
                         upto=f["watermark"], inflight=f["inflight"],
                         stats=f["stats"],
                     )
+                elif f.get("kind") == "trace" \
+                        and self.trace_collector is not None:
+                    # worker spans -> the fleet timeline (the collector
+                    # dedups by frame seq and applies the clock offset)
+                    self.trace_collector.ingest(self.id, f)
         interval = (self.stream_poll_interval_s
                     if self._stream is not None
                     else self.poll_interval_s)
@@ -648,6 +659,7 @@ class RemoteReplicaHandle:
         self._last_poll = now
         c = self._client()
         sent_wm = self.consumed
+        t0 = self.clock.now()
         try:
             r = c.call("poll", watermark=sent_wm,
                        version=self._pub_version,
@@ -666,6 +678,7 @@ class RemoteReplicaHandle:
                 )
             return  # transient blip: skip the tick, keep the salvage
         self._last_heartbeat = now
+        self._clock_sample(r, t0, self.clock.now())
         if r.get("unchanged"):
             self._pub_version = r.get("version", self._pub_version)
             return  # heartbeat only: salvage/stats still current
@@ -674,6 +687,52 @@ class RemoteReplicaHandle:
             completions=r["completions"], upto=r["watermark"],
             inflight=r["inflight"], stats=r["stats"],
         )
+
+    def _clock_sample(self, reply: dict, t0: float, t3: float) -> None:
+        """Feed one timestamped round trip to the collector's offset
+        estimator (every poll/ping reply carries the worker's clock)."""
+        if self.trace_collector is None:
+            return
+        tw = reply.get("t")
+        if tw is not None:
+            self.trace_collector.add_clock_sample(self.id, t0, tw, t3)
+
+    def measure_clock(self, samples: int = 4) -> Optional[float]:
+        """Eagerly sample the worker's clock offset over `samples`
+        pings; returns the resulting skew bound (None without a
+        collector or a reachable worker). Run against an IDLE fleet
+        (fleet build, post-restart probe) the RTT is tens of
+        microseconds — far tighter than anything measured mid-decode,
+        which is exactly why the eager pass exists: every trace frame
+        merged later rides an offset whose error bound was set here."""
+        if self.trace_collector is None:
+            return None
+        c = self._client()
+        if c is None:
+            return None
+        for _ in range(max(1, samples)):
+            t0 = self.clock.now()
+            try:
+                r = c.call("ping", timeout_s=self.poll_timeout_s,
+                           retries=0)
+            except (RpcError, RpcRemoteError):
+                break
+            self._clock_sample(r, t0, self.clock.now())
+        return self.trace_collector.skew_bound(self.id)
+
+    def set_trace(self, enabled: bool) -> bool:
+        """Toggle the worker's span recording (the overhead bench's
+        on/off lever); False when the worker has no tracer or the call
+        failed (a disabled plane, not an error)."""
+        c = self._client()
+        if c is None:
+            return False
+        try:
+            r = c.call("trace", enabled=enabled,
+                       timeout_s=self.poll_timeout_s)
+        except (RpcError, RpcRemoteError):
+            return False
+        return bool(r.get("supported"))
 
     def poll(self) -> List[Completion]:
         out, self._pending = self._pending, []
@@ -760,11 +819,13 @@ class RemoteReplicaHandle:
         c = self._client()
         if c is None:
             return False
+        t0 = self.clock.now()
         try:
-            c.call("ping", timeout_s=self.poll_timeout_s, retries=0)
-            return True
+            r = c.call("ping", timeout_s=self.poll_timeout_s, retries=0)
         except (RpcError, RpcRemoteError):
             return False
+        self._clock_sample(r, t0, self.clock.now())
+        return True
 
     def restart(self) -> None:
         """Join a freshly probed process. Usually that is a NEW
@@ -792,6 +853,12 @@ class RemoteReplicaHandle:
         #                            snapshots — never alias the old one's
         self._drop_stream()        # re-subscribes to the NEW process
         self._shed_skip.clear()    # the old process's stream died with it
+        if self.trace_collector is not None:
+            # new incarnation = new trace-frame numbering AND a new
+            # clock domain: re-measure the offset from scratch — NOW,
+            # while the freshly probed worker is still idle (tight RTT)
+            self.trace_collector.on_worker_restart(self.id)
+            self.measure_clock()
         self._last_heartbeat = self.clock.now()
         self._broken = False
 
@@ -829,6 +896,17 @@ def make_fleet_router(
     specs = [
         dataclasses.replace(base_spec, replica=i) for i in range(n_workers)
     ]
+    collector = None
+    if tracer is not None and base_spec.trace:
+        # the fleet trace plane: workers record + stream their spans
+        # (spec.trace), the collector merges them into THIS recorder
+        # under worker-N lanes with measured clock offsets applied
+        from ddp_practice_tpu.utils.trace import TraceCollector
+
+        collector = TraceCollector(tracer, registry=registry)
+        for i in range(n_workers):
+            collector.label_worker(
+                i, specs[i].engine.get("max_slots", 4))
     supervisor = Supervisor(specs, sup_config, spawn_fn=spawn_fn,
                             clock=clock)
     supervisor.start()
@@ -836,14 +914,19 @@ def make_fleet_router(
         RemoteReplicaHandle(
             i, supervisor, specs[i], clock=clock,
             heartbeat_timeout_s=heartbeat_timeout_s,
+            trace_collector=collector,
         )
         for i in range(n_workers)
     ]
+    if collector is not None:
+        for h in handles:
+            h.measure_clock()  # tight offsets BEFORE any traffic
     router = Router(
         handles, clock=clock, config=config or RouterConfig(),
         metrics=RouterMetrics(registry), tracer=tracer,
         slo=slo, telemetry=telemetry,
     )
+    router.trace_collector = collector
     return router, supervisor, handles
 
 
@@ -853,8 +936,10 @@ def make_federated_server(supervisor: Supervisor,
     """One fleet-level TelemetryServer over every worker's endpoints:
     /metrics re-labels each worker's exposition with worker="N" plus
     fleet_worker_up / heartbeat-age / restart series, /healthz renders
-    the verdict tools/check_fleet.py judges. Returns (federator,
-    server); caller owns server.close()."""
+    the verdict tools/check_fleet.py judges, /flight rolls the workers'
+    latency windows into true fleet percentiles (pooled samples, shared
+    percentile_summary). Returns (federator, server); caller owns
+    server.close()."""
     from ddp_practice_tpu.utils.telemetry import (
         ScrapeFederator,
         TelemetryServer,
@@ -865,7 +950,7 @@ def make_federated_server(supervisor: Supervisor,
         stale_after_s=stale_after_s,
     )
     server = TelemetryServer(registry=fed, healthz_fn=fed.healthz,
-                             port=port)
+                             flight_fn=fed.flight, port=port)
     return fed, server
 
 

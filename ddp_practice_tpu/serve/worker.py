@@ -65,6 +65,13 @@ class WorkerSpec:
     telemetry_port: int = 0
     warmup: bool = True
     platform: str = "cpu"       # jax platform pin ("" = leave alone)
+    # fleet tracing: record this replica's prefill/decode_burst/queued/
+    # request spans (utils/trace.py) and stream them back to the router
+    # as batched `trace` push frames, where the TraceCollector merges
+    # them into the fleet timeline. Off = zero recording (the PR-4
+    # disabled-tracer contract).
+    trace: bool = False
+    trace_buffer: int = 4096    # pending-events bound (drops counted)
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self))
@@ -75,6 +82,42 @@ class WorkerSpec:
 
 
 READY_PREFIX = "WORKER_READY "
+
+
+class _TraceBuffer:
+    """Bounded holding pen between the worker's TraceRecorder sink and
+    the push stream: spans are recorded mid-burst (under the big lock),
+    drained into one batched ``trace`` frame per publish. Bounded the
+    same way the TelemetryExporter queue is — a stalled stream drops
+    the OLDEST pending events and counts them (`dropped` rides every
+    frame, cumulative, so the router-side collector books the loss),
+    it never grows without bound and never stalls the serve loop."""
+
+    def __init__(self, maxlen: int = 4096) -> None:
+        self._lock = threading.Lock()
+        self._buf: list = []
+        self._maxlen = maxlen
+        self.dropped = 0
+
+    def put(self, rec: dict) -> None:
+        with self._lock:
+            if len(self._buf) >= self._maxlen:
+                del self._buf[0]
+                self.dropped += 1
+            self._buf.append(rec)
+
+    def drain(self) -> list:
+        with self._lock:
+            out, self._buf = self._buf, []
+        return out
+
+    def note_drops(self, n: int) -> None:
+        with self._lock:
+            self.dropped += n
+
+    def clear(self) -> None:
+        with self._lock:
+            self._buf.clear()
 
 
 def build_model(model_kw: dict):
@@ -157,8 +200,32 @@ class WorkerServer:
         self._draining = False
         self._seen_rids: dict = {}   # rid -> accepted (submit dedup)
         self._t0 = time.monotonic()
+        # fleet tracing (spec.trace): this replica's own span recorder,
+        # draining through a bounded buffer into batched `trace` push
+        # frames (see _publish). The ring buffer is small — the ROUTER
+        # holds the fleet timeline; this one only backs the stream.
+        self._tracer = None
+        self._trace_buf: Optional[_TraceBuffer] = None
+        self._trace_seq = 0
+        self._last_trace_dropped = 0
         if spec.warmup:
             self._warm()
+        if spec.trace:
+            # attached only AFTER warmup, so compile-time spans never
+            # enter the stream (the bench/router warmup-clear contract)
+            from ddp_practice_tpu.utils.trace import (
+                TraceRecorder,
+                label_replica,
+            )
+
+            self._trace_buf = _TraceBuffer(spec.trace_buffer)
+            self._tracer = TraceRecorder(
+                max_events=spec.trace_buffer, sink=self._trace_buf.put,
+            )
+            label_replica(self._tracer, spec.replica,
+                          self.engine.config.max_slots)
+            self.scheduler.tracer = self._tracer
+            self.engine.set_tracer(self._tracer, spec.replica)
         with self._lock:
             self._publish()   # ping/poll answer before the first step
         # planes come up only after warmup: a worker is dispatchable
@@ -177,6 +244,7 @@ class WorkerServer:
             "reset": self._op_reset,
             "shed": self._op_shed,
             "drain": self._op_drain,
+            "trace": self._op_trace,
             "shutdown": self._op_shutdown,
         }, port=spec.rpc_port)
 
@@ -210,7 +278,11 @@ class WorkerServer:
         if stats is None:
             with self._lock:
                 stats = self._stats()
-        return {"stats": stats}
+        # "t" is THIS clock read during handling — the remote timestamp
+        # of the NTP-style offset sample the caller may be taking
+        # (utils/trace.py ClockOffsetEstimator); the snapshot stats'
+        # own "t" is stale by up to a publish interval
+        return {"stats": stats, "t": time.monotonic()}
 
     def _op_submit(self, req: dict) -> dict:
         from ddp_practice_tpu.serve.scheduler import Request
@@ -254,6 +326,7 @@ class WorkerServer:
             "rid": c.rid, "tokens": list(c.tokens), "status": c.status,
             "arrival": c.arrival, "finish": c.finish,
             "ttft": c.ttft, "tpot": c.tpot, "flight": c.flight,
+            "trace_id": c.trace_id,
         }
 
     def _publish(self) -> None:
@@ -303,8 +376,55 @@ class WorkerServer:
                 sub["watermark"] = upto
             except Exception:
                 pass  # full queue: this frame drops, poll reconciles
+        # trace events drain ONLY toward live subscribers: with none,
+        # they stay buffered (the bounded buffer ages them out, counted)
+        # instead of being drained into a frame nobody receives —
+        # loss is counted, never silent
+        tf = self._trace_frame() if subs else None
+        if tf is not None:
+            for sub in subs:
+                try:
+                    sub["q"].put_nowait(tf)
+                except Exception:
+                    # a full push queue loses these events for good —
+                    # book them so the next frame's cumulative count
+                    # tells the collector the timeline has a hole
+                    self._trace_buf.note_drops(len(tf["events"]))
         self._last_push = time.monotonic()
         self._last_pushed_upto = upto
+
+    def _trace_frame(self) -> Optional[dict]:
+        """Drain pending trace events into one batched push frame
+        (None when nothing new happened). `seq` dedups transport
+        replays at the collector; `dropped` is cumulative."""
+        if self._trace_buf is None:
+            return None
+        events = self._trace_buf.drain()
+        dropped = self._trace_buf.dropped
+        if not events and dropped == self._last_trace_dropped:
+            return None
+        self._trace_seq += 1
+        self._last_trace_dropped = dropped
+        return {"kind": "trace", "seq": self._trace_seq,
+                "replica": self.spec.replica,
+                "events": events, "dropped": dropped}
+
+    def _op_trace(self, req: dict) -> dict:
+        """Toggle span recording at runtime (idempotent). The overhead
+        bench flips the whole trace plane off/on per rep against the
+        same warm fleet — `enabled=false` also clears anything pending,
+        so a later re-enable starts a clean stream."""
+        enabled = bool(req.get("enabled", True))
+        if self._tracer is None:
+            return {"supported": False, "enabled": False}
+        with self._lock:
+            if enabled:
+                self._tracer.enable()
+            else:
+                self._tracer.disable()
+                self._tracer.clear()
+                self._trace_buf.clear()
+        return {"supported": True, "enabled": enabled}
 
     def _op_poll(self, req: dict) -> dict:
         """The heartbeat + completions-watermark read. `watermark` is
@@ -326,8 +446,10 @@ class WorkerServer:
         if seen_version == version and watermark >= upto:
             # nothing moved since the client's last poll: answer with a
             # frame small enough that a high-rate heartbeat costs the
-            # decode loop (same single core!) close to nothing
-            return {"version": version, "unchanged": True}
+            # decode loop (same single core!) close to nothing. "t" =
+            # this clock read (clock-offset sampling, see _op_ping).
+            return {"version": version, "unchanged": True,
+                    "t": time.monotonic()}
         comps = self.scheduler.completions  # append-only list
         new = [self._completion_dict(c) for c in comps[watermark:upto]]
         if stats is None:
@@ -337,7 +459,8 @@ class WorkerServer:
                 "completions": new,
                 "watermark": upto,
                 "inflight": inflight,
-                "stats": stats}
+                "stats": stats,
+                "t": time.monotonic()}
 
     def _drain_intake_locked(self) -> int:
         """Move intake into the scheduler (big lock held by caller)."""

@@ -581,14 +581,17 @@ class Trainer:
                       self._tele_server.port)
             if config.slo:
                 from ddp_practice_tpu.serve.slo import (
+                    AlertSinks,
                     SLOConfig,
                     SLOWatchdog,
                 )
 
+                sinks = (AlertSinks(config.alert_sinks, registry=reg)
+                         if config.alert_sinks else None)
                 self._slo = SLOWatchdog(
                     SLOConfig.from_json(config.slo), registry=reg,
                     tracer=self._tracer, telemetry=self._telemetry,
-                    pid=0,
+                    sinks=sinks, pid=0,
                 )
 
     def _estimate_flops_per_step(self) -> Optional[float]:

@@ -170,10 +170,31 @@ class Gauge:
         self.value = float(v)
 
 
-class Histogram:
-    """Streaming distribution with exact count/sum and reservoir quantiles."""
+# exemplar bucket bounds: latency-shaped (seconds), OpenMetrics-style
+# cumulative `le` thresholds. An exemplar-fed histogram keeps the LAST
+# trace_id observed per bucket, so an operator reading a bad p99 bucket
+# in /metrics can jump straight to one offending trace in the merged
+# timeline instead of grepping blind.
+DEFAULT_EXEMPLAR_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, float("inf"),
+)
 
-    def __init__(self, max_samples: int = 4096) -> None:
+
+class Histogram:
+    """Streaming distribution with exact count/sum and reservoir quantiles.
+
+    `observe(v, exemplar=...)` additionally files the observation into
+    fixed `le` buckets and remembers the last exemplar (a trace_id) per
+    bucket; `render_text` then emits OpenMetrics ``name_bucket{le=...}
+    N # {trace_id="..."} v`` lines. Histograms never fed an exemplar
+    render exactly as before (no bucket lines) — the exposition stays
+    byte-stable for existing consumers.
+    """
+
+    def __init__(self, max_samples: int = 4096,
+                 buckets: Sequence[float] = DEFAULT_EXEMPLAR_BUCKETS
+                 ) -> None:
         if max_samples <= 0:
             raise ValueError("max_samples must be positive")
         self.count = 0
@@ -181,16 +202,43 @@ class Histogram:
         self._max = max_samples
         self._samples: list = []
         self._next = 0  # ring-buffer cursor once the reservoir is full
+        self.buckets = tuple(buckets)
+        if list(self.buckets) != sorted(self.buckets):
+            raise ValueError("buckets must be ascending")
+        self._bucket_counts = [0] * len(self.buckets)
+        # bucket index -> (exemplar_id, value); None until an exemplar
+        # was ever observed (gates the exposition's bucket section)
+        self._exemplars: Optional[list] = None
 
-    def observe(self, v: float) -> None:
+    def _bucket_index(self, v: float) -> int:
+        from bisect import bisect_left
+
+        i = bisect_left(self.buckets, v)
+        return min(i, len(self.buckets) - 1)
+
+    def observe(self, v: float, exemplar: Optional[str] = None) -> None:
         v = float(v)
         self.count += 1
         self.sum += v
+        i = self._bucket_index(v)
+        self._bucket_counts[i] += 1
+        if exemplar is not None:
+            if self._exemplars is None:
+                self._exemplars = [None] * len(self.buckets)
+            self._exemplars[i] = (str(exemplar), v)
         if len(self._samples) < self._max:
             self._samples.append(v)
         else:
             self._samples[self._next] = v
             self._next = (self._next + 1) % self._max
+
+    def exemplar_for(self, p: float):
+        """(exemplar_id, value) filed in the bucket holding the p-th
+        percentile (None when that bucket never saw an exemplar) — the
+        jump-from-p99-to-trace lookup /flight surfaces."""
+        if self._exemplars is None or not self._samples:
+            return None
+        return self._exemplars[self._bucket_index(self.percentile(p))]
 
     @property
     def mean(self) -> float:
@@ -332,6 +380,27 @@ class MetricsRegistry:
             lines.append(
                 f"{base}_sum{_render_labels(pairs)} {_fmt_value(h.sum)}"
             )
+            if h._exemplars is not None:
+                # OpenMetrics exemplar section — only for histograms
+                # actually FED exemplars (trace_ids from completions),
+                # so the classic summary output above stays byte-stable
+                # for everything else. Cumulative le buckets; the last
+                # exemplar filed in a bucket rides its line as
+                # `# {trace_id="..."} value`.
+                cum = 0
+                for i, le in enumerate(h.buckets):
+                    cum += h._bucket_counts[i]
+                    le_s = "+Inf" if le == float("inf") else _fmt_value(le)
+                    bpairs = pairs + [("le", le_s)]
+                    line = (f"{base}_bucket{_render_labels(bpairs)} "
+                            f"{_fmt_value(cum)}")
+                    ex = h._exemplars[i]
+                    if ex is not None:
+                        eid, ev = ex
+                        line += (f' # {{trace_id="'
+                                 f'{_escape_label_value(eid)}"}} '
+                                 f"{_fmt_value(ev)}")
+                    lines.append(line)
         return "\n".join(lines) + "\n"
 
 

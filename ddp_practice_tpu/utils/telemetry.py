@@ -71,21 +71,37 @@ class FlightStats:
     """
 
     PHASES = ("queue_s", "prefill_s", "decode_s", "stall_s")
+    # raw samples shipped per report, newest last, for fleet rollup
+    # (ScrapeFederator.flight pools every worker's samples and
+    # recomputes TRUE fleet percentiles — percentiles of percentiles
+    # would be a lie)
+    SAMPLES_PER_REPORT = 256
 
     def __init__(self, window: int = 512) -> None:
         self._lock = threading.Lock()
         self._flights: deque = deque(maxlen=window)
-        self._ttft: deque = deque(maxlen=window)
+        self._ttft: deque = deque(maxlen=window)    # (value, trace_id)
         self._tpot: deque = deque(maxlen=window)
 
     def on_completion(self, completion, **_kw) -> None:
+        tid = getattr(completion, "trace_id", None)
         with self._lock:
             if completion.flight is not None:
                 self._flights.append(completion.flight)
             if completion.ttft is not None:
-                self._ttft.append(completion.ttft)
+                self._ttft.append((completion.ttft, tid))
             if completion.tpot is not None:
-                self._tpot.append(completion.tpot)
+                self._tpot.append((completion.tpot, tid))
+
+    @staticmethod
+    def _p99_exemplar(pairs, p99):
+        """trace_id of the sample AT the rolling p99 (nearest-rank
+        returns an actual sample value, so an exact match exists);
+        None when no sample carried a trace_id."""
+        for v, tid in pairs:
+            if v == p99 and tid is not None:
+                return {"trace_id": tid, "value": v}
+        return None
 
     def report(self) -> dict:
         with self._lock:
@@ -97,8 +113,25 @@ class FlightStats:
             out[key] = percentile_summary(
                 [f[key] for f in flights if key in f]
             )
-        out["ttft_s"] = percentile_summary(ttft)
-        out["tpot_s"] = percentile_summary(tpot)
+        out["ttft_s"] = percentile_summary([v for v, _ in ttft])
+        out["tpot_s"] = percentile_summary([v for v, _ in tpot])
+        # p99 -> trace pointers (the /flight mirror of the /metrics
+        # bucket exemplars) + raw sample tails for fleet federation
+        exemplars = {}
+        ex = self._p99_exemplar(ttft, out["ttft_s"]["p99"])
+        if ex is not None:
+            exemplars["ttft_p99"] = ex
+        ex = self._p99_exemplar(tpot, out["tpot_s"]["p99"])
+        if ex is not None:
+            exemplars["tpot_p99"] = ex
+        if exemplars:
+            out["exemplars"] = exemplars
+        cap = self.SAMPLES_PER_REPORT
+        samples = {"ttft_s": [v for v, _ in ttft[-cap:]],
+                   "tpot_s": [v for v, _ in tpot[-cap:]]}
+        for key in self.PHASES:
+            samples[key] = [f[key] for f in flights[-cap:] if key in f]
+        out["samples"] = samples
         return out
 
 
@@ -158,6 +191,7 @@ class TelemetryExporter:
             "arrival": completion.arrival, "finish": completion.finish,
             "ttft": completion.ttft, "tpot": completion.tpot,
             "tokens": len(completion.tokens),
+            "trace_id": getattr(completion, "trace_id", None),
         }
         if slo_exempt:
             ev["slo_exempt"] = True
@@ -400,16 +434,22 @@ def _relabel_metric_line(line: str, extra: str) -> str:
     """Inject `extra` (e.g. worker="0") as the FIRST label of one
     Prometheus exposition line; comments/blank lines pass through. The
     value is everything after the last space (a float, never spaced),
-    so escaped label values cannot confuse the split."""
+    so escaped label values cannot confuse the split. An OpenMetrics
+    exemplar section (`value # {trace_id="..."} exemplar_value`) is
+    split off first and re-attached verbatim — the naive last-space
+    split would otherwise label the exemplar value as the sample."""
     if not line or line.startswith("#"):
         return line
-    head, _, val = line.rpartition(" ")
+    sample, sep, exemplar = line.partition(" # ")
+    head, _, val = sample.rpartition(" ")
     if not head:
         return line
     if "{" in head:
         name, rest = head.split("{", 1)
-        return f"{name}{{{extra},{rest} {val}"
-    return f"{head}{{{extra}}} {val}"
+        out = f"{name}{{{extra},{rest} {val}"
+    else:
+        out = f"{head}{{{extra}}} {val}"
+    return out + sep + exemplar
 
 
 class ScrapeFederator:
@@ -507,6 +547,48 @@ class ScrapeFederator:
                 if line and not line.startswith("#"):
                     out.append(_relabel_metric_line(line, extra))
         return "\n".join(out) + "\n"
+
+    # -------------------------------------------------- /flight rollup
+    def flight(self) -> dict:
+        """Fleet-wide latency view: every worker's /flight report,
+        plus TRUE fleet percentiles recomputed from the POOLED raw
+        sample tails the workers ship (`FlightStats` "samples") through
+        the shared percentile_summary — a percentile of per-worker
+        percentiles would be a different (wrong) number. Dead workers
+        are absent; the rollup is over who answered."""
+        targets = self.targets_fn()
+        scraped = self._get_many(targets, "/flight")
+        workers: Dict[str, dict] = {}
+        pooled: Dict[str, list] = {}
+        exemplars: Dict[str, dict] = {}
+        for wid in sorted(targets):
+            body = scraped.get(wid)
+            if body is None:
+                continue
+            try:
+                rep = json.loads(body)
+            except ValueError:
+                continue
+            samples = rep.pop("samples", {}) or {}
+            for key, vals in samples.items():
+                if isinstance(vals, list):
+                    pooled.setdefault(key, []).extend(vals)
+            for key, ex in (rep.get("exemplars") or {}).items():
+                # fleet p99 exemplar: keep the WORST per key — the
+                # trace an operator wants is the slowest one anywhere
+                cur = exemplars.get(key)
+                if cur is None or ex.get("value", 0) > cur.get("value", 0):
+                    exemplars[key] = dict(ex, worker=str(wid))
+            workers[str(wid)] = rep
+        fleet = {
+            key: percentile_summary(vals) for key, vals in pooled.items()
+        }
+        fleet["window"] = sum(
+            w.get("window", 0) for w in workers.values()
+        )
+        if exemplars:
+            fleet["exemplars"] = exemplars
+        return {"fleet": fleet, "workers": workers}
 
     # ------------------------------------------------ /healthz verdict
     def healthz(self) -> dict:

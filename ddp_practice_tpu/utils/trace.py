@@ -138,7 +138,8 @@ class TraceRecorder:
     """Bounded, clock-injected span/event recorder (see module doc)."""
 
     def __init__(self, *, clock=None, max_events: int = 65536,
-                 enabled: bool = True, sink=None) -> None:
+                 enabled: bool = True, sink=None,
+                 drop_counter=None) -> None:
         if max_events < 1:
             raise ValueError("max_events must be positive")
         self._now = _resolve_clock(clock)
@@ -146,6 +147,16 @@ class TraceRecorder:
         self._records: deque = deque(maxlen=max_events)
         self._seq = itertools.count()
         self._lock = threading.Lock()
+        # span-loss accounting: the ring buffer SILENTLY evicts the
+        # oldest record when full — count every eviction (plus drops
+        # reported by external producers, e.g. a worker's bounded trace
+        # buffer via TraceCollector) so a truncated timeline is
+        # observable instead of quietly validating. `drop_counter` is an
+        # optional utils/metrics.py Counter (trace_events_dropped_total);
+        # the count is also stamped into the export metadata so
+        # tools/check_traces.py can warn.
+        self.dropped = 0
+        self._drop_counter = drop_counter
         self._process_names: Dict[int, str] = {}
         self._thread_names: Dict[tuple, str] = {}
         # streaming sink (utils/telemetry.py TelemetryExporter): called
@@ -193,6 +204,24 @@ class TraceRecorder:
     def now(self) -> float:
         return self._now()
 
+    def _append(self, rec: "_Rec") -> None:
+        if len(self._records) == self._records.maxlen:
+            self._note_drops(1)
+        self._records.append(rec)
+
+    def _note_drops(self, n: int) -> None:
+        if n <= 0:
+            return
+        self.dropped += n
+        if self._drop_counter is not None:
+            self._drop_counter.inc(n)
+
+    def count_external_drops(self, n: int) -> None:
+        """Fold drops that happened OUTSIDE this ring buffer (a worker's
+        bounded trace buffer, a full push queue) into this recorder's
+        loss accounting — one number answers "is this timeline whole"."""
+        self._note_drops(n)
+
     def span(self, name: str, *, trace_id: Optional[str] = None,
              pid: int = 0, tid: int = 0, **attrs):
         """Lane span context manager; a shared no-op when disabled."""
@@ -206,7 +235,7 @@ class TraceRecorder:
         """Explicit-timestamp lane span (for intervals the caller timed)."""
         if not self.enabled:
             return
-        self._records.append(_Rec(
+        self._append(_Rec(
             _DUR, name, t0, t1, pid, tid, trace_id, attrs, next(self._seq)
         ))
         if self._sink is not None:
@@ -220,7 +249,7 @@ class TraceRecorder:
         so overlapping requests never fight over one lane's B/E stack."""
         if not self.enabled:
             return
-        self._records.append(_Rec(
+        self._append(_Rec(
             _ASYNC, name, t0, t1, pid, 0, trace_id, attrs, next(self._seq)
         ))
         if self._sink is not None:
@@ -231,8 +260,18 @@ class TraceRecorder:
                 pid: int = 0, tid: int = 0, **attrs) -> None:
         if not self.enabled:
             return
-        t = self._now()
-        self._records.append(_Rec(
+        self.record_instant(name, self._now(), trace_id=trace_id,
+                            pid=pid, tid=tid, attrs=attrs or None)
+
+    def record_instant(self, name: str, t: float, *,
+                       trace_id: Optional[str] = None, pid: int = 0,
+                       tid: int = 0, attrs: Optional[dict] = None) -> None:
+        """Explicit-timestamp instant — for events timed in another
+        process's clock domain (TraceCollector merges worker instants
+        with the measured offset already applied)."""
+        if not self.enabled:
+            return
+        self._append(_Rec(
             _INSTANT, name, t, t, pid, tid, trace_id, attrs or None,
             next(self._seq)
         ))
@@ -352,7 +391,13 @@ class TraceRecorder:
             ev = begin(r, "i")
             ev["s"] = "t"  # thread-scoped instant
             events.append(ev)
-        return {"traceEvents": events, "displayTimeUnit": "ms"}
+        out = {"traceEvents": events, "displayTimeUnit": "ms"}
+        if self.dropped:
+            # a flight recorder that lost events must SAY so: the
+            # validator (tools/check_traces.py) warns on this instead of
+            # blessing a quietly truncated timeline
+            out["metadata"] = {"trace_events_dropped": self.dropped}
+        return out
 
     def save(self, path: str) -> None:
         """Write the Chrome trace JSON (open in Perfetto / chrome://tracing)."""
@@ -374,3 +419,251 @@ def label_replica(recorder: TraceRecorder, replica: int,
 def label_router(recorder: TraceRecorder) -> None:
     recorder.set_process_name(ROUTER_PID, "router")
     recorder.set_thread_name(ROUTER_PID, 0, "dispatch")
+
+
+# ------------------------------------------------------- fleet trace plane
+class ClockOffsetEstimator:
+    """NTP-style clock-offset estimate from RPC round trips.
+
+    Worker processes stamp trace events with their OWN clocks; merging
+    them onto the router's timeline needs the per-worker offset. Each
+    ping/poll round trip yields one sample: the client reads its clock
+    before (t0) and after (t3) the call, the worker stamps its clock
+    (tw) while handling it; then
+
+        offset = tw - (t0 + t3) / 2        (remote minus local)
+
+    with worst-case error rtt/2 — the classic symmetric-delay bound
+    (the true receive instant lies somewhere inside [t0, t3]; assuming
+    the midpoint is wrong by at most half the round trip, however
+    asymmetric the two legs actually were). So the BEST sample is the
+    minimum-RTT one: we keep the lowest-RTT samples seen and answer
+    with the lowest's offset, `bound` = its rtt/2. `reset()` on
+    reconnect/restart — a new worker incarnation is a new clock domain.
+    """
+
+    def __init__(self, max_samples: int = 32) -> None:
+        self.max_samples = max_samples
+        self._samples: list = []   # (rtt, offset), sorted ascending rtt
+        self.total_samples = 0
+
+    def add(self, t0: float, t_remote: float, t3: float) -> bool:
+        """Fold one round trip in; True when the best (min-RTT) sample
+        — and therefore the answer — changed."""
+        if t3 < t0:
+            return False  # a torn reading is not a sample
+        rtt = t3 - t0
+        offset = t_remote - 0.5 * (t0 + t3)
+        self.total_samples += 1
+        best_before = self._samples[0] if self._samples else None
+        self._samples.append((rtt, offset))
+        self._samples.sort(key=lambda s: s[0])
+        del self._samples[self.max_samples:]
+        return self._samples[0] != best_before
+
+    @property
+    def n_samples(self) -> int:
+        return len(self._samples)
+
+    @property
+    def offset(self) -> float:
+        """Best current estimate of (remote clock - local clock); 0.0
+        until a sample exists (merge unshifted rather than invent)."""
+        return self._samples[0][1] if self._samples else 0.0
+
+    @property
+    def min_rtt(self) -> Optional[float]:
+        return self._samples[0][0] if self._samples else None
+
+    @property
+    def bound(self) -> Optional[float]:
+        """Worst-case error of `offset` (min observed rtt / 2)."""
+        return self._samples[0][0] / 2.0 if self._samples else None
+
+    def reset(self) -> None:
+        self._samples.clear()
+
+
+class TraceCollector:
+    """Router-side merge of worker-streamed trace events into ONE fleet
+    recorder.
+
+    Workers record their own prefill/decode_burst/queued/request spans
+    locally (serve/worker.py) and push them back over the RPC push
+    stream as batched ``trace`` frames; this collector folds each frame
+    into the fleet TraceRecorder so `--trace-out` exports one merged
+    timeline — the Dapper collection step. Contracts:
+
+    - **pid = worker lane.** Events arrive already stamped with the
+      worker's replica pid (the PR-4 lane convention); `label_worker`
+      names that lane ``worker-N`` so the merged trace reads as a fleet,
+      and the worker's own ``replicaN`` process_name meta is dropped in
+      favour of it. Cross-process trace_id propagation is untouched —
+      a SIGKILL-failover request's pre-crash spans (streamed before the
+      kill) and its survivor spans share the original trace_id, so it
+      renders as ONE timeline.
+    - **Clock alignment.** Every event timestamp is shifted by the
+      worker's measured offset (ClockOffsetEstimator, fed by the
+      handle's ping/poll round trips) at merge time; the current
+      offset/bound is recorded as a ``clock_offset`` instant on the
+      worker's lane whenever the estimate improves, so the exported
+      trace carries its own skew model (tools/check_traces.py --fleet
+      reads it back as the causality tolerance).
+    - **At-most-once, any order.** Frames carry a per-incarnation
+      sequence number; duplicates (transport retry / stream+poll
+      overlap) are skipped, out-of-order frames merge fine because
+      every record carries absolute timestamps (the exporter sorts).
+      `on_worker_restart` resets seq dedup and the offset — a new
+      process is a new stream and a new clock.
+    - **Loss is counted, never silent.** Frames carry the worker's
+      cumulative dropped count (bounded buffer + full push queues);
+      the delta folds into the fleet recorder's `dropped` (and the
+      optional ``trace_events_dropped_total`` counter), which the
+      export stamps into its metadata.
+    """
+
+    def __init__(self, recorder: TraceRecorder, *,
+                 registry=None) -> None:
+        self.recorder = recorder
+        if registry is not None and recorder._drop_counter is None:
+            recorder._drop_counter = registry.counter(
+                "trace_events_dropped_total"
+            )
+        self._estimators: Dict[int, ClockOffsetEstimator] = {}
+        self._seen: Dict[int, set] = {}        # applied frame seqs
+        self._last_dropped: Dict[int, int] = {}  # worker cumulative
+        self._labelled: set = set()
+        self.frames = 0
+        self.events = 0
+        self.duplicates = 0
+        # merged span/async/instant events per worker — observable
+        # progress of each worker's stream (tests gate chaos on it: a
+        # kill is only meaningful once the victim's spans ARRIVED)
+        self.events_by_worker: Dict[int, int] = {}
+
+    # --------------------------------------------------- clock alignment
+    def estimator(self, worker: int) -> ClockOffsetEstimator:
+        est = self._estimators.get(worker)
+        if est is None:
+            est = self._estimators[worker] = ClockOffsetEstimator()
+        return est
+
+    def add_clock_sample(self, worker: int, t0: float, t_remote: float,
+                         t3: float) -> None:
+        est = self.estimator(worker)
+        if est.add(t0, t_remote, t3):
+            # the estimate improved: stamp the skew model into the
+            # timeline itself (local clock domain — t3 just happened)
+            self.recorder.record_instant(
+                "clock_offset", t3, pid=worker,
+                attrs={"offset_s": est.offset, "bound_s": est.bound,
+                       "rtt_s": est.min_rtt, "samples": est.total_samples},
+            )
+
+    def offset(self, worker: int) -> float:
+        est = self._estimators.get(worker)
+        return est.offset if est is not None else 0.0
+
+    def skew_bound(self, worker: Optional[int] = None) -> Optional[float]:
+        """The measured worst-case skew — one worker's, or the fleet
+        max (the causality tolerance check_traces --fleet applies)."""
+        if worker is not None:
+            est = self._estimators.get(worker)
+            return est.bound if est is not None else None
+        bounds = [e.bound for e in self._estimators.values()
+                  if e.bound is not None]
+        return max(bounds) if bounds else None
+
+    # ----------------------------------------------------------- labels
+    def label_worker(self, worker: int, max_slots: int) -> None:
+        """Name the worker's merged lanes (pid=worker, the same
+        engine/slot tid layout label_replica stamps in-process)."""
+        self._labelled.add(worker)
+        self.recorder.set_process_name(worker, f"worker-{worker}")
+        self.recorder.set_thread_name(worker, ENGINE_LANE, "engine")
+        for s in range(max_slots):
+            self.recorder.set_thread_name(
+                worker, SLOT_LANE_BASE + s, f"slot{s}")
+
+    # ------------------------------------------------------ the ingest
+    def on_worker_restart(self, worker: int) -> None:
+        """A new incarnation numbers its own frames and runs its own
+        clock: forget the old stream's dedup set, offset, and drop
+        baseline (cumulative counts restart at 0)."""
+        self._seen.pop(worker, None)
+        self._last_dropped.pop(worker, None)
+        est = self._estimators.get(worker)
+        if est is not None:
+            est.reset()
+
+    def ingest(self, worker: int, frame: dict) -> int:
+        """Merge one ``trace`` push frame; returns events applied
+        (0 for a duplicate)."""
+        seq = frame.get("seq")
+        if seq is not None:
+            seen = self._seen.setdefault(worker, set())
+            if seq in seen:
+                self.duplicates += 1
+                return 0
+            seen.add(seq)
+            if len(seen) > 8192:   # bounded dedup window, newest kept
+                cut = max(seen) - 8192
+                self._seen[worker] = {s for s in seen if s > cut}
+        dropped = frame.get("dropped")
+        if dropped is not None:
+            delta = dropped - self._last_dropped.get(worker, 0)
+            if delta > 0:
+                self.recorder.count_external_drops(delta)
+            self._last_dropped[worker] = dropped
+        if not self.recorder.enabled:
+            # plane toggled off: the frame is consumed (seq marked,
+            # drops booked) but nothing merges — record_* would no-op
+            # silently, and counting phantom events would make
+            # `events_by_worker` overstate what the timeline holds
+            return 0
+        off = self.offset(worker)
+        rec = self.recorder
+        n = 0
+        for ev in frame.get("events", ()):
+            kind = ev.get("kind")
+            if kind == "span":
+                rec.record_span(
+                    ev["name"], ev["t0"] - off, ev["t1"] - off,
+                    trace_id=ev.get("trace_id"), pid=ev.get("pid", worker),
+                    tid=ev.get("tid", 0), attrs=ev.get("attrs"),
+                )
+            elif kind == "async":
+                rec.record_async(
+                    ev["name"], ev["t0"] - off, ev["t1"] - off,
+                    trace_id=ev.get("trace_id"),
+                    pid=ev.get("pid", worker), attrs=ev.get("attrs"),
+                )
+            elif kind == "instant":
+                rec.record_instant(
+                    ev["name"], ev["t"] - off,
+                    trace_id=ev.get("trace_id"),
+                    pid=ev.get("pid", worker), tid=ev.get("tid", 0),
+                    attrs=ev.get("attrs"),
+                )
+            elif kind == "meta":
+                # the collector's worker-N lane names win over the
+                # worker's own replicaN process label; thread names
+                # (engine/slotK) pass through for lanes not yet named
+                if ev.get("meta") == "process_name":
+                    if ev.get("pid") not in self._labelled:
+                        rec.set_process_name(ev["pid"], ev["name"])
+                elif ev.get("meta") == "thread_name":
+                    key = (ev.get("pid"), ev.get("tid"))
+                    if key not in rec._thread_names:
+                        rec.set_thread_name(ev["pid"], ev["tid"],
+                                            ev["name"])
+                n -= 1  # meta is bookkeeping, not a merged event
+            else:
+                n -= 1
+            n += 1
+        self.frames += 1
+        self.events += max(0, n)
+        self.events_by_worker[worker] = (
+            self.events_by_worker.get(worker, 0) + max(0, n)
+        )
+        return max(0, n)

@@ -174,3 +174,116 @@ def test_artifacts_validate_as_library_too():
     assert {r["kind"] for r in records} >= {
         "flight", "metrics", "alert", "span", "meta",
     }
+
+
+# ------------------------------------------- ISSUE 8: fleet trace artifact
+# a REAL 2-worker SIGKILL run's merged timeline (cli.py serve --procs 2
+# --fault-plan kill --trace-out): router dispatch/failover instants plus
+# worker-streamed spans under pid=worker-N lanes, clock_offset skew
+# model stamped by the collector
+FLEET_TRACE = os.path.join(ROOT, "tests", "data", "fleet_trace.json")
+# bench-regression ledger pair: baseline == the repo's own
+# BENCH_serve.json at the time the ledger was cut; _bad is the same
+# file with a 1.5x-regressed seam latency ratio and 2 lost requests
+BENCH_BASELINE = os.path.join(ROOT, "tests", "data",
+                              "bench_baseline.json")
+BENCH_BAD = os.path.join(ROOT, "tests", "data", "bench_current_bad.json")
+
+
+def test_check_traces_fleet_mode_exit_codes_both_ways(tmp_path):
+    # the merged 2-worker chaos timeline validates clean in fleet mode
+    r = _run("tools/check_traces.py", "--fleet", FLEET_TRACE)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "OK" in r.stdout
+    # break causality: shift every router dispatch instant 1s LATER so
+    # each precedes nothing — fleet mode must fail where plain validate
+    # still passes (instants have no lane ordering of their own)
+    trace = json.load(open(FLEET_TRACE))
+    for ev in trace["traceEvents"]:
+        if ev.get("ph") == "i" and ev.get("name") == "dispatch":
+            ev["ts"] += 1_000_000
+    bad = tmp_path / "bad_fleet.json"
+    bad.write_text(json.dumps(trace))
+    assert _run("tools/check_traces.py", str(bad)).returncode == 0
+    r = _run("tools/check_traces.py", "--fleet", str(bad))
+    assert r.returncode == 1
+    assert "causality" in r.stdout
+
+
+def test_fleet_trace_artifact_contracts():
+    """The artifact itself keeps the merge contract visible: worker
+    lanes, a measured skew model, and failover trace_id linkage."""
+    from tools.check_traces import measured_skew, validate_fleet
+
+    trace = json.load(open(FLEET_TRACE))
+    assert validate_fleet(trace) == []
+    ev = trace["traceEvents"]
+    lanes = {e["args"]["name"] for e in ev
+             if e["ph"] == "M" and e["name"] == "process_name"}
+    assert {"router", "worker-0", "worker-1"} <= lanes
+    skew = measured_skew(trace)
+    assert skew and all(b < 0.05 for b in skew.values())
+    fo = [e for e in ev if e.get("ph") == "i" and e["name"] == "failover"]
+    assert fo, "the chaos artifact must contain a failover"
+    # at least one migrated request's spans span BOTH worker lanes
+    linked = False
+    for e in fo:
+        tid = e["args"]["trace_id"]
+        pids = {x.get("pid") for x in ev
+                if (x.get("args") or {}).get("trace_id") == tid
+                or x.get("id") == tid}
+        linked = linked or ({0, 1} <= pids)
+    assert linked
+
+
+def test_check_bench_exit_codes_both_ways(tmp_path):
+    # the repo's OWN bench json vs the checked-in baseline: the ledger
+    # that keeps fleet-overhead/goodput numbers honest across PRs
+    r = _run("tools/check_bench.py", "BENCH_serve.json",
+             "--baseline", BENCH_BASELINE)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "BENCH OK" in r.stdout
+    # the regressed current fails, and names the regressed keys
+    r = _run("tools/check_bench.py", BENCH_BAD,
+             "--baseline", BENCH_BASELINE)
+    assert r.returncode == 1
+    assert "REGRESSION" in r.stdout
+    assert "latency_ratio_p50" in r.stdout
+    assert "lost" in r.stdout
+    # unreadable input is exit 2, not a fake verdict
+    garbage = tmp_path / "garbage.json"
+    garbage.write_text("{broken")
+    assert _run("tools/check_bench.py", str(garbage)).returncode == 2
+    assert _run("tools/check_bench.py",
+                str(tmp_path / "missing.json")).returncode == 2
+    # a custom gate map overrides the defaults (and --json round-trips)
+    gates = tmp_path / "gates.json"
+    gates.write_text(json.dumps({
+        "fleet_x2_sigkill_100rps.fleet.lost":
+            {"direction": "lower", "tol": 0.0},
+    }))
+    r = _run("tools/check_bench.py", BENCH_BAD, "--baseline",
+             BENCH_BASELINE, "--gates", str(gates), "--json")
+    assert r.returncode == 1
+    rep = json.loads(r.stdout)
+    assert [row["status"] for row in rep["rows"]] == ["regression"]
+
+
+def test_check_bench_as_library():
+    from tools.check_bench import bench_verdict, dig
+
+    cur = json.load(open(os.path.join(ROOT, "BENCH_serve.json")))
+    base = json.load(open(BENCH_BASELINE))
+    ok, rows = bench_verdict(cur, base)
+    assert ok, [r for r in rows if r["status"] not in ("ok", "skipped")]
+    # a key absent from the baseline is SKIPPED (ungated until the
+    # ledger refreshes), but one that vanished from current is a miss
+    ok, rows = bench_verdict(
+        cur, base, {"nonexistent.key": {"direction": "lower",
+                                        "tol": 0.1}})
+    assert ok and rows[0]["status"] == "skipped"
+    ok, rows = bench_verdict(
+        {}, base, {"fleet_x2_overhead_8rps.latency_ratio_p50":
+                   {"direction": "lower", "tol": 0.1}})
+    assert not ok and rows[0]["status"] == "missing"
+    assert dig({"a": {"b": 3}}, "a.b") == 3

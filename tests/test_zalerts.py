@@ -1,0 +1,178 @@
+"""Push alerts: sink specs, retry backoff, the dead-sink breaker, and
+fleet-level edges — all host-pure (injected clock + transport), plus
+real jsonl/command deliveries (no network).
+
+The backoff schedule is pinned against utils/backoff.py backoff_delay
+itself (the shared-schedule contract every retry loop in this repo
+holds), and the breaker is pinned to never call a dead sink again.
+"""
+
+import json
+
+import pytest
+
+from ddp_practice_tpu.serve.slo import (
+    AlertSinkSpec,
+    AlertSinks,
+    FleetAlerts,
+    SLOConfig,
+    SLOWatchdog,
+)
+from ddp_practice_tpu.utils.backoff import backoff_delay
+from ddp_practice_tpu.utils.metrics import MetricsRegistry
+
+
+# ------------------------------------------------------------ spec parsing
+def test_sink_spec_parse_forms():
+    assert AlertSinkSpec.parse("jsonl:/tmp/a.jsonl") == AlertSinkSpec(
+        "jsonl", "/tmp/a.jsonl")
+    assert AlertSinkSpec.parse("command:notify -u ops") == AlertSinkSpec(
+        "command", "notify -u ops")
+    # a bare URL is a webhook; the colon inside survives
+    s = AlertSinkSpec.parse("http://pager.example:8080/hook")
+    assert s.kind == "webhook" and s.target.endswith(":8080/hook")
+    s = AlertSinkSpec.parse("webhook:https://h/x")
+    assert (s.kind, s.target) == ("webhook", "https://h/x")
+    with pytest.raises(ValueError):
+        AlertSinkSpec.parse("bogus")
+    with pytest.raises(ValueError):
+        AlertSinkSpec.parse("smoke:signals")
+
+
+# ----------------------------------------------------- backoff + breaker
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def now(self):
+        return self.t
+
+
+def test_retry_backoff_matches_shared_schedule():
+    clock = _Clock()
+    attempts = []
+    sinks = AlertSinks(["command:x"], clock=clock, max_failures=10,
+                       base_s=0.5, max_s=30.0, seed=3,
+                       deliver=lambda s, e: attempts.append(clock.t)
+                       and False or False)
+    sinks.send({"event": "trip"})
+    assert attempts == [0.0]
+    # the k-th retry comes due exactly at the shared backoff_delay sum
+    due = 0.0
+    for k in range(3):
+        due += backoff_delay(k, base_s=0.5, max_s=30.0, seed=3)
+        clock.t = due - 1e-6
+        sinks.flush()
+        assert len(attempts) == k + 1          # just before: not due
+        clock.t = due
+        sinks.flush()
+        assert len(attempts) == k + 2          # at the edge: retried
+
+
+def test_dead_sink_breaker_stops_calling_and_drops_pending():
+    clock = _Clock()
+    calls = []
+    reg = MetricsRegistry()
+    sinks = AlertSinks(["command:x", "jsonl:y"], clock=clock,
+                       registry=reg, max_failures=2, base_s=0.1,
+                       seed=0,
+                       deliver=lambda s, e: (calls.append(s.kind),
+                                             s.kind == "jsonl")[1])
+    sinks.send({"event": "trip", "objective": "a"})
+    clock.t = 10.0
+    sinks.flush()
+    st = {s["sink"]: s for s in sinks.state()}
+    assert st["command:x"]["dead"] and st["command:x"]["pending"] == 0
+    assert not st["jsonl:y"]["dead"] and st["jsonl:y"]["delivered"] == 1
+    n = len(calls)
+    sinks.send({"event": "trip", "objective": "b"})
+    clock.t = 100.0
+    sinks.flush()
+    # the dead sink was never called again; the live one delivered
+    assert [c for c in calls[n:]] == ["jsonl"]
+    assert sinks.any_alive
+
+
+def test_pending_queue_is_bounded():
+    sinks = AlertSinks(["command:x"], clock=lambda: 0.0,
+                       max_failures=10**9, base_s=10.0,
+                       deliver=lambda s, e: False)
+    for i in range(AlertSinks.PENDING_CAP + 7):
+        sinks.send({"event": "trip", "i": i})
+    s = sinks.state()[0]
+    assert s["pending"] == AlertSinks.PENDING_CAP
+    assert s["dropped"] >= 7
+
+
+# ------------------------------------------------------- real transports
+def test_jsonl_and_command_delivery(tmp_path):
+    path = tmp_path / "alerts.jsonl"
+    sinks = AlertSinks([f"jsonl:{path}", "command:true"],
+                       clock=lambda: 0.0)
+    sinks.send({"kind": "alert", "event": "trip", "objective": "x"})
+    sinks.send({"kind": "alert", "event": "resolve", "objective": "x"})
+    lines = [json.loads(x) for x in path.read_text().splitlines()]
+    assert [x["event"] for x in lines] == ["trip", "resolve"]
+    st = {s["sink"]: s for s in sinks.state()}
+    assert st["command:true"]["delivered"] == 2
+    # a command that exits nonzero is a failed attempt
+    bad = AlertSinks(["command:false"], clock=lambda: 0.0,
+                     max_failures=1)
+    bad.send({"event": "trip"})
+    assert not bad.any_alive
+
+
+# ------------------------------------------------------ watchdog wiring
+def test_slo_watchdog_pushes_edges_to_sinks():
+    clock = _Clock()
+    got = []
+    sinks = AlertSinks(["jsonl:unused"], clock=clock,
+                       deliver=lambda s, e: got.append(dict(e)) or True)
+    wd = SLOWatchdog(
+        SLOConfig(error_rate=0.1, fast_window_s=1.0, slow_window_s=2.0,
+                  min_events=3, trip_burn=2.0, resolve_burn=1.0),
+        clock=clock, sinks=sinks,
+    )
+    for i in range(6):
+        wd.observe_event(t=clock.t, status="error")
+        clock.t += 0.05
+    wd.evaluate(clock.t, force=True)
+    assert wd.active
+    trips = [e for e in got if e["event"] == "trip"]
+    assert trips and trips[0]["objective"] == "error_rate"
+    assert trips[0]["scope"] == "slo"
+    # resolve edge pushes too
+    clock.t += 3.0
+    wd.evaluate(clock.t, force=True)
+    assert not wd.active
+    assert any(e["event"] == "resolve" for e in got)
+
+
+# ------------------------------------------------------- fleet federation
+def test_fleet_alerts_edges_on_status_transitions():
+    clock = _Clock()
+    got = []
+    reg = MetricsRegistry()
+    sinks = AlertSinks(["jsonl:x"], clock=clock,
+                       deliver=lambda s, e: got.append(dict(e)) or True)
+    fa = FleetAlerts(sinks, registry=reg, clock=clock)
+    hz = {"workers": {"0": {"status": "healthy"},
+                      "1": {"status": "healthy"}}}
+    assert fa.observe(hz) == []
+    hz["workers"]["1"]["status"] = "stale"
+    assert [e["objective"] for e in fa.observe(hz)] == ["worker_stale"]
+    # stale -> dead: trips the new objective AND resolves the old one
+    hz["workers"]["1"]["status"] = "dead"
+    edges = fa.observe(hz)
+    assert {(e["event"], e["objective"]) for e in edges} == {
+        ("trip", "worker_dead"), ("resolve", "worker_stale")}
+    hz["workers"]["1"]["status"] = "healthy"
+    assert [(e["event"], e["objective"]) for e in fa.observe(hz)] == [
+        ("resolve", "worker_dead")]
+    assert reg.counter("fleet_alerts_total").value == 2
+    assert len([e for e in got if e["scope"] == "fleet"]) == len(got)
+    # trip/resolve pairing held across the whole episode
+    trips = [e for e in got if e["event"] == "trip"]
+    resolves = [e for e in got if e["event"] == "resolve"]
+    assert {e["objective"] for e in trips} == {
+        e["objective"] for e in resolves}

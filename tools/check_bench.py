@@ -1,0 +1,194 @@
+"""Bench-regression ledger: gate BENCH_serve.json against a baseline.
+
+Every PR re-measures the serving benches (BENCH_serve.json), but until
+now nothing COMPARED runs — a fleet-overhead ratio could quietly creep
+from 1.10x to 1.4x across three PRs and every individual report would
+still look plausible. This tool is the gate: a checked-in baseline
+(tests/data/bench_baseline.json, refreshed deliberately when a number
+moves for a REASON) plus per-key tolerances, and an exit code CI can
+act on:
+
+    python tools/check_bench.py BENCH_serve.json
+    python tools/check_bench.py --baseline old.json --gates g.json new.json
+
+exit 0 = every gated key within tolerance of the baseline; 1 = at
+least one regression (or a gated key vanished from the current file —
+a dropped measurement is a silent regression too); 2 = input
+unreadable/malformed — a broken comparison must be distinguishable
+from a broken bench.
+
+Gates are dotted paths into the bench JSON with a direction and a
+tolerance::
+
+    {"fleet_x2_overhead_8rps.latency_ratio_p50":
+        {"direction": "lower", "tol": 0.15}}
+
+``lower`` = lower is better (latency ratios): current must be <=
+baseline * (1 + tol). ``higher`` = higher is better (goodput ratios):
+current >= baseline * (1 - tol). A baseline value of 0 degenerates to
+an absolute bound of tol (the zero-lost invariant: baseline 0 lost,
+tol 0 -> current must be 0). Keys absent from the BASELINE are skipped
+with a note (a new measurement has no history yet — it becomes gated
+when the baseline is refreshed).
+
+The default gate set covers the serving headlines this repo's
+acceptance criteria actually pinned: the RPC-seam and trace-plane
+overhead ratios, chaos goodput, and the zero-lost invariant.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional, Tuple
+
+OK, REGRESSION, UNREADABLE = 0, 1, 2
+
+# lower = smaller is better (overhead/latency ratios); higher = bigger
+# is better (goodput/throughput ratios). tol is the allowed relative
+# drift vs the baseline.
+DEFAULT_GATES: Dict[str, dict] = {
+    # the RPC seam's bill (PR 7 gate: p50 <= 1.10x) may drift, not creep
+    "fleet_x2_overhead_8rps.latency_ratio_p50":
+        {"direction": "lower", "tol": 0.15},
+    "fleet_x2_overhead_8rps.goodput_ratio":
+        {"direction": "higher", "tol": 0.15},
+    # real-SIGKILL chaos: goodput under faults, and NOTHING lost — the
+    # zero-lost invariant is absolute (tol 0 on a baseline of 0)
+    "fleet_x2_sigkill_100rps.goodput_ratio":
+        {"direction": "higher", "tol": 0.20},
+    "fleet_x2_sigkill_100rps.fleet.lost":
+        {"direction": "lower", "tol": 0.0},
+    # observability planes must stay ~free (their acceptance gates)
+    "tracing_overhead_100rps.mean_ratio":
+        {"direction": "lower", "tol": 0.05},
+    "telemetry_plane_overhead_100rps.mean_ratio":
+        {"direction": "lower", "tol": 0.05},
+    "fleet_trace_overhead_8rps.latency_ratio_p50":
+        {"direction": "lower", "tol": 0.05},
+    # the prefix cache's reason to exist
+    "prefix_cache_100rps.prefix_vs_paged":
+        {"direction": "higher", "tol": 0.20},
+}
+
+
+def dig(obj, dotted: str):
+    """Resolve "a.b.c" into nested dicts; None when any hop misses."""
+    cur = obj
+    for part in dotted.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return None
+        cur = cur[part]
+    return cur
+
+
+def judge_key(key: str, gate: dict, current, baseline) -> dict:
+    """One gated key's verdict row. `status`: ok / regression /
+    skipped (no baseline history) / missing (vanished from current)."""
+    direction = gate.get("direction", "lower")
+    tol = float(gate.get("tol", 0.1))
+    row = {"key": key, "direction": direction, "tol": tol,
+           "baseline": baseline, "current": current}
+    if baseline is None or not isinstance(baseline, (int, float)):
+        row["status"] = "skipped"
+        row["note"] = "no baseline value — ungated until refreshed"
+        return row
+    if current is None or not isinstance(current, (int, float)):
+        # the measurement DISAPPEARED: that is how a regression hides
+        row["status"] = "missing"
+        return row
+    if direction == "lower":
+        limit = baseline * (1.0 + tol) if baseline else tol
+        row["limit"] = limit
+        row["status"] = "ok" if current <= limit else "regression"
+    elif direction == "higher":
+        limit = baseline * (1.0 - tol)
+        row["limit"] = limit
+        row["status"] = "ok" if current >= limit else "regression"
+    else:
+        row["status"] = "regression"
+        row["note"] = f"unknown direction {direction!r}"
+    return row
+
+
+def bench_verdict(current: dict, baseline: dict,
+                  gates: Optional[Dict[str, dict]] = None
+                  ) -> Tuple[bool, List[dict]]:
+    """(ok, rows) over every gated key — the pure function the CLI and
+    the artifact tests share."""
+    rows = [
+        judge_key(key, gate, dig(current, key), dig(baseline, key))
+        for key, gate in sorted((gates or DEFAULT_GATES).items())
+    ]
+    ok = all(r["status"] in ("ok", "skipped") for r in rows)
+    return ok, rows
+
+
+def _load(path_or_json: str) -> dict:
+    text = path_or_json
+    if not text.lstrip().startswith("{"):
+        with open(text) as f:
+            text = f.read()
+    data = json.loads(text)
+    if not isinstance(data, dict):
+        raise ValueError("bench file must be a JSON object")
+    return data
+
+
+def render(source: str, ok: bool, rows: List[dict]) -> str:
+    lines = []
+    for r in rows:
+        st = r["status"]
+        mark = {"ok": "ok", "skipped": "--", "missing": "MISSING",
+                "regression": "REGRESSION"}[st]
+        cur = (f"{r['current']:.4g}"
+               if isinstance(r["current"], (int, float)) else "-")
+        base = (f"{r['baseline']:.4g}"
+                if isinstance(r["baseline"], (int, float)) else "-")
+        lim = (f" (limit {r['limit']:.4g})" if "limit" in r else "")
+        lines.append(
+            f"  {mark:>10}  {r['key']}: {cur} vs baseline {base}"
+            f" [{r['direction']} ±{r['tol']:.0%}]{lim}"
+        )
+    lines.append(f"{source}: " + ("BENCH OK" if ok else "BENCH REGRESSION"))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        "check_bench",
+        description="gate a BENCH_serve.json against a baseline's "
+                    "gated keys (latency/goodput ratios, per-key "
+                    "tolerance)",
+    )
+    p.add_argument("current", help="bench JSON path (or literal)")
+    p.add_argument("--baseline", default="tests/data/bench_baseline.json",
+                   help="baseline bench JSON (default: the checked-in "
+                        "ledger)")
+    p.add_argument("--gates", default=None, metavar="JSON|PATH",
+                   help="gate map override: dotted key -> "
+                        '{"direction": "lower"|"higher", "tol": f}')
+    p.add_argument("--json", action="store_true")
+    args = p.parse_args(argv)
+    try:
+        current = _load(args.current)
+        baseline = _load(args.baseline)
+        gates = _load(args.gates) if args.gates else None
+        if gates is not None:
+            for k, g in gates.items():
+                if not isinstance(g, dict):
+                    raise ValueError(f"gate {k!r} must be an object")
+    except (OSError, ValueError) as e:
+        print(f"UNREADABLE — {e}", file=sys.stderr)
+        return UNREADABLE
+    ok, rows = bench_verdict(current, baseline, gates)
+    if args.json:
+        print(json.dumps({"ok": ok, "rows": rows}))
+    else:
+        print(render(args.current, ok, rows))
+    return OK if ok else REGRESSION
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -38,8 +38,7 @@ from typing import List, Tuple
 OK, PROBLEM, UNREADABLE = 0, 1, 2
 
 
-def fetch_healthz(url: str, timeout_s: float = 3.0) -> dict:
-    """GET <url>/healthz from a live federated TelemetryServer."""
+def _fetch(url: str, path: str, timeout_s: float = 3.0) -> str:
     import http.client
     from urllib.parse import urlparse
 
@@ -52,23 +51,48 @@ def fetch_healthz(url: str, timeout_s: float = 3.0) -> dict:
         conn = http.client.HTTPConnection(
             u.hostname, u.port or 80, timeout=timeout_s
         )
-    conn.request("GET", "/healthz")
+    conn.request("GET", path)
     body = conn.getresponse().read().decode("utf-8", "replace")
     conn.close()
-    return json.loads(body)
+    return body
 
 
-def load_snapshot(path: str) -> dict:
-    """A saved federated /healthz body, optionally wrapped as
-    {"healthz": {...}, "metrics": "..."} (a full-plane snapshot)."""
+def fetch_healthz(url: str, timeout_s: float = 3.0) -> dict:
+    """GET <url>/healthz from a live federated TelemetryServer."""
+    return json.loads(_fetch(url, "/healthz", timeout_s))
+
+
+def fetch_flight(url: str, timeout_s: float = 3.0):
+    """GET <url>/flight (the federated latency rollup); None when the
+    endpoint is missing/unparseable — latency is a VIEW in the verdict
+    output, never a reason to call the probe broken."""
+    try:
+        body = json.loads(_fetch(url, "/flight", timeout_s))
+        return body if isinstance(body, dict) else None
+    except Exception:
+        return None
+
+
+def load_snapshot_doc(path: str):
+    """One read of a snapshot file -> (healthz, flight|None). The file
+    is either a bare federated /healthz body or a full-plane wrapper
+    {"healthz": {...}, "metrics": "...", "flight": {...}}."""
     with open(path) as f:
         data = json.load(f)
+    flight = None
     if isinstance(data, dict) and "healthz" in data:
+        fl = data.get("flight")
+        flight = fl if isinstance(fl, dict) else None
         data = data["healthz"]
     if not isinstance(data, dict) or "workers" not in data:
         raise ValueError("not a federated healthz body "
                          "(no 'workers' key)")
-    return data
+    return data, flight
+
+
+def load_snapshot(path: str) -> dict:
+    """A saved federated /healthz body (see load_snapshot_doc)."""
+    return load_snapshot_doc(path)[0]
 
 
 def fleet_verdict(healthz: dict,
@@ -104,8 +128,35 @@ def fleet_verdict(healthz: dict,
     return (not problems, problems)
 
 
+def _flight_lines(flight: dict) -> List[str]:
+    """The rolled-up latency view (federated /flight): fleet TTFT/TPOT
+    and phase percentiles over the pooled worker samples."""
+    fleet = (flight or {}).get("fleet") or {}
+    out: List[str] = []
+    keys = [k for k in ("ttft_s", "tpot_s", "queue_s", "prefill_s",
+                        "decode_s", "stall_s") if isinstance(
+                            fleet.get(k), dict)]
+    if not keys:
+        return out
+    out.append(f"  latency (fleet rollup over "
+               f"{fleet.get('window', '?')} flights):")
+    for k in keys:
+        p = fleet[k]
+        out.append(
+            f"    {k:>10}: p50 {p.get('p50', 0) * 1e3:8.2f} ms"
+            f"  p99 {p.get('p99', 0) * 1e3:8.2f} ms"
+        )
+    for name, ex in (fleet.get("exemplars") or {}).items():
+        out.append(
+            f"    exemplar {name}: trace_id {ex.get('trace_id')!r} "
+            f"({ex.get('value', 0) * 1e3:.2f} ms on worker "
+            f"{ex.get('worker', '?')})"
+        )
+    return out
+
+
 def render(source: str, healthz: dict, ok: bool,
-           problems: List[str]) -> str:
+           problems: List[str], flight: dict = None) -> str:
     lines = [f"{source}: fleet {healthz.get('status', '?')}"]
     for wid in sorted(healthz.get("workers", {})):
         w = healthz["workers"][wid]
@@ -118,6 +169,7 @@ def render(source: str, healthz: dict, ok: bool,
             f"  heartbeat "
             + (f"{hb:.2f}s" if hb is not None else "-")
         )
+    lines.extend(_flight_lines(flight))
     if ok:
         lines.append(f"{source}: OK")
     else:
@@ -146,11 +198,13 @@ def main(argv=None) -> int:
     rc = OK
     reports = {}
     for target in args.targets:
+        flight = None
         try:
             if target.startswith(("http://", "https://")):
                 healthz = fetch_healthz(target)
+                flight = fetch_flight(target)
             else:
-                healthz = load_snapshot(target)
+                healthz, flight = load_snapshot_doc(target)
         except Exception as e:
             if args.json:
                 reports[target] = {"error": str(e)}
@@ -167,8 +221,10 @@ def main(argv=None) -> int:
                 for wid, w in healthz.get("workers", {}).items()
             },
         }
+        if flight is not None:
+            reports[target]["flight"] = flight.get("fleet", flight)
         if not args.json:
-            print(render(target, healthz, ok, problems))
+            print(render(target, healthz, ok, problems, flight))
         if not ok:
             rc = max(rc, PROBLEM)
     if args.json:

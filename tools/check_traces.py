@@ -40,6 +40,21 @@ Checks (each one a real corruption mode of the exporter):
 - **matched async b/e** per (pid, id): b before e, same name, ts
   ordered, nothing left open;
 - only known phases (B E b e i M X C) appear.
+
+FLEET mode (``--fleet [--skew-s S]``): the extra contracts of a MERGED
+cross-process timeline (utils/trace.py TraceCollector):
+
+- **cross-process causality**: every router ``dispatch`` instant
+  (pid=router, args replica/trace_id) must precede that worker's
+  ``queued``/``request`` span start for the same trace_id — within the
+  clock-skew tolerance. The tolerance is the trace's own measured skew
+  model (the ``clock_offset`` instants the collector stamps, worst
+  bound across workers) unless ``--skew-s`` overrides it;
+- a killed worker's TRUNCATED stream is tolerated: missing worker-side
+  spans are not an error (the spans that did arrive pre-crash still
+  validate), only an out-of-order one is;
+- dropped-event metadata (``trace_events_dropped``) prints as a WARNING
+  either way — a lossy timeline is usable but must say so.
 """
 
 from __future__ import annotations
@@ -160,6 +175,82 @@ def validate(trace) -> List[str]:
             errors.append(
                 f"async id {key[1]!r} (pid {key[0]}): "
                 f"{len(stack)} unclosed b"
+            )
+    return errors
+
+
+def measured_skew(trace) -> dict:
+    """Per-pid worst-case clock-skew bound from the ``clock_offset``
+    instants the TraceCollector stamps (empty when the trace carries
+    no skew model — a single-process trace, or offsets never measured)."""
+    bounds: dict = {}
+    for ev in trace.get("traceEvents", []):
+        if not (isinstance(ev, dict) and ev.get("ph") == "i"
+                and ev.get("name") == "clock_offset"):
+            continue
+        b = (ev.get("args") or {}).get("bound_s")
+        if isinstance(b, (int, float)):
+            pid = ev.get("pid")
+            # the estimate improves over the run, but events merged
+            # EARLY were shifted under the then-current (cruder)
+            # offset: the honest per-pid tolerance is the WORST bound
+            # that was ever in effect, not the final tightest one
+            bounds[pid] = max(b, bounds.get(pid, 0.0))
+    return bounds
+
+
+def validate_fleet(trace, skew_s=None) -> List[str]:
+    """Fleet-merge causality checks on top of `validate` (run both).
+
+    For every router ``dispatch`` instant targeting (replica R,
+    trace_id T): if worker R recorded any ``queued``/``request`` span
+    start for T, at least one must start at-or-after the dispatch
+    minus the skew tolerance — time cannot flow backwards across the
+    RPC hop by more than the measured clock uncertainty. A worker with
+    NO spans for a dispatched trace_id is tolerated (SIGKILL truncates
+    streams mid-run; the merged timeline stays valid, just shorter).
+    `skew_s` None = use the trace's own measured bounds (plus a small
+    floor for quantization), falling back to 50 ms when unmeasured.
+    """
+    errors: List[str] = []
+    events = trace.get("traceEvents", [])
+    if not isinstance(events, list):
+        return errors
+    bounds = measured_skew(trace)
+    default_skew = max(bounds.values()) if bounds else 0.05
+    dispatches = []          # (ts_us, replica, trace_id)
+    starts = {}              # (pid, trace_id) -> [start_ts_us, ...]
+    for ev in events:
+        if not isinstance(ev, dict):
+            continue
+        args = ev.get("args") or {}
+        if ev.get("ph") == "i" and ev.get("name") == "dispatch":
+            if "replica" in args and "trace_id" in args:
+                dispatches.append(
+                    (ev.get("ts"), args["replica"], args["trace_id"])
+                )
+        elif ev.get("ph") in ("b", "X") and ev.get("name") in (
+                "queued", "request"):
+            tid = args.get("trace_id", ev.get("id"))
+            if tid is not None:
+                key = (ev.get("pid"), tid)
+                starts.setdefault(key, []).append(ev.get("ts"))
+    if not dispatches:
+        return errors
+    for ts, replica, trace_id in dispatches:
+        got = starts.get((replica, trace_id))
+        if not got:
+            continue  # truncated worker stream: tolerated
+        skew = skew_s if skew_s is not None else max(
+            bounds.get(replica, default_skew), 0.001
+        )
+        tol_us = skew * 1e6
+        if max(got) < ts - tol_us:
+            errors.append(
+                f"causality: dispatch of {trace_id!r} to replica "
+                f"{replica} at {ts}us but every worker-side span "
+                f"starts before it (latest {max(got)}us, "
+                f"tolerance {tol_us:.0f}us) — merge offsets wrong?"
             )
     return errors
 
@@ -301,8 +392,26 @@ def main(argv=None) -> int:
     if not args or args[0] in ("-h", "--help"):
         print(__doc__)
         return 0
+    fleet = False
+    skew_s = None
+    paths = []
+    it = iter(args)
+    for a in it:
+        if a == "--fleet":
+            fleet = True
+        elif a == "--skew-s":
+            try:
+                skew_s = float(next(it))
+            except (StopIteration, ValueError):
+                print("--skew-s wants a number (seconds)")
+                return 1
+        else:
+            paths.append(a)
+    if not paths:
+        print("no trace files given")
+        return 1
     rc = 0
-    for path in args:
+    for path in paths:
         try:
             with open(path) as f:
                 text = f.read()
@@ -330,6 +439,13 @@ def main(argv=None) -> int:
         else:
             errors = []
         errors += validate(trace)
+        if fleet:
+            errors += validate_fleet(trace, skew_s)
+        dropped = 0
+        if isinstance(trace, dict):
+            md = trace.get("metadata")
+            if isinstance(md, dict):
+                dropped = md.get("trace_events_dropped", 0) or 0
         s = summarize(trace)
         if errors:
             rc = 1
@@ -344,6 +460,12 @@ def main(argv=None) -> int:
             spans = ", ".join(f"{n} x{c}" for n, c in top) or "none"
             print(f"{path}: OK — {s['events']} events, "
                   f"pids {s['pids']}, spans: {spans}{note}")
+        if dropped:
+            # a warning, not a verdict: the timeline is valid but has a
+            # hole — whoever reads it should know before trusting gaps
+            print(f"{path}: WARNING — {dropped} trace event(s) were "
+                  f"dropped (bounded buffers); the timeline is "
+                  f"truncated, not corrupt")
     return rc
 
 
