@@ -45,6 +45,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 from typing import Optional
@@ -992,6 +993,295 @@ def fleet_trace_overhead_bench(
         sup.stop()
 
 
+def _score_streams(router, comps) -> dict:
+    """Score and CLEAR the router's TokenStreams from the consumer's
+    seat (the bench IS the consumer). Everything here is re-derived
+    from the delivered events, independently of the router's own
+    cursors: `chunk_dupes`/`chunk_gaps` recount token-offset overlaps
+    and holes (the exactly-once gate pins both at 0 — `suppressed` is
+    the router absorbing re-decoded salvage and is EXPECTED under
+    chaos), `inter_token_s` is the per-token delivery cadence between
+    consecutive chunk arrivals, `ttft_s` is first DELIVERED token
+    minus arrival, and `resume_gap_s` is the consumer-visible stall a
+    failover splice cost each resumed stream."""
+    arrival = {c.rid: c.arrival for c in comps}
+    final_tokens = {c.rid: c.tokens for c in comps}
+    inter, ttft, gaps_s = [], [], []
+    dupes = holes = suppressed = resumed = 0
+    unterminated = mismatched = 0
+    for rid, st in router.streams.items():
+        delivered = 0
+        last_t = None
+        for ev in st.events:
+            if ev.kind == "resumed":
+                resumed += 1
+                continue
+            if ev.kind != "tokens" or not ev.tokens:
+                continue
+            if ev.start < delivered:
+                dupes += delivered - ev.start
+            elif ev.start > delivered:
+                holes += ev.start - delivered
+            delivered = ev.start + len(ev.tokens)
+            if last_t is None:
+                if rid in arrival:
+                    ttft.append(ev.t - arrival[rid])
+            else:
+                # one chunk = one consumer-visible delivery; its
+                # tokens share the arrival instant, so the per-token
+                # cadence is the chunk gap amortized over the chunk
+                inter.extend([(ev.t - last_t) / len(ev.tokens)]
+                             * len(ev.tokens))
+            last_t = ev.t
+        if not st.closed:
+            unterminated += 1
+        if st.tokens() != final_tokens.get(rid, st.tokens()):
+            mismatched += 1  # stream view disagrees with completion
+        if st.resume_gap_s:
+            gaps_s.append(st.resume_gap_s)
+        suppressed += st.suppressed
+        holes += st.gaps
+    n = len(router.streams)
+    router.streams.clear()
+    return {
+        "streams": n,
+        "chunk_dupes": dupes,
+        "chunk_gaps": holes,
+        "suppressed_tokens": suppressed,
+        "resumed_markers": resumed,
+        "unterminated": unterminated,
+        "stream_completion_mismatches": mismatched,
+        "inter_token_s": _percentiles(inter),
+        "consumer_ttft_s": _percentiles(ttft),
+        "resume_gap_s": _percentiles(gaps_s),
+        "resume_gap_p99_s": (_percentiles(gaps_s).get("p99", 0.0)
+                             if gaps_s else 0.0),
+        "inter_token_p99_s": (_percentiles(inter).get("p99", 0.0)
+                              if inter else 0.0),
+    }
+
+
+def streaming_bench(
+    *,
+    n_requests: int = 32,
+    rate_hz: float = 8.0,
+    procs: int = 2,
+    max_slots: int = 8,
+    vocab: int = 64,
+    hidden: int = 128,
+    depth: int = 2,
+    heads: int = 4,
+    mlp: int = 256,
+    max_len: int = 128,
+    prompt_buckets=(8, 16),
+    prompt_len_range=(2, 16),
+    max_new_range=(2, 32),
+    decode_burst: int = 8,
+    eos_id: Optional[int] = 46,
+    seed: int = 0,
+    reps: int = 6,
+    fault_plan=None,
+    telemetry_out: Optional[str] = None,
+) -> dict:
+    """Token STREAMING through the worker fleet, two operating points:
+
+    - overhead (no kill plan): the same trace replays through TWO warm
+      worker fleets — streaming delivery (chunks in every pub frame,
+      router TokenStreams armed) vs end-of-request delivery (chunk
+      plane fully off, worker-side and router-side) — in alternating
+      order per rep; the headline is the median per-rep MEAN-latency
+      ratio (acceptance gate: <= 1.05x at 8 rps). Every rep also
+      cross-checks each stream's concatenation against its completion.
+
+    - chaos (`fault_plan` with kill specs): ONE streaming fleet, real
+      signals mid-stream, and the report is the CONSUMER'S ledger —
+      re-derived duplicate/missing token counts (gated at zero),
+      inter-token p99 at the consumer, resume-gap p99 (the stall a
+      SIGKILL splice actually cost), resumed-marker count, and the
+      tools/check_stream.py audit over the run's telemetry JSONL
+      (`telemetry_out`; a temp file when not asked for)."""
+    from ddp_practice_tpu.serve.router import RouterConfig
+    from ddp_practice_tpu.serve.faults import FleetFaultDriver
+    from ddp_practice_tpu.serve.supervisor import (
+        SupervisorConfig,
+        make_fleet_router,
+    )
+    from ddp_practice_tpu.serve.worker import WorkerSpec
+    from ddp_practice_tpu.utils.telemetry import TelemetryExporter
+
+    model_kw = {
+        "vocab_size": vocab, "max_len": max_len, "hidden_dim": hidden,
+        "depth": depth, "num_heads": heads, "mlp_dim": mlp,
+        "pos_emb": "rope",
+    }
+    trace = build_trace(
+        n_requests=n_requests, rate_hz=rate_hz, vocab=vocab,
+        prompt_len_range=prompt_len_range, max_new_range=max_new_range,
+        seed=seed,
+    )
+    chaos = fault_plan is not None and bool(fault_plan.kills())
+    if fault_plan is not None and not chaos:
+        raise ValueError("streaming_bench interprets only 'kill' specs")
+    engine_kw = {
+        "max_slots": max_slots, "max_len": max_len,
+        "prompt_buckets": list(prompt_buckets),
+        "temperature": 0.0, "decode_burst": decode_burst,
+        "eos_id": eos_id,
+    }
+    max_queue = len(trace) * max(1, reps)
+
+    def build(stream: bool, telemetry=None):
+        return make_fleet_router(
+            WorkerSpec(model=model_kw, engine=dict(engine_kw),
+                       max_queue=max_queue, stream=stream),
+            procs,
+            config=RouterConfig(streaming=stream),
+            sup_config=SupervisorConfig(restart_base_s=0.25),
+            telemetry=telemetry,
+        )
+
+    def med(xs):
+        s = sorted(xs)
+        n = len(s)
+        return (s[n // 2] if n % 2
+                else 0.5 * (s[n // 2 - 1] + s[n // 2]))
+
+    report = {
+        "trace": {
+            "n_requests": n_requests, "rate_hz": rate_hz, "seed": seed,
+            "prompt_len_range": list(prompt_len_range),
+            "max_new_range": list(max_new_range),
+        },
+        "procs": procs,
+    }
+
+    if chaos:
+        # ---------------- chaos arm: one streaming fleet, real kills
+        tmp = None
+        if telemetry_out is None:
+            import tempfile
+
+            tmp = tempfile.NamedTemporaryFile(
+                suffix=".jsonl", delete=False)
+            telemetry_out = tmp.name
+            tmp.close()
+        exporter = TelemetryExporter(telemetry_out,
+                                     snapshot_interval_s=0.0)
+        router, sup, handles = build(True, telemetry=exporter)
+        try:
+            driver = FleetFaultDriver(fault_plan, sup.kill)
+            before = len(router.completions)
+            row = _replay_through_router(router, trace, driver=driver,
+                                         fleet=True)
+            comps = router.completions[before:]
+            streams = _score_streams(router, comps)
+            m = router.metrics
+            row.update({
+                "mode": f"stream fleet x{procs}",
+                "failovers": m.failovers.value,
+                "retries": m.retries.value,
+                "worker_restarts": list(sup.restarts),
+                "kills_fired": [
+                    {"replica": f.replica, "sig": f.sig, "at_s": f.at_s}
+                    for f in driver.fired
+                ],
+            })
+            report.update({
+                "reps": 1,
+                "fleet": row,
+                "fault_plan": fault_plan.to_json(),
+                "telemetry_out": telemetry_out,
+                # the gated keys, at top level for check_bench's dotted
+                # paths: exactly-once re-derived at the consumer
+                "chunk_dupes": streams["chunk_dupes"],
+                "chunk_gaps": streams["chunk_gaps"],
+                "lost": row["lost"],
+                "unterminated": streams["unterminated"],
+                "stream_completion_mismatches":
+                    streams["stream_completion_mismatches"],
+                "inter_token_p99_s": streams["inter_token_p99_s"],
+                "resume_gap_p99_s": streams["resume_gap_p99_s"],
+                "streams": streams,
+            })
+        finally:
+            sup.stop()
+            exporter.close()
+        # offline audit of the SAME contract from the telemetry file
+        # alone — the artifact a production incident would have
+        try:
+            from tools.check_stream import load_jsonl, stream_verdict
+
+            ok, audit = stream_verdict(load_jsonl(telemetry_out))
+            report["check_stream"] = {
+                "ok": ok, "streams": audit["streams"],
+                "violations": sum(len(v)
+                                  for v in audit["violations"].values()),
+            }
+        except ImportError:  # tools/ not importable (installed pkg)
+            report["check_stream"] = {"ok": None}
+        if tmp is not None:
+            os.unlink(telemetry_out)
+            report.pop("telemetry_out")
+        return report
+
+    # ------------- overhead arm: streaming vs end-of-request delivery
+    r_on, sup_on, _ = build(True)
+    r_off, sup_off, _ = build(False)
+    rows = {"on": [], "off": []}
+    mismatches = 0
+    try:
+        for rep in range(reps):
+            order = ["on", "off"] if rep % 2 == 0 else ["off", "on"]
+            for side in order:
+                router = r_on if side == "on" else r_off
+                before = len(router.completions)
+                rows[side].append(_replay_through_router(
+                    router, trace, rid_offset=rep * 1_000_000,
+                    fleet=True,
+                ))
+                if side == "on":
+                    comps = router.completions[before:]
+                    streams = _score_streams(router, comps)
+                    mismatches += (
+                        streams["stream_completion_mismatches"]
+                        + streams["chunk_dupes"] + streams["chunk_gaps"]
+                        + streams["unterminated"])
+                    rows[side][-1]["streams"] = streams
+        ratios_mean = [on["latency_s"]["mean"] / off["latency_s"]["mean"]
+                       for on, off in zip(rows["on"], rows["off"])]
+        ratios_p50 = [on["latency_s"]["p50"] / off["latency_s"]["p50"]
+                      for on, off in zip(rows["on"], rows["off"])]
+        report.update({
+            "reps": reps,
+            "gate": "mean <= 1.05x",
+            "latency_ratio_mean": med(ratios_mean),
+            "latency_ratio_mean_per_rep": ratios_mean,
+            "latency_ratio_p50": med(ratios_p50),
+            "goodput_ratio": med(
+                [on["goodput_tokens_per_sec"]
+                 / off["goodput_tokens_per_sec"]
+                 for on, off in zip(rows["on"], rows["off"])]
+            ),
+            "streaming": {
+                "latency_s": rows["on"][-1]["latency_s"],
+                "lost": sum(r["lost"] for r in rows["on"]),
+                "last_rep_streams": rows["on"][-1]["streams"],
+            },
+            "end_of_request": {
+                "latency_s": rows["off"][-1]["latency_s"],
+                "lost": sum(r["lost"] for r in rows["off"]),
+            },
+            # every rep's exactly-once cross-check, summed: stream-vs-
+            # completion disagreements + re-derived dupes/gaps +
+            # unterminated streams (all must be 0 fault-free)
+            "stream_violations": mismatches,
+        })
+        return report
+    finally:
+        sup_on.stop()
+        sup_off.stop()
+
+
 def _exemplar_resolution(sup, handles, tracer) -> dict:
     """Scrape each worker's /metrics and answer the acceptance
     question: does the TTFT p99 latency bucket carry an exemplar
@@ -1560,6 +1850,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "sink — command:..., webhook:http://..., "
                         "jsonl:path (serve/slo.py AlertSinks: per-sink "
                         "retry backoff, dead-sink breaker); needs --slo")
+    p.add_argument("--streaming", action="store_true",
+                   help="with --procs: bench STREAMING token delivery "
+                        "(per-burst TokenChunks over the push stream, "
+                        "router TokenStreams). Without --fault-plan: "
+                        "A/B vs end-of-request delivery over order-"
+                        "balanced reps (gate: mean latency <= 1.05x). "
+                        "With a kill --fault-plan: one chaos rep, real "
+                        "signals mid-stream, consumer-side exactly-once "
+                        "ledger (dupes/gaps gated 0, inter-token p99, "
+                        "resume-gap p99) + tools/check_stream.py audit "
+                        "of the telemetry JSONL")
     p.add_argument("--trace-overhead", dest="trace_overhead",
                    action="store_true",
                    help="with --procs: measure the fleet trace plane's "
@@ -1707,6 +2008,50 @@ def main(argv=None) -> int:
                 print(f"  wrote merged trace to {report['trace_out']} — "
                       f"validate with tools/check_traces.py --fleet")
         return 0
+    if args.procs and args.streaming:
+        from ddp_practice_tpu.serve.faults import FaultPlan
+
+        plan = (FaultPlan.from_json(args.fault_plan)
+                if args.fault_plan else None)
+        report = streaming_bench(
+            n_requests=args.requests, rate_hz=args.rate,
+            max_slots=args.max_slots, procs=args.procs,
+            seed=args.seed, fault_plan=plan,
+            telemetry_out=args.telemetry_out,
+            **({"decode_burst": args.decode_burst}
+               if args.decode_burst is not None else {}),
+        )
+        if args.json:
+            print(json.dumps(report))
+        elif "fleet" in report:  # chaos arm
+            fl, st = report["fleet"], report["streams"]
+            print(f"[streaming_bench chaos] {args.requests} requests @ "
+                  f"{args.rate}/s, {args.procs} workers, kills "
+                  f"{fl['kills_fired']}")
+            print(f"  consumer ledger: dupes {report['chunk_dupes']}  "
+                  f"gaps {report['chunk_gaps']}  lost {report['lost']}  "
+                  f"unterminated {report['unterminated']}  "
+                  f"resumed markers {st['resumed_markers']}  "
+                  f"suppressed {st['suppressed_tokens']} tok")
+            print(f"  inter-token p99 "
+                  f"{report['inter_token_p99_s'] * 1e3:.2f} ms  "
+                  f"resume gap p99 "
+                  f"{report['resume_gap_p99_s'] * 1e3:.1f} ms")
+            cs = report.get("check_stream", {})
+            print(f"  check_stream audit: ok={cs.get('ok')} over "
+                  f"{cs.get('streams', 0)} stream(s), "
+                  f"{cs.get('violations', 0)} violation(s)")
+        else:
+            print(f"[streaming_bench] {args.requests} requests @ "
+                  f"{args.rate}/s, {args.procs} workers, "
+                  f"{report['reps']} order-balanced reps")
+            print(f"  streaming vs end-of-request: latency mean "
+                  f"{report['latency_ratio_mean']:.3f}x  p50 "
+                  f"{report['latency_ratio_p50']:.3f}x  goodput "
+                  f"{report['goodput_ratio']:.3f}x  ({report['gate']})")
+            print(f"  exactly-once cross-check violations: "
+                  f"{report['stream_violations']}")
+        return 0
     if args.procs:
         from ddp_practice_tpu.serve.faults import FaultPlan
 
@@ -1760,6 +2105,10 @@ def main(argv=None) -> int:
         raise SystemExit("--trace-overhead needs --procs N (it measures "
                          "the fleet trace plane against worker "
                          "processes)")
+    if args.streaming:
+        raise SystemExit("--streaming needs --procs N (chunks ride the "
+                         "worker push stream; the in-process router "
+                         "streams by default already)")
     if args.alert_sink and not args.slo:
         raise SystemExit("--alert-sink needs --slo (the sinks carry the "
                          "watchdog's trip/resolve edges)")

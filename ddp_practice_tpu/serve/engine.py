@@ -187,6 +187,14 @@ class _EngineBase:
     # set by each subclass __init__ via set_tracer defaults
     tracer = None
     replica = 0
+    # per-burst surfacing for the streaming plane: how many decode
+    # dispatches this engine ever ran and how many slots were live in
+    # the last one. The scheduler stamps `burst_seq` onto each
+    # TokenChunk's telemetry line, so per-chunk flight accounting can
+    # tell "no bursts ran" (a stalled engine) from "bursts ran without
+    # this request" (preempted / queued) when attributing a resume gap.
+    burst_seq = 0
+    last_burst_active = 0
 
     def set_tracer(self, tracer, replica: int = 0) -> None:
         """Attach a utils/trace.py TraceRecorder; `replica` is this
@@ -470,6 +478,8 @@ class SlotEngine(_EngineBase):
             )
             self.cursor += k
             toks, finite = jax.device_get((toks, finite))
+        self.burst_seq += 1
+        self.last_burst_active = int(np.count_nonzero(self._active))
         # (K, max_slots) bool: False rows mark slots whose token this
         # burst was sampled from non-finite logits — the scheduler
         # finishes those requests with status "error"
@@ -1171,6 +1181,8 @@ class PagedEngine(_EngineBase):
             )
             self._len[self._active] += k
             toks, finite = jax.device_get((toks, finite))
+        self.burst_seq += 1
+        self.last_burst_active = int(np.count_nonzero(self._active))
         self.last_finite = np.asarray(finite)
         return np.asarray(toks)
 

@@ -104,6 +104,12 @@ class RouterConfig:
     # replica probe jitter folds in the replica id — deterministic replay,
     # de-synchronized fleet
     seed: int = 0
+    # ---- streaming delivery: expose a per-request TokenStream fed from
+    # the replicas' TokenChunks (scheduler.py), with the exactly-once /
+    # resume contract. False = end-of-request delivery only (the
+    # overhead bench's control arm; chunks from replicas are drained
+    # and discarded so handle state stays bounded).
+    streaming: bool = True
 
 
 @dataclasses.dataclass
@@ -122,6 +128,74 @@ class _Tracked:
     queue_s: float = 0.0
     prefill_s: float = 0.0
     decode_s: float = 0.0
+    # streaming splice point: len(prefix) at the CURRENT dispatch — a
+    # chunk's attempt-local `start` plus this base is its absolute
+    # offset in the client's output (the dedup key after failover)
+    dispatch_base: int = 0
+
+
+@dataclasses.dataclass
+class StreamEvent:
+    """One edge on a TokenStream, in consumer order.
+
+    `kind` is ``tokens`` (new output, never re-delivered), ``resumed``
+    (a failover/retry splice happened HERE — the marker the exactly-once
+    contract emits instead of duplicate or missing tokens), or ``end``
+    (terminal, carries the request's final status — a brown-out shed
+    mid-stream ends the stream with status "shed", never silence).
+    `seq` is contiguous per stream from 0; `start` is the absolute
+    token offset of `tokens[0]` in the client's output."""
+
+    kind: str
+    seq: int
+    trace_id: Optional[str]
+    t: float
+    start: int = 0
+    tokens: List[int] = dataclasses.field(default_factory=list)
+    status: Optional[str] = None
+    attrs: Optional[dict] = None
+
+
+class TokenStream:
+    """Per-request consumer stream with the exactly-once contract.
+
+    The router appends StreamEvents as replica TokenChunks arrive;
+    `delivered` counts absolute tokens handed to the consumer, and any
+    chunk tokens at offsets below it are suppressed (counted in
+    `suppressed`) — that is how a failover's re-decode of the salvaged
+    prefix never reaches the consumer twice. `gaps` counts offsets
+    that were skipped forward over (the chaos pin asserts 0: chunks
+    and the salvage point ride the same worker frame, so the resume
+    cursor can never outrun delivery). `resume_gap_s` sums the time
+    the stream sat between a resume marker and its next token — the
+    stall the flight record attributes to failover."""
+
+    def __init__(self, rid: int, trace_id: Optional[str]) -> None:
+        self.rid = rid
+        self.trace_id = trace_id
+        self.events: List[StreamEvent] = []
+        self.delivered = 0
+        self.closed = False
+        self.status: Optional[str] = None
+        # replica tokens suppressed by the dedup cursor (failover
+        # re-decode of the salvaged prefix lands here — EXPECTED under
+        # chaos; consumer-visible duplicates are structurally impossible
+        # and re-checked from the event log by bench/check_stream)
+        self.suppressed = 0
+        self.gaps = 0
+        self.resume_gap_s = 0.0
+        self._resumed_at: Optional[float] = None
+
+    @property
+    def next_seq(self) -> int:
+        return len(self.events)
+
+    def tokens(self) -> List[int]:
+        """The consumer's view: every delivered token, concatenated."""
+        out: List[int] = []
+        for ev in self.events:
+            out.extend(ev.tokens)
+        return out
 
 
 class ReplicaHandle:
@@ -148,6 +222,7 @@ class ReplicaHandle:
         self.engine: SlotEngine = scheduler.engine
         self.health = ReplicaHealth(breaker)
         self.consumed = 0  # completions watermark (survives restarts)
+        self.chunks_consumed = 0  # TokenChunk watermark (same contract)
 
     # --------------- the seam: submit down, completions watermark up
     def submit(self, req: Request) -> None:
@@ -164,6 +239,15 @@ class ReplicaHandle:
         """Completions since the watermark (consume-once)."""
         comps = self.scheduler.completions
         new, self.consumed = comps[self.consumed:], len(comps)
+        return new
+
+    def poll_chunks(self) -> List:
+        """TokenChunks since the chunk watermark (consume-once) — the
+        streaming twin of poll(). The list is append-only across
+        restarts, so the watermark never replays."""
+        chunks = self.scheduler.chunks
+        new = chunks[self.chunks_consumed:]
+        self.chunks_consumed = len(chunks)
         return new
 
     def evacuate(self) -> List[tuple]:
@@ -303,6 +387,13 @@ class Router:
             self.handles.append(h)
         self.tracked: Dict[int, _Tracked] = {}
         self.completions: List[Completion] = []
+        # streaming registry: rid -> TokenStream, created at intake,
+        # closed by _finalize's typed end event. Closed streams stay
+        # until the consumer takes them (the bench reads/clears per
+        # rep) — the same accumulate-and-consume contract as
+        # `completions`.
+        self.streams: Dict[int, TokenStream] = {}
+        self._streaming = config.streaming
         self.brownout = False
         self._pending = 0
         self._retry_q: List[tuple] = []  # (ready_at, seq, rid) heap
@@ -356,7 +447,90 @@ class Router:
         tr = _Tracked(req=req, budget=budget)
         self.tracked[req.rid] = tr
         self._pending += 1
+        if self._streaming:
+            self.streams[req.rid] = TokenStream(req.rid, req.trace_id)
         return tr
+
+    def stream(self, rid: int) -> Optional["TokenStream"]:
+        """The consumer handle for one request's TokenStream (None when
+        streaming is off or the rid was never submitted)."""
+        return self.streams.get(rid)
+
+    # --------------------------------------------------------- streaming
+    def _stream_emit(self, st: TokenStream, kind: str, *, start: int = 0,
+                     tokens=(), status: Optional[str] = None,
+                     attrs: Optional[dict] = None) -> StreamEvent:
+        now = self.clock.now()
+        ev = StreamEvent(
+            kind=kind, seq=st.next_seq, trace_id=st.trace_id, t=now,
+            start=start, tokens=list(tokens), status=status, attrs=attrs,
+        )
+        st.events.append(ev)
+        if kind == "resumed":
+            if st._resumed_at is None:
+                st._resumed_at = now
+        elif st._resumed_at is not None:
+            # the resume gap closes at the next consumer-visible edge
+            # (first post-splice tokens, or the end if none ever came) —
+            # the stall the flight record books as resume_gap_s
+            st.resume_gap_s += now - st._resumed_at
+            st._resumed_at = None
+        if kind == "end":
+            st.closed = True
+            st.status = status
+        emit = getattr(self.telemetry, "emit", None)
+        if emit is not None:
+            # one JSONL line per stream event: the offline exactly-once
+            # audit trail (tools/check_stream.py) — contiguous seq per
+            # trace_id, one terminal, original trace_id across failover
+            emit("chunk", trace_id=st.trace_id, rid=st.rid, seq=ev.seq,
+                 event=kind, start=ev.start, n=len(ev.tokens),
+                 status=status)
+        return ev
+
+    def _stream_tokens(self, st: TokenStream, gstart: int,
+                       toks: List[int]) -> None:
+        """Feed replica chunk tokens at absolute offset `gstart` through
+        the dedup cursor: only tokens past `delivered` reach the
+        consumer, re-decoded salvage is suppressed, and a forward skip
+        (structurally impossible — chunks and the salvage point share a
+        frame) is counted as a gap rather than hidden."""
+        if st.closed or not toks:
+            return
+        end = gstart + len(toks)
+        if end <= st.delivered:
+            st.suppressed += len(toks)
+            return
+        if gstart > st.delivered:
+            st.gaps += gstart - st.delivered
+            start = gstart
+        else:
+            st.suppressed += st.delivered - gstart
+            start = st.delivered
+        self._stream_emit(st, "tokens", start=start,
+                          tokens=toks[start - gstart:])
+        st.delivered = end
+
+    def _ingest_chunks(self, h) -> None:
+        """Drain one handle's TokenChunks into the streams. Runs even
+        with streaming off (the handle's pending buffer must not grow
+        unbounded); chunk-level `final` markers are scheduler-attempt
+        scoped and deliberately ignored here — the ROUTER owns the
+        terminal event (_finalize), because a sub-attempt's "error"
+        final is a retry, not an ending, from the consumer's seat."""
+        poll = getattr(h, "poll_chunks", None)
+        if poll is None:
+            return
+        chunks = poll()
+        if not self._streaming:
+            return
+        for ch in chunks:
+            st = self.streams.get(ch.rid)
+            if st is None or st.closed:
+                continue
+            tr = self.tracked.get(ch.rid)
+            base = tr.dispatch_base if tr is not None else 0
+            self._stream_tokens(st, base + ch.start, list(ch.tokens))
 
     # ---------------------------------------------------------- dispatch
     def _alive(self) -> List[ReplicaHandle]:
@@ -375,45 +549,58 @@ class Router:
         if not cands:
             return False
         # HEALTHY before DEGRADED, then least-loaded, then stable id
-        h = min(cands, key=lambda h: (
+        cands.sort(key=lambda h: (
             h.health.state is HealthState.DEGRADED, h.load, h.id,
         ))
         req = tr.req
-        if tr.prefix:
-            if not h.fits_prompt(len(req.prompt) + len(tr.prefix)):
-                # prompt+prefix outgrew every prefill bucket (a long
-                # generation migrated late): drop the salvage and
-                # regenerate from the original prompt — it fit once, it
-                # fits again, and a deterministic decode reproduces the
-                # same tokens (the per-request PRNG chain restarts from
-                # the request seed). Recompute beats a lost request.
-                tr.prefix = []
-                remaining = tr.budget
-        sub = Request(
-            rid=req.rid,
-            # failover/retry resume: the tokens already produced ARE the
-            # continuation — re-admitting prompt+prefix as a fresh
-            # prefill reproduces the remaining tokens exactly under
-            # greedy decoding
-            prompt=list(req.prompt) + list(tr.prefix),
-            max_new_tokens=remaining,
-            deadline=req.deadline,
-            seed=req.seed,
-            arrival=req.arrival,
-            priority=req.priority,
-            # the ORIGINAL trace_id: the survivor's spans join the
-            # migrated request's timeline (tests/test_trace.py)
-            trace_id=req.trace_id,
-        )
-        rec = self.tracer
-        if rec is not None and rec.enabled:
-            rec.instant(
-                "dispatch", trace_id=req.trace_id, pid=ROUTER_PID,
-                replica=h.id, attempt=tr.retries + tr.failovers,
-                salvaged=len(tr.prefix),
+        for h in cands:
+            if tr.prefix:
+                if not h.fits_prompt(len(req.prompt) + len(tr.prefix)):
+                    # prompt+prefix outgrew every prefill bucket (a long
+                    # generation migrated late): drop the salvage and
+                    # regenerate from the original prompt — it fit once,
+                    # it fits again, and a deterministic decode
+                    # reproduces the same tokens (the per-request PRNG
+                    # chain restarts from the request seed). Recompute
+                    # beats a lost request.
+                    tr.prefix = []
+                    remaining = tr.budget
+            # the splice point for this attempt's chunks: attempt-local
+            # chunk offsets + this base = absolute position in the
+            # client's output (the stream dedup key)
+            tr.dispatch_base = len(tr.prefix)
+            sub = Request(
+                rid=req.rid,
+                # failover/retry resume: the tokens already produced ARE
+                # the continuation — re-admitting prompt+prefix as a
+                # fresh prefill reproduces the remaining tokens exactly
+                # under greedy decoding
+                prompt=list(req.prompt) + list(tr.prefix),
+                max_new_tokens=remaining,
+                deadline=req.deadline,
+                seed=req.seed,
+                arrival=req.arrival,
+                priority=req.priority,
+                # the ORIGINAL trace_id: the survivor's spans join the
+                # migrated request's timeline (tests/test_trace.py)
+                trace_id=req.trace_id,
             )
-        h.submit(sub)
-        return True
+            h.submit(sub)
+            if getattr(h, "last_submit_refused", False):
+                # a DRAINING worker refused at the door — typed and
+                # certain, not a fault: try the next candidate instead
+                # of writing the replica off (it is finishing in-flight
+                # streams and will exit on its own)
+                continue
+            rec = self.tracer
+            if rec is not None and rec.enabled:
+                rec.instant(
+                    "dispatch", trace_id=req.trace_id, pid=ROUTER_PID,
+                    replica=h.id, attempt=tr.retries + tr.failovers,
+                    salvaged=len(tr.prefix),
+                )
+            return True
+        return False
 
     def _requeue(self, tr: _Tracked, delay_s: float) -> None:
         now = self.clock.now()
@@ -444,6 +631,9 @@ class Router:
             except ReplicaCrashed:
                 self._kill(h)
         for h in self.handles:
+            # chunks BEFORE completions: the dedup cursor must be
+            # current when the terminal flush measures what is left
+            self._ingest_chunks(h)
             self._consume(h)
         self._drain_retries()
         if self.slo is not None:
@@ -480,6 +670,11 @@ class Router:
         rec = self.tracer
         if rec is not None and rec.enabled:
             rec.instant("replica_dead", pid=ROUTER_PID, replica=h.id)
+        # flush chunks the dead replica already published: they rode
+        # the same frames as the salvage point below, so after this the
+        # delivery cursor and the resume cursor agree — the survivor's
+        # re-decode dedups exactly, no duplicate and no gap
+        self._ingest_chunks(h)
         for req, tokens, ftt, phases in h.evacuate():
             tr = self.tracked.get(req.rid)
             if tr is None or tr.done:
@@ -496,6 +691,14 @@ class Router:
                 tr.first_token_time = ftt
             tr.failovers += 1
             self.metrics.failovers.inc()
+            st = self.streams.get(req.rid)
+            if st is not None and not st.closed:
+                # the consumer sees a marker at the splice, never a
+                # duplicate and never a hole — the exactly-once edge
+                self._stream_emit(st, "resumed", attrs={
+                    "reason": "failover", "from_replica": h.id,
+                    "salvaged": len(tokens),
+                })
             if rec is not None and rec.enabled:
                 rec.instant("failover", trace_id=req.trace_id,
                             pid=ROUTER_PID, from_replica=h.id,
@@ -540,6 +743,15 @@ class Router:
                     continue
                 tr.retries += 1
                 self.metrics.retries.inc()
+                st = self.streams.get(c.rid)
+                if st is not None and not st.closed:
+                    # an error retry is a resume point too: tokens
+                    # already streamed stay delivered, the re-decode on
+                    # the next replica dedups against them
+                    self._stream_emit(st, "resumed", attrs={
+                        "reason": "retry", "replica": h.id,
+                        "salvaged": len(tr.prefix),
+                    })
                 cfg = self.config
                 delay = backoff_delay(
                     tr.retries - 1, base_s=cfg.retry_base_s,
@@ -655,6 +867,20 @@ class Router:
             ),
             "retries": tr.retries, "failovers": tr.failovers,
         }
+        st = self.streams.get(req.rid)
+        if st is not None and not st.closed:
+            # flush the authoritative tail (tokens the completion holds
+            # that never rode a chunk — at most the last burst), then
+            # the typed end. A shed mid-stream lands HERE with status
+            # "shed": the stream terminates with a reason, not silence.
+            if len(tokens) > st.delivered:
+                self._stream_emit(st, "tokens", start=st.delivered,
+                                  tokens=tokens[st.delivered:])
+                st.delivered = len(tokens)
+            self._stream_emit(st, "end", status=status)
+            # attribute the failover stall: time between resume markers
+            # and their next delivered edge, measured at the consumer
+            flight["resume_gap_s"] = st.resume_gap_s
         c = Completion(
             rid=req.rid, tokens=tokens, status=status,
             arrival=req.arrival, finish=now, ttft=ttft, tpot=tpot,

@@ -216,6 +216,7 @@ class FrameStream:
         self._sock = sock
         self._sock.setblocking(False)
         self._buf = bytearray()
+        self._closed = False
 
     def fileno(self) -> int:
         """The underlying fd — a select()-driven caller sleeps on this
@@ -224,7 +225,7 @@ class FrameStream:
         return self._sock.fileno()
 
     def drain(self) -> list:
-        while True:
+        while not self._closed:
             try:
                 chunk = self._sock.recv(65536)
             except (BlockingIOError, InterruptedError):
@@ -232,7 +233,14 @@ class FrameStream:
             except OSError as e:
                 raise RpcError(f"stream recv failed: {e}") from e
             if not chunk:
-                raise RpcError("stream peer closed")
+                # peer closed (e.g. the worker died) — but the kernel
+                # buffer may still hold frames pushed BEFORE the death:
+                # parse and return them first, raise on the NEXT drain.
+                # A SIGKILLed worker's final pub frame carries the
+                # freshest salvage point + chunk slice; discarding it
+                # here would widen every failover's resume gap.
+                self._closed = True
+                break
             self._buf.extend(chunk)
         frames = []
         while len(self._buf) >= _LEN.size:
@@ -248,6 +256,8 @@ class FrameStream:
             except ValueError as e:
                 raise RpcError(f"bad stream frame: {e}") from e
             del self._buf[:_LEN.size + n]
+        if self._closed and not frames:
+            raise RpcError("stream peer closed")
         return frames
 
     def close(self) -> None:
@@ -297,9 +307,13 @@ class RpcServer:
     owns it for life, so pushes cannot interleave with replies.
 
     Pushed frames are kind-tagged dicts; the worker currently emits
-    ``pub`` (completions watermark + inflight salvage + stats), ``hb``
-    (idle heartbeat), and ``trace`` (batched span records for the
-    fleet TraceCollector, seq-numbered with a cumulative drop count).
+    ``pub`` (completions watermark + per-burst TokenChunk slice with
+    its own ``chunks_watermark`` + inflight salvage + stats — chunks
+    ride IN the pub frame, not a separate kind, so a dropped frame
+    loses the chunk slice and the salvage point together and the
+    client's resume cursor can never outrun delivery), ``hb`` (idle
+    heartbeat), and ``trace`` (batched span records for the fleet
+    TraceCollector, seq-numbered with a cumulative drop count).
     The transport is deliberately agnostic: new kinds ride for free,
     and unknown kinds are skipped by consumers.
     """

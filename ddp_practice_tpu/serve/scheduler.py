@@ -118,6 +118,45 @@ class Request:
 
 
 @dataclasses.dataclass
+class TokenChunk:
+    """One decode burst's tokens for one request — the streaming unit.
+
+    Chunks are the scheduler's append-only side channel next to
+    `completions`: consumers read them through a watermark (the same
+    consume-once contract), the worker ships them inside its `pub`
+    push frames (atomically with the inflight salvage point, so a
+    dropped frame loses both together and the router's resume cursor
+    can never run ahead of the chunks it suppresses against), and the
+    router splices them into per-request TokenStreams.
+
+    `seq` is contiguous per rid WITHIN this scheduler (attempt-local
+    ordering, transport dedup); `start` is the rid-global offset of
+    `tokens[0]` counting any in-scheduler preemption prefix — the
+    router adds its dispatch base on top, so a chunk's tokens have an
+    absolute position in the client's output and re-decoded salvage
+    after failover dedups by offset, not by guesswork. Exactly one
+    chunk per completion carries `final=True` + the terminal status —
+    the stream's end marker."""
+
+    rid: int
+    trace_id: Optional[str]
+    seq: int
+    start: int
+    tokens: List[int]
+    t: float
+    final: bool = False
+    status: Optional[str] = None
+
+    def to_dict(self) -> dict:
+        return {
+            "rid": self.rid, "trace_id": self.trace_id,
+            "seq": self.seq, "start": self.start,
+            "tokens": list(self.tokens), "t": self.t,
+            "final": self.final, "status": self.status,
+        }
+
+
+@dataclasses.dataclass
 class Completion:
     rid: int
     tokens: List[int]
@@ -173,6 +212,11 @@ class _Running:
     # admission order — the block-aware preemption victim key (youngest
     # admitted evicts first, vLLM-style LIFO)
     seq: int = 0
+    # streaming state: rid-global offset where THIS attempt's tokens
+    # start (= the in-scheduler preemption prefix length at admit), and
+    # how many of st.tokens have already left as TokenChunks
+    chunk_base: int = 0
+    emitted: int = 0
 
 
 class Scheduler:
@@ -180,7 +224,8 @@ class Scheduler:
 
     def __init__(self, engine: SlotEngine, *, clock=None, max_queue: int = 64,
                  metrics=None, fault_hook=None, tracer=None,
-                 replica: int = 0, telemetry=None) -> None:
+                 replica: int = 0, telemetry=None,
+                 stream: bool = True) -> None:
         self.engine = engine
         self.clock = clock or MonotonicClock()
         self.max_queue = max_queue
@@ -201,6 +246,14 @@ class Scheduler:
         self.queue: Deque[Request] = deque()
         self.running: Dict[int, _Running] = {}  # slot -> state
         self.completions: List[Completion] = []
+        # streaming side channel: one TokenChunk per request per decode
+        # burst plus one final chunk per completion, append-only and
+        # watermark-consumed exactly like `completions`. `stream=False`
+        # is the end-of-request-delivery baseline (the overhead bench's
+        # control arm) — no chunks are ever built.
+        self.stream = stream
+        self.chunks: List[TokenChunk] = []
+        self._chunk_seq: Dict[int, int] = {}  # rid -> next chunk seq
         self._admit_counter = 0
         # preempted-request resume state (PagedEngine block-aware
         # preemption): rid -> {"orig": the ORIGINAL request, "prefix":
@@ -234,9 +287,42 @@ class Scheduler:
         return True
 
     # ------------------------------------------------------------ internals
+    def _emit_chunk(self, rid: int, trace_id: Optional[str], start: int,
+                    tokens: List[int], *, final: bool = False,
+                    status: Optional[str] = None) -> None:
+        """Append one TokenChunk (no-op with streaming off). `start` is
+        the rid-GLOBAL token offset. The final chunk retires the rid's
+        seq counter, so `_chunk_seq` stays O(in-flight)."""
+        if not self.stream:
+            return
+        seq = self._chunk_seq.get(rid, 0)
+        self._chunk_seq[rid] = seq + 1
+        self.chunks.append(TokenChunk(
+            rid=rid, trace_id=trace_id, seq=seq, start=start,
+            tokens=list(tokens), t=self.clock.now(), final=final,
+            status=status,
+        ))
+        if final:
+            self._chunk_seq.pop(rid, None)
+        emit = getattr(self.telemetry, "emit", None)
+        if emit is not None:
+            # single-replica serving (a TelemetryExporter attached
+            # directly): per-chunk JSONL so tools/check_stream.py can
+            # audit delivery offline. Behind a router, the router's
+            # consumer-side stream events are the audited lines; worker
+            # FlightStats has no emit and skips this branch.
+            emit("chunk", trace_id=trace_id, rid=rid, seq=seq,
+                 start=start, n=len(tokens), final=final, status=status,
+                 # which decode dispatch produced these tokens — the
+                 # flight-accounting hook that tells a stalled engine
+                 # (burst stands still) from a starved request (bursts
+                 # advance without it) inside a resume gap
+                 burst=getattr(self.engine, "burst_seq", None))
+
     def _finish(self, req: Request, tokens: List[int], status: str,
                 first_token_time: Optional[float] = None,
-                admitted: Optional[tuple] = None) -> Completion:
+                admitted: Optional[tuple] = None,
+                chunked: Optional[int] = None) -> Completion:
         now = self.clock.now()
         prior = self._resume.pop(req.rid, None)
         if prior is not None:
@@ -246,6 +332,17 @@ class Scheduler:
             tokens = prior["prefix"] + tokens
             if prior["ftt"] is not None:
                 first_token_time = prior["ftt"]
+        if chunked is None:
+            # not finishing from a running slot: everything this rid
+            # ever streamed is its preemption prefix (queued shed /
+            # timeout / stale continuation) or nothing (fresh request)
+            chunked = len(prior["prefix"]) if prior is not None else 0
+        # the terminal marker: whatever tokens have not streamed yet
+        # ride out with it, so chunk delivery is complete exactly when
+        # the completion exists (one final chunk per completion, even
+        # for sheds/rejects — a typed end, never silence)
+        self._emit_chunk(req.rid, req.trace_id, chunked,
+                         tokens[chunked:], final=True, status=status)
         ttft = tpot = None
         if first_token_time is not None:
             ttft = first_token_time - req.arrival
@@ -502,9 +599,13 @@ class Scheduler:
                                 trace_id=req.trace_id, pid=self.replica,
                                 attrs={"slot": slot})
             self._admit_counter += 1
+            prior = self._resume.get(req.rid)
             self.running[slot] = _Running(
                 req=req, slot=slot, admit_t0=t_admit0, admit_t1=t_admit1,
                 seq=self._admit_counter,
+                # a preempted continuation's chunks continue the rid's
+                # global token offsets after the already-streamed prefix
+                chunk_base=len(prior["prefix"]) if prior else 0,
             )
 
     # ------------------------------------------------------------ the tick
@@ -540,6 +641,7 @@ class Scheduler:
                             st.req, st.tokens, "error",
                             st.first_token_time,
                             admitted=(st.admit_t0, st.admit_t1),
+                            chunked=st.chunk_base + st.emitted,
                         )
                         continue
                     tok = int(row[slot])
@@ -564,9 +666,23 @@ class Scheduler:
                             st.req, st.tokens, done_status,
                             st.first_token_time,
                             admitted=(st.admit_t0, st.admit_t1),
+                            chunked=st.chunk_base + st.emitted,
                         )
                 if not self.running:
                     break  # the rest of the burst is free-slot padding
+            if self.stream:
+                # one TokenChunk per still-running request per burst:
+                # the tokens this tick produced, stamped with their
+                # rid-global offsets. Finished requests already left
+                # through their final chunk in _finish.
+                for st in self.running.values():
+                    if len(st.tokens) > st.emitted:
+                        self._emit_chunk(
+                            st.req.rid, st.req.trace_id,
+                            st.chunk_base + st.emitted,
+                            st.tokens[st.emitted:],
+                        )
+                        st.emitted = len(st.tokens)
         if self.metrics:
             self.metrics.on_tick(self)
         return self.completions[before:]
@@ -638,10 +754,13 @@ class Scheduler:
         the replica comes back."""
         out = self.inflight_snapshot()
         # every live rid is in queue/running, so their _resume entries
-        # (already folded into the snapshot) go with them
+        # (already folded into the snapshot) go with them — and their
+        # chunk seq counters: evacuated attempts never reach a final
+        # chunk, and the router re-dispatches under a fresh attempt
         self._resume.clear()
         self.running.clear()
         self.queue.clear()
+        self._chunk_seq.clear()
         return out
 
     @property

@@ -373,11 +373,15 @@ class AlertSinks:
     watchdog's evaluate() drives it, so retries ride the serve tick and
     nothing here owns a thread). Delivery failures back off
     exponentially and, after `max_failures` CONSECUTIVE failures, trip
-    the sink's breaker for good: its pending alerts drop, the
-    ``alert_sink_dead`` gauge flips, and the process stops burning
-    timeouts on a pager that is gone. `deliver` is injectable so the
-    state machine is host-pure testable (the real one shells out /
-    POSTs / appends).
+    the sink's breaker: bulk pending drops (counted), the
+    ``alert_sink_dead`` gauge flips, and the serve loop stops paying
+    the sink's timeout per edge. Dead is HALF-OPEN, not forever: the
+    sink keeps exactly ONE queued edge (always the newest — send() to
+    a dead sink replaces it) and re-probes with it every
+    `probe_cooldown_s` — a pager that was rebooted, rotated, or had
+    its disk freed rejoins on its own instead of staying dead until a
+    process restart. `deliver` is injectable so the state machine is
+    host-pure testable (the real one shells out / POSTs / appends).
     """
 
     PENDING_CAP = 64
@@ -385,6 +389,7 @@ class AlertSinks:
     def __init__(self, specs, *, clock=None, registry=None,
                  max_failures: int = 5, base_s: float = 0.5,
                  max_s: float = 30.0, seed: int = 0,
+                 probe_cooldown_s: float = 30.0,
                  deliver=None) -> None:
         self._now = _resolve_clock(clock)
         self.registry = registry
@@ -392,6 +397,7 @@ class AlertSinks:
         self.base_s = base_s
         self.max_s = max_s
         self.seed = seed
+        self.probe_cooldown_s = probe_cooldown_s
         self._deliver_fn = deliver
         self.sinks: List[dict] = []
         for spec in specs:
@@ -423,6 +429,14 @@ class AlertSinks:
         now = self._now()
         for s in self.sinks:
             if s["dead"]:
+                # a dead sink holds exactly ONE edge for its next
+                # half-open probe — the newest (a probe that succeeds
+                # should deliver the current state of the world, not a
+                # stale alarm); the displaced edge drops, counted
+                if s["pending"]:
+                    s["dropped"] += len(s["pending"])
+                    s["pending"].clear()
+                s["pending"].append(dict(event))
                 continue
             if len(s["pending"]) == s["pending"].maxlen:
                 s["dropped"] += 1  # oldest falls off the bounded deque
@@ -436,7 +450,29 @@ class AlertSinks:
         now = self._now() if now is None else now
         delivered = 0
         for s in self.sinks:
-            if s["dead"] or not s["pending"] or now < s["next_at"]:
+            if not s["pending"] or now < s["next_at"]:
+                continue
+            if s["dead"]:
+                # half-open probe: ONE attempt with the kept edge.
+                # Success closes the breaker (failures reset, gauge
+                # clears — the sink is a normal live sink again);
+                # failure re-arms the fixed cool-down, never the
+                # exponential schedule (a 30 s heartbeat against a
+                # maybe-back pager, not a retry storm).
+                if self._try_deliver(s["spec"], s["pending"][0]):
+                    s["pending"].popleft()
+                    s["dead"] = False
+                    s["failures"] = 0
+                    s["delivered"] += 1
+                    delivered += 1
+                    m = self._metric("delivered", s)
+                    if m is not None:
+                        m.inc()
+                    g = self._metric("dead", s)
+                    if g is not None:
+                        g.set(0)
+                else:
+                    s["next_at"] = now + self.probe_cooldown_s
                 continue
             while s["pending"]:
                 ev = s["pending"][0]
@@ -454,12 +490,16 @@ class AlertSinks:
                 if m is not None:
                     m.inc()
                 if s["failures"] >= self.max_failures:
-                    # the dead-sink breaker: no half-open probes — an
-                    # operator replaces a dead pager, the serve loop
-                    # must not keep paying its timeout forever
+                    # the dead-sink breaker: bulk pending drops so the
+                    # serve loop stops paying this sink's timeout per
+                    # edge — but ONE edge (the newest) stays queued for
+                    # the half-open probe after `probe_cooldown_s`
                     s["dead"] = True
-                    s["dropped"] += len(s["pending"])
+                    keep = s["pending"][-1]
+                    s["dropped"] += len(s["pending"]) - 1
                     s["pending"].clear()
+                    s["pending"].append(keep)
+                    s["next_at"] = now + self.probe_cooldown_s
                     g = self._metric("dead", s)
                     if g is not None:
                         g.set(1)

@@ -65,6 +65,7 @@ from ddp_practice_tpu.serve.scheduler import (
     Completion,
     MonotonicClock,
     Request,
+    TokenChunk,
 )
 from ddp_practice_tpu.serve.worker import READY_PREFIX, WorkerSpec
 from ddp_practice_tpu.utils.backoff import backoff_delay
@@ -246,6 +247,11 @@ class SupervisorConfig:
     # goes FAILED for good (operator territory — a crash loop must not
     # burn the machine forever). Counts spawn FAILURES too.
     restart_budget: int = 5
+    # rolling window for the budget: None = lifetime count (FAILED is
+    # permanent until revive(slot)); a float makes the budget count
+    # only restarts within the last window — a slot that exhausted its
+    # budget during a transient storm rejoins once the storm ages out
+    restart_window_s: Optional[float] = None
     # how long a spawn may take to reach READY (jax import + compile)
     ready_timeout_s: float = 300.0
     rpc_timeout_s: float = 5.0
@@ -286,6 +292,10 @@ class Supervisor:
         self.workers: List[Optional[object]] = [None] * n
         self.states: List[str] = [STOPPED] * n
         self.restarts: List[int] = [0] * n    # lifetime restarts/slot
+        # budget accounting, separate from the lifetime telemetry
+        # counter above: revive() zeroes THESE, never the telemetry
+        self._budget_used: List[int] = [0] * n
+        self._restart_times: List[List[float]] = [[] for _ in range(n)]
         self._next_at: List[float] = [0.0] * n
         self._spawn_threads: List[Optional[threading.Thread]] = [None] * n
         self._spawn_results: List[Optional[tuple]] = [None] * n
@@ -350,14 +360,48 @@ class Supervisor:
                     self._begin_spawn(slot, now)
                 elif st == SPAWNING:
                     self._collect_spawn(slot, now)
+                elif st == FAILED \
+                        and self.config.restart_window_s is not None \
+                        and self._budget_spent(slot, now) \
+                        < self.config.restart_budget:
+                    # the crash storm aged out of the rolling window:
+                    # the breaker half-closes and the slot rejoins
+                    self._next_at[slot] = now
+                    self.states[slot] = BACKOFF
+
+    def _budget_spent(self, slot: int, now: float) -> int:
+        """Restarts counting against the budget: the lifetime count by
+        default, only those inside the rolling window when one is
+        configured (pruning as a side effect — old entries never count
+        again)."""
+        w = self.config.restart_window_s
+        if w is None:
+            return self._budget_used[slot]
+        times = self._restart_times[slot]
+        times[:] = [t for t in times if now - t < w]
+        return len(times)
+
+    def revive(self, slot: int) -> None:
+        """Operator escape hatch: put a FAILED slot back in play NOW,
+        with a fresh budget (a revive that instantly re-tripped would
+        be no escape at all). Lifetime restart telemetry is preserved."""
+        if self.states[slot] != FAILED:
+            return
+        with self._lock:
+            self._budget_used[slot] = 0
+            self._restart_times[slot] = []
+            self._next_at[slot] = self.clock.now()
+            self.states[slot] = BACKOFF
 
     def _on_death(self, slot: int, now: float) -> None:
         w = self.workers[slot]
         if w is not None:
             w.reap()
         self.workers[slot] = None
-        if self.restarts[slot] >= self.config.restart_budget:
-            # the restart-budget circuit breaker: slot is done
+        if self._budget_spent(slot, now) >= self.config.restart_budget:
+            # the restart-budget circuit breaker: slot is done (for
+            # good without a window — see revive(); until the storm
+            # ages out with one — see poll())
             self.states[slot] = FAILED
             return
         c = self.config
@@ -367,6 +411,8 @@ class Supervisor:
             jitter=c.restart_jitter, seed=c.seed + slot,
         )
         self.restarts[slot] += 1
+        self._budget_used[slot] += 1
+        self._restart_times[slot].append(now)
         self._next_at[slot] = now + delay
         self.states[slot] = BACKOFF
 
@@ -499,8 +545,16 @@ class RemoteReplicaHandle:
         self.stream_poll_interval_s = 0.25
         self.consumed = 0               # watermark into the CURRENT
         #                                 process's completions list
+        self.chunks_consumed = 0        # same contract, TokenChunk list
         self.outstanding: Dict[int, dict] = {}
         self._pending: List[Completion] = []
+        self._pending_chunks: List[TokenChunk] = []
+        # set when the worker refused a submit as DRAINING (typed, not
+        # a fault): the router retries its next candidate instead of
+        # writing the replica off; has_queue_space goes False until the
+        # stats say otherwise (or the drained process exits)
+        self.last_submit_refused = False
+        self._remote_draining = False
         # rids shed via shed_queued(): their worker-side sub-completions
         # are already finalized by the router from the op's reply, so
         # when they replay through the push stream / poll they must be
@@ -544,6 +598,7 @@ class RemoteReplicaHandle:
     def submit(self, req: Request) -> None:
         if req.trace_id is None:
             req.trace_id = f"r{req.rid}"
+        self.last_submit_refused = False
         # track BEFORE the wire: if the call fails mid-flight the
         # request is outstanding either way, and evacuate() re-admits
         # it on a survivor (the worker-side dedup absorbs the case
@@ -562,18 +617,30 @@ class RemoteReplicaHandle:
             self._broken = True
             return
         if not r.get("accepted", False):
-            # refused at the door (a draining worker): the request must
-            # not strand in `outstanding` with no completion ever coming
+            if r.get("draining"):
+                # graceful-drain refusal (SIGTERM path): the worker is
+                # finishing its in-flight streams and will exit — not a
+                # fault. Untrack (no completion will ever come from
+                # here) and tell the router to try its next candidate.
+                self.outstanding.pop(req.rid, None)
+                self.last_submit_refused = True
+                self._remote_draining = True
+                return
+            # refused at the door otherwise: the request must not
+            # strand in `outstanding` with no completion ever coming
             # — treat like a replica failure, so the next step() raises
             # and the evacuation re-dispatches it on a survivor
             self._broken = True
 
     def _apply_snapshot(self, *, version, from_wm, completions, upto,
-                        inflight, stats) -> None:
+                        inflight, stats, chunks=(), chunks_from=None,
+                        chunks_upto=None) -> None:
         """Fold one published worker snapshot (push frame or poll
         reply) into client state. `from_wm` is where the payload's
         completion slice starts — anything below our own watermark is a
-        replay (stream/poll overlap) and is skipped, never re-pended."""
+        replay (stream/poll overlap) and is skipped, never re-pended.
+        The TokenChunk slice rides the same replay-skip contract on its
+        own watermark (defaults keep pre-streaming fakes working)."""
         self._pub_version = version
         if upto > self.consumed:
             start = max(0, self.consumed - from_wm)
@@ -583,6 +650,21 @@ class RemoteReplicaHandle:
                     continue  # already finalized from the shed reply
                 self._pending.append(self._to_completion(d))
             self.consumed = upto
+        if chunks_from is None:
+            chunks_from = self.chunks_consumed
+        if chunks_upto is None:
+            chunks_upto = chunks_from + len(chunks)
+        if chunks_upto > self.chunks_consumed:
+            start = max(0, self.chunks_consumed - chunks_from)
+            for d in chunks[start:]:
+                self._pending_chunks.append(TokenChunk(
+                    rid=d["rid"], trace_id=d.get("trace_id"),
+                    seq=d["seq"], start=d["start"],
+                    tokens=list(d["tokens"]), t=d.get("t", 0.0),
+                    final=d.get("final", False),
+                    status=d.get("status"),
+                ))
+            self.chunks_consumed = chunks_upto
         for item in inflight:
             st = self.outstanding.get(item["rid"])
             if st is not None:
@@ -593,6 +675,9 @@ class RemoteReplicaHandle:
                 }
         if stats is not None:
             self._stats = stats
+            # drain state rides the stats: a draining worker stops
+            # being a dispatch candidate even before its first refusal
+            self._remote_draining = bool(stats.get("draining", False))
 
     def _ensure_stream(self) -> None:
         if self._stream is not None:
@@ -604,6 +689,7 @@ class RemoteReplicaHandle:
         try:
             self._stream = open_stream(
                 "127.0.0.1", port, watermark=self.consumed,
+                chunks_watermark=self.chunks_consumed,
                 timeout_s=self.poll_timeout_s,
             )
         except (RpcError, RpcRemoteError):
@@ -613,6 +699,37 @@ class RemoteReplicaHandle:
         if self._stream is not None:
             self._stream.close()
             self._stream = None
+
+    def _apply_pub_frame(self, f: dict) -> None:
+        self._apply_snapshot(
+            version=f.get("version"), from_wm=f["from"],
+            completions=f["completions"],
+            upto=f["watermark"], inflight=f["inflight"],
+            stats=f["stats"],
+            chunks=f.get("chunks", ()),
+            chunks_from=f.get("chunks_from"),
+            chunks_upto=f.get("chunks_watermark"),
+        )
+
+    def _final_drain(self) -> None:
+        """Best-effort drain of a DEAD process's push stream (TCP
+        buffers outlive the process): apply any pub frames that made it
+        out before the kill, then drop the stream. Completions that
+        surface here finalize normally; their rids are excluded from
+        the evacuation salvage (see evacuate())."""
+        if self._stream is None:
+            return
+        try:
+            while True:
+                frames = self._stream.drain()
+                if not frames:
+                    break
+                for f in frames:
+                    if f.get("kind") == "pub":
+                        self._apply_pub_frame(f)
+        except RpcError:
+            pass
+        self._drop_stream()
 
     def step(self) -> None:
         """Heartbeat + completion intake + salvage refresh. Fast path:
@@ -626,6 +743,12 @@ class RemoteReplicaHandle:
         now = self.clock.now()
         self.supervisor.poll(now)
         if not self.supervisor.alive(self.id):
+            # one FINAL stream drain before the failover: frames the
+            # kernel buffered before the death survive the process, and
+            # the salvage point + chunk slice they carry are fresher
+            # than our last applied snapshot — minutes of resume gap
+            # become the one burst the frame missed
+            self._final_drain()
             raise ReplicaCrashed(f"worker {self.id}: process down")
         if self._broken:
             self._broken = False
@@ -640,12 +763,7 @@ class RemoteReplicaHandle:
             for f in frames:
                 self._last_heartbeat = now
                 if f.get("kind") == "pub":
-                    self._apply_snapshot(
-                        version=f.get("version"), from_wm=f["from"],
-                        completions=f["completions"],
-                        upto=f["watermark"], inflight=f["inflight"],
-                        stats=f["stats"],
-                    )
+                    self._apply_pub_frame(f)
                 elif f.get("kind") == "trace" \
                         and self.trace_collector is not None:
                     # worker spans -> the fleet timeline (the collector
@@ -659,9 +777,11 @@ class RemoteReplicaHandle:
         self._last_poll = now
         c = self._client()
         sent_wm = self.consumed
+        sent_cwm = self.chunks_consumed
         t0 = self.clock.now()
         try:
             r = c.call("poll", watermark=sent_wm,
+                       chunks_watermark=sent_cwm,
                        version=self._pub_version,
                        timeout_s=self.poll_timeout_s, retries=0)
         except (RpcError, RpcRemoteError):
@@ -686,6 +806,9 @@ class RemoteReplicaHandle:
             version=r.get("version"), from_wm=sent_wm,
             completions=r["completions"], upto=r["watermark"],
             inflight=r["inflight"], stats=r["stats"],
+            chunks=r.get("chunks", ()),
+            chunks_from=r.get("chunks_from", sent_cwm),
+            chunks_upto=r.get("chunks_watermark"),
         )
 
     def _clock_sample(self, reply: dict, t0: float, t3: float) -> None:
@@ -740,10 +863,21 @@ class RemoteReplicaHandle:
             self.outstanding.pop(comp.rid, None)
         return out
 
+    def poll_chunks(self) -> List[TokenChunk]:
+        """TokenChunks folded from worker frames since the last call
+        (consume-once) — the streaming twin of poll(), same shape as
+        the in-process ReplicaHandle's."""
+        out, self._pending_chunks = self._pending_chunks, []
+        return out
+
     def evacuate(self) -> List[tuple]:
+        # a rid whose COMPLETION already surfaced (the final stream
+        # drain beat the failover) finalizes through poll() — salvaging
+        # it TOO would deliver prefix + full tokens, a double-count
+        done = {c.rid for c in self._pending}
         out = [
             (st["req"], list(st["tokens"]), st["ftt"], st["phases"])
-            for st in self.outstanding.values()
+            for rid, st in self.outstanding.items() if rid not in done
         ]
         self.outstanding.clear()
         return out
@@ -775,6 +909,8 @@ class RemoteReplicaHandle:
 
     @property
     def has_queue_space(self) -> bool:
+        if self._remote_draining:
+            return False   # drain refusals are certain — stop offering
         return len(self.outstanding) < self._max_queue + self._max_slots
 
     @property
@@ -838,17 +974,22 @@ class RemoteReplicaHandle:
         whole history against possibly-reused rids. Heartbeat clock
         restarts; outstanding was already evacuated at death."""
         self.consumed = 0
+        self.chunks_consumed = 0
+        self._pending_chunks.clear()   # old incarnation's, if any
         c = self._client()
         if c is not None:
             try:
                 r = c.call("reset", timeout_s=self.poll_timeout_s,
                            retries=0)
                 self.consumed = int(r.get("completions", 0))
+                self.chunks_consumed = int(r.get("chunks", 0))
             except (RpcError, RpcRemoteError):
                 pass  # probe_ok just passed; a blip here resolves via
                 #       the normal poll path (worst case: a fresh
                 #       process replays nothing anyway)
         self._stats = {}
+        self._remote_draining = False
+        self.last_submit_refused = False
         self._pub_version = None   # a fresh process numbers its own
         #                            snapshots — never alias the old one's
         self._drop_stream()        # re-subscribes to the NEW process

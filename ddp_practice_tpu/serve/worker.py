@@ -72,6 +72,12 @@ class WorkerSpec:
     # disabled-tracer contract).
     trace: bool = False
     trace_buffer: int = 4096    # pending-events bound (drops counted)
+    # token streaming: the scheduler emits per-burst TokenChunks and
+    # the worker ships them inside its `pub` push frames (atomically
+    # with the inflight salvage point — a dropped frame loses both
+    # together, so the router's resume cursor never outruns delivery).
+    # False = end-of-request delivery (the overhead bench's control).
+    stream: bool = True
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self))
@@ -172,6 +178,7 @@ class WorkerServer:
             self.engine, max_queue=spec.max_queue,
             metrics=ServeMetrics(self.registry),
             telemetry=self.flight, replica=spec.replica,
+            stream=spec.stream,
         )
         # two-lock discipline so the RPC plane NEVER waits out a decode
         # burst: `_lock` (the big one) serializes scheduler/engine
@@ -185,7 +192,8 @@ class WorkerServer:
         self._io_lock = threading.Lock()
         self._intake: list = []
         self._published: dict = {
-            "completions_len": 0, "inflight": [], "stats": None,
+            "completions_len": 0, "chunks_len": 0,
+            "inflight": [], "stats": None,
         }
         self._pub_version = 0
         # push subscribers: [{"q": Queue, "watermark": int}] — _publish
@@ -195,9 +203,11 @@ class WorkerServer:
         self._subscribers: list = []
         self._last_push = 0.0
         self._last_pushed_upto = 0
+        self._last_pushed_cupto = 0
         self._stop = threading.Event()
         self._wake = threading.Event()   # submit -> serve loop, no spin
         self._draining = False
+        self._drain_exit = False         # SIGTERM: exit once drained
         self._seen_rids: dict = {}   # rid -> accepted (submit dedup)
         self._t0 = time.monotonic()
         # fleet tracing (spec.trace): this replica's own span recorder,
@@ -343,37 +353,53 @@ class WorkerServer:
         stats = self._stats()
         comps = self.scheduler.completions
         upto = len(comps)
+        chunks = self.scheduler.chunks   # append-only, like completions
+        cupto = len(chunks)
         with self._io_lock:
             self._pub_version += 1
             version = self._pub_version
             self._published = {
                 "completions_len": upto,
+                "chunks_len": cupto,
                 "inflight": inflight,
                 "stats": stats,
             }
             subs = list(self._subscribers)
-        # push to subscribers only when a COMPLETION moved (the
-        # latency-critical event) or the 50 ms freshness beat is due:
-        # pushing every decode step taxed the same single core the
-        # decode runs on, for frames that carried nothing new
+        # push to subscribers only when a COMPLETION or a token chunk
+        # moved (the latency-critical events — streaming TTFT/ITL are
+        # measured off these frames) or the 50 ms freshness beat is
+        # due: pushing every decode step taxed the same single core the
+        # decode runs on, for frames that carried nothing new. With
+        # streaming on, a burst that decoded tokens always moved cupto,
+        # so the chunk plane rides per-burst frames; the overhead bench
+        # bills exactly this extra push traffic against the ≤1.05x bar.
         if subs and upto == self._last_pushed_upto \
+                and cupto == self._last_pushed_cupto \
                 and time.monotonic() - self._last_push < 0.05:
             return
         # (outside the io lock — the queues are thread-safe; completion
         # dicts are built per subscriber from its own watermark)
         for sub in subs:
             wm = sub["watermark"]
+            cwm = sub["cwm"]
+            # chunks ride IN the pub frame (not a separate frame kind):
+            # a dropped frame loses the chunk slice and the inflight
+            # salvage point TOGETHER, so the router's resume cursor can
+            # never run ahead of the chunks it suppresses against
             frame = {
                 "kind": "pub", "version": version,
                 "from": wm, "watermark": upto,
                 "completions": [
                     self._completion_dict(c) for c in comps[wm:upto]
                 ],
+                "chunks": [c.to_dict() for c in chunks[cwm:cupto]],
+                "chunks_from": cwm, "chunks_watermark": cupto,
                 "inflight": inflight, "stats": stats,
             }
             try:
                 sub["q"].put_nowait(frame)
                 sub["watermark"] = upto
+                sub["cwm"] = cupto
             except Exception:
                 pass  # full queue: this frame drops, poll reconciles
         # trace events drain ONLY toward live subscribers: with none,
@@ -392,6 +418,7 @@ class WorkerServer:
                     self._trace_buf.note_drops(len(tf["events"]))
         self._last_push = time.monotonic()
         self._last_pushed_upto = upto
+        self._last_pushed_cupto = cupto
 
     def _trace_frame(self) -> Optional[dict]:
         """Drain pending trace events into one batched push frame
@@ -436,14 +463,17 @@ class WorkerServer:
         and greedy re-decode reproduces even those. Served from the
         post-step published snapshot: a poll never waits out a burst."""
         watermark = int(req.get("watermark", 0))
+        cwm = int(req.get("chunks_watermark", 0))
         seen_version = req.get("version")
         with self._io_lock:
             version = self._pub_version
             pub = self._published
             upto = pub["completions_len"]
+            cupto = pub["chunks_len"]
             inflight = pub["inflight"]
             stats = pub["stats"]
-        if seen_version == version and watermark >= upto:
+        if seen_version == version and watermark >= upto \
+                and cwm >= cupto:
             # nothing moved since the client's last poll: answer with a
             # frame small enough that a high-rate heartbeat costs the
             # decode loop (same single core!) close to nothing. "t" =
@@ -452,12 +482,17 @@ class WorkerServer:
                     "t": time.monotonic()}
         comps = self.scheduler.completions  # append-only list
         new = [self._completion_dict(c) for c in comps[watermark:upto]]
+        chunks = self.scheduler.chunks      # append-only too
+        new_chunks = [c.to_dict() for c in chunks[cwm:cupto]]
         if stats is None:
             with self._lock:
                 stats = self._stats()
         return {"version": version,
                 "completions": new,
                 "watermark": upto,
+                "chunks": new_chunks,
+                "chunks_from": cwm,
+                "chunks_watermark": cupto,
                 "inflight": inflight,
                 "stats": stats,
                 "t": time.monotonic()}
@@ -481,7 +516,8 @@ class WorkerServer:
         import queue
 
         q: "queue.Queue" = queue.Queue(maxsize=256)
-        sub = {"q": q, "watermark": int(req.get("watermark", 0))}
+        sub = {"q": q, "watermark": int(req.get("watermark", 0)),
+               "cwm": int(req.get("chunks_watermark", 0))}
         with self._io_lock:
             self._subscribers.append(sub)
 
@@ -513,7 +549,12 @@ class WorkerServer:
             with self._io_lock:
                 self._seen_rids.clear()
             self._publish()
-            return {"completions": len(self.scheduler.completions)}
+            # both watermarks so the rejoining client resyncs its chunk
+            # cursor too — the evacuated attempts' chunks stay in the
+            # list (append-only) but none of them will ever see a final
+            # marker; skipping ahead avoids replaying them
+            return {"completions": len(self.scheduler.completions),
+                    "chunks": len(self.scheduler.chunks)}
 
     def _op_shed(self, req: dict) -> dict:
         min_priority = int(req["min_priority"])
@@ -537,6 +578,18 @@ class WorkerServer:
         self._stop.set()
         return {"bye": True}
 
+    def begin_drain(self) -> None:
+        """The SIGTERM path: refuse new submits (typed ``draining``
+        refusal — the router re-dispatches those on survivors), finish
+        every in-flight request to its natural end (consumers observe
+        an uninterrupted stream, NO resume marker — the graceful column
+        of the failure matrix), publish the final frames, exit 0.
+        Signal-handler safe: only sets flags."""
+        with self._io_lock:
+            self._draining = True
+        self._drain_exit = True
+        self._wake.set()
+
     # ------------------------------------------------------- the loop
     def serve_forever(self) -> None:
         """Self-driven serve loop: tick whenever work exists; otherwise
@@ -551,6 +604,18 @@ class WorkerServer:
                 if moved or not idle:
                     self._publish()
             if idle and not moved:
+                if self._drain_exit:
+                    with self._io_lock:
+                        pending = bool(self._intake)
+                    if not pending:
+                        # drained: in-flight streams ran to their
+                        # natural end and were published. Give the push
+                        # loop a beat to flush the final frames, then
+                        # exit 0 — the supervisor reaps a clean drain,
+                        # not a crash.
+                        time.sleep(0.25)
+                        self._stop.set()
+                        break
                 # a truly idle replica SLEEPS (an 0.5 ms spin here
                 # measurably taxed every OTHER process on a small box);
                 # a submit sets the event, so admission latency stays
@@ -601,6 +666,11 @@ def main(argv=None) -> int:
         # imports all hide inside WorkerServer)
         os.environ.setdefault("JAX_PLATFORMS", spec.platform)
     server = WorkerServer(spec)
+    # graceful SIGTERM: finish in-flight work, refuse new submits, exit
+    # 0 once idle (handler only sets flags — never runs mid-burst)
+    import signal
+
+    signal.signal(signal.SIGTERM, lambda *_: server.begin_drain())
     print(server.ready_line(), flush=True)
     try:
         server.serve_forever()

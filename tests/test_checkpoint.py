@@ -231,6 +231,7 @@ def test_trainer_periodic_step_checkpoints(tmp_path):
     assert int(t2.state.step) == total
 
 
+@pytest.mark.slow  # >10s on the tier-1 box (pytest.ini: excluded from the gate)
 def test_trainer_resume(tmp_path):
     """Train 1 epoch, checkpoint, resume: step counter continues — the
     resume path the reference never built."""

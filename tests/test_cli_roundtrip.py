@@ -28,6 +28,7 @@ def _train(tmp_path, capsys, *extra):
     return json.loads(out[-1])
 
 
+@pytest.mark.slow  # >10s on the tier-1 box (pytest.ini: excluded from the gate)
 def test_train_then_generate_roundtrip(tmp_path, capsys, devices):
     summary = _train(
         tmp_path, capsys,
@@ -51,6 +52,7 @@ def test_train_then_generate_roundtrip(tmp_path, capsys, devices):
     assert first == second
 
 
+@pytest.mark.slow  # >10s on the tier-1 box (pytest.ini: excluded from the gate)
 def test_metrics_file_records_curves(tmp_path, capsys, devices):
     """--metrics_file: JSONL with per-step train records (monotone steps),
     an eval-derived record stream, and a final summary matching stdout."""

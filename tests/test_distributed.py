@@ -139,6 +139,7 @@ def test_eval_exact_under_padding():
     assert 0.0 <= float(correct) <= 20.0
 
 
+@pytest.mark.slow  # >10s on the tier-1 box (pytest.ini: excluded from the gate)
 def test_fit_on_8_device_mesh():
     """End-to-end DP fit on the full mesh — the ddp_main.py-equivalent run."""
     cfg = TrainConfig(

@@ -77,6 +77,7 @@ def test_cached_decode_matches_full_forward(devices, lm):
         )
 
 
+@pytest.mark.slow  # >10s on the tier-1 box (pytest.ini: excluded from the gate)
 def test_greedy_generate_matches_naive_rollout(devices, lm):
     """The scan-over-cache generate == re-running the full model each step."""
     model, params = lm

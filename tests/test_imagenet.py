@@ -134,6 +134,7 @@ def test_uint8_path_matches_fp32_path():
     )
 
 
+@pytest.mark.slow  # >10s on the tier-1 box (pytest.ini: excluded from the gate)
 def test_resnet50_trains_on_imagenet_shaped_corpus(tmp_path):
     """The BASELINE config-5 rung: ResNet-50 takes real ImageNet-shaped
     uint8 batches from a memmapped corpus — no fp32 dataset in RAM."""
@@ -164,6 +165,7 @@ def test_resnet50_trains_on_imagenet_shaped_corpus(tmp_path):
     assert np.isfinite(float(metrics["loss"]))
 
 
+@pytest.mark.slow  # >10s on the tier-1 box (pytest.ini: excluded from the gate)
 def test_trainer_end_to_end_on_imagenet_corpus(tmp_path):
     """Trainer smoke over dataset='imagenet' (synthetic fallback): uint8
     memmap corpus through sharded loaders, train + exact eval."""

@@ -103,6 +103,7 @@ def test_loader_too_small_corpus_raises():
         LMDataLoader(corpus, seq_len=63, global_batch_size=32)
 
 
+@pytest.mark.slow  # >10s on the tier-1 box (pytest.ini: excluded from the gate)
 def test_lm_fit_end_to_end_reaches_entropy_floor(devices):
     """One epoch of lm_tiny on the Markov corpus: held-out perplexity must
     land far below uniform (vocab 64) — the chain's conditional entropy is
@@ -165,6 +166,7 @@ def test_lm_label_smoothing_threads_through(devices):
     assert loss_with(0.0) != loss_with(0.5)
 
 
+@pytest.mark.slow  # >10s on the tier-1 box (pytest.ini: excluded from the gate)
 def test_lm_resident_matches_host_path(devices):
     """The HBM-resident LM driver (token stream + on-device window gather,
     LMDataLoader.epoch_plan) is an optimization, not a math change: same
@@ -195,6 +197,7 @@ def test_lm_resident_matches_host_path(devices):
     )
 
 
+@pytest.mark.slow  # >10s on the tier-1 box (pytest.ini: excluded from the gate)
 def test_lm_trainer_text_dataset(devices, tmp_path):
     """dataset='text': the Trainer trains a byte-level LM on real files."""
     from ddp_practice_tpu.train.loop import Trainer

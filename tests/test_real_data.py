@@ -59,6 +59,7 @@ def test_mnist_idx_trains_end_to_end():
     assert summary["accuracy"] > 0.5, summary
 
 
+@pytest.mark.slow  # >10s on the tier-1 box (pytest.ini: excluded from the gate)
 def test_cifar_batches_train_end_to_end():
     from ddp_practice_tpu.config import TrainConfig
     from ddp_practice_tpu.train.loop import fit

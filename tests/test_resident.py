@@ -74,6 +74,7 @@ def _assert_params_close(a_state, b_state, atol):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=atol, rtol=0)
 
 
+@pytest.mark.slow  # >10s on the tier-1 box (pytest.ini: excluded from the gate)
 def test_resident_epoch_matches_host(devices):
     """One full epoch, resident vs host streaming: same batches (proven
     exactly by test_epoch_plan_matches_iteration), same step count, params
@@ -102,6 +103,7 @@ def test_resident_epoch_matches_host(devices):
     _assert_params_close(host2.state, res2.state, atol=1e-4)
 
 
+@pytest.mark.slow  # >10s on the tier-1 box (pytest.ini: excluded from the gate)
 def test_resident_whole_epoch_one_dispatch(devices):
     """steps_per_call=-1: the entire epoch is one scan call; step count and
     params still match the per-step host path (compounded float noise over
@@ -130,6 +132,7 @@ def test_resident_respects_max_steps_cap(devices):
     assert int(tr.state.step) == 5
 
 
+@pytest.mark.slow  # >10s on the tier-1 box (pytest.ini: excluded from the gate)
 def test_resident_fit_end_to_end(devices):
     """fit() through the resident path reaches the same accuracy contract
     and reports the same step count as the host path."""

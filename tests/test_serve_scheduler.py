@@ -375,3 +375,52 @@ def test_continuation_victims_requeue_by_arrival_not_seq(devices):
     sched._admit()
     assert eng.preempts == [5, 7]          # evicted in seq order (LIFO)
     assert [r.rid for r in sched.queue] == [0, 1, 2]   # ARRIVAL order
+
+
+# ------------------------------------------------------ token streaming
+def test_stream_chunks_match_completions(devices, lm):
+    """TokenChunk emission (the streaming side channel): per rid the
+    chunks' concatenated tokens ARE the completion's tokens, offsets
+    and seq are contiguous, and exactly one final chunk carries the
+    terminal status — chunk delivery is complete exactly when the
+    completion exists. stream=False (the control arm) builds none."""
+    model, params = lm
+    engine = SlotEngine(model, params, EngineConfig(
+        max_slots=2, max_len=96, prompt_buckets=(8,),
+    ))
+    sched = Scheduler(engine, clock=FakeClock(step_s=0.01), max_queue=8)
+    reqs = [Request(rid=i, prompt=[1 + i, 2, 3], max_new_tokens=4 + i)
+            for i in range(4)]
+    for r in reqs:
+        sched.submit(r)
+    sched.run_until_idle()
+    by_rid = {c.rid: c for c in sched.completions}
+    assert len(by_rid) == 4
+
+    per_rid = {}
+    for ch in sched.chunks:
+        per_rid.setdefault(ch.rid, []).append(ch)
+    assert set(per_rid) == set(by_rid)
+    for rid, chunks in per_rid.items():
+        c = by_rid[rid]
+        assert [ch.seq for ch in chunks] == list(range(len(chunks)))
+        toks, offset = [], 0
+        for ch in chunks:
+            assert ch.start == offset        # offset-contiguous
+            toks.extend(ch.tokens)
+            offset += len(ch.tokens)
+            assert ch.trace_id == c.trace_id
+        assert toks == c.tokens
+        finals = [ch for ch in chunks if ch.final]
+        assert len(finals) == 1 and finals[0] is chunks[-1]
+        assert finals[0].status == c.status
+    # seq counters retire with their rid: live state stays O(in-flight)
+    assert sched._chunk_seq == {}
+
+    # control arm: stream=False emits nothing (end-of-request delivery)
+    sched2 = Scheduler(engine, clock=FakeClock(step_s=0.01),
+                       max_queue=8, stream=False)
+    sched2.submit(Request(rid=0, prompt=[1, 2, 3], max_new_tokens=4))
+    sched2.run_until_idle()
+    assert sched2.chunks == []
+    assert sched2.completions[0].tokens == by_rid[0].tokens

@@ -29,6 +29,14 @@ TELEMETRY = os.path.join(ROOT, "tests", "data", "bench_telemetry.jsonl")
 # heartbeat-stale worker — the two verdicts check_fleet exists to catch
 FLEET_OK = os.path.join(ROOT, "tests", "data", "fleet_healthz_ok.json")
 FLEET_BAD = os.path.join(ROOT, "tests", "data", "fleet_healthz_bad.json")
+# streaming exactly-once audit artifacts: a deterministic FakeClock
+# 2-replica run with a scripted mid-stream crash (so the PASSING
+# artifact contains resumed markers — failover is part of the
+# contract, not a violation); _bad is the same run with one chunk line
+# replayed (duplicate seq + token overlap) and one stream's terminal
+# dropped (ended in silence)
+STREAM_OK = os.path.join(ROOT, "tests", "data", "stream_chunks_ok.jsonl")
+STREAM_BAD = os.path.join(ROOT, "tests", "data", "stream_chunks_bad.jsonl")
 
 # the SLO the artifact run was recorded against (it violates this one)
 TIGHT_SLO = json.dumps({
@@ -287,3 +295,53 @@ def test_check_bench_as_library():
                    {"direction": "lower", "tol": 0.1}})
     assert not ok and rows[0]["status"] == "missing"
     assert dig({"a": {"b": 3}}, "a.b") == 3
+
+
+def test_check_stream_exit_codes_both_ways(tmp_path):
+    """The exactly-once audit over its checked-in artifact pair: the
+    real chaos run (resume markers included) passes, the corrupted
+    copy fails on BOTH planted violations, garbage is UNREADABLE (2) —
+    a broken audit input must never read as a broken stream."""
+    r = _run("tools/check_stream.py", STREAM_OK)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "STREAMS OK" in r.stdout
+    assert "VIOLATION" not in r.stdout
+
+    r = _run("tools/check_stream.py", STREAM_BAD)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "STREAM CONTRACT BROKEN" in r.stdout
+    assert "duplicate seq" in r.stdout          # the replayed line
+    assert "no terminal marker" in r.stdout     # the silenced ending
+
+    garbage = tmp_path / "garbage.jsonl"
+    garbage.write_text("{not json\n")
+    assert _run("tools/check_stream.py", str(garbage)).returncode == 2
+    assert _run("tools/check_stream.py",
+                str(tmp_path / "missing.jsonl")).returncode == 2
+    # a telemetry file with no chunk lines at all is a VIOLATION, not a
+    # silent pass (wrong file / streaming was off)
+    empty = tmp_path / "nochunks.jsonl"
+    empty.write_text('{"kind": "flight", "rid": 0}\n')
+    r = _run("tools/check_stream.py", str(empty))
+    assert r.returncode == 1 and "no chunk lines" in r.stdout
+
+    # --json emits the machine-readable verdict
+    r = _run("tools/check_stream.py", "--json", STREAM_OK)
+    v = json.loads(r.stdout)
+    assert v["ok"] is True and v["streams"] > 0
+
+
+def test_check_stream_as_library():
+    """stream_verdict() is the pure seam the bench's chaos rep calls
+    in-process — pinned on the same artifacts the CLI sees."""
+    sys.path.insert(0, ROOT)
+    try:
+        from tools.check_stream import load_jsonl, stream_verdict
+    finally:
+        sys.path.pop(0)
+    ok, report = stream_verdict(load_jsonl(STREAM_OK))
+    assert ok and not report["violations"]
+    assert report["streams"] == 5 and report["tokens"] == 40
+    ok, report = stream_verdict(load_jsonl(STREAM_BAD))
+    assert not ok
+    assert set(report["violations"]) == {"r0", "r3"}

@@ -67,6 +67,7 @@ def test_train_step_decreases_loss():
     assert int(state.step) == 20
 
 
+@pytest.mark.slow  # >10s on the tier-1 box (pytest.ini: excluded from the gate)
 def test_fit_reaches_reference_accuracy_contract():
     """The 91%-in-3-epochs contract (README.md:199) on the synthetic MNIST
     stand-in. Uses the parity budget: 3 epochs, batch 32."""

@@ -146,6 +146,72 @@ def test_restart_budget_circuit_breaker_goes_failed():
     assert sup.worker(0) is None
 
 
+def test_rolling_window_budget_half_closes_after_storm_ages_out():
+    """With restart_window_s configured, FAILED is a cool-down, not a
+    grave: only restarts inside the rolling window count against the
+    budget, so once the crash storm ages out the breaker half-closes
+    and the slot respawns on its own — no operator in the loop."""
+    cfg = SupervisorConfig(restart_base_s=0.2, restart_factor=2.0,
+                           restart_max_s=10.0, restart_jitter=0.0,
+                           restart_budget=3, restart_window_s=300.0)
+    sup, clock, spawned = make_sup(cfg=cfg)
+    for _ in range(cfg.restart_budget):       # deaths at t=0, 60, 120
+        spawned[-1].die()
+        sup.poll()
+        clock.advance(60.0)
+        sup.poll()
+        assert sup.state(0) == RUNNING
+    spawned[-1].die()                         # 4th death inside window
+    sup.poll()
+    assert sup.state(0) == FAILED and sup.worker(0) is None
+    # still inside the window: the breaker stays open, nothing spawns
+    clock.advance(100.0)                      # t=280; oldest was t=0
+    sup.poll()
+    assert sup.state(0) == FAILED
+    assert len(spawned) == 1 + cfg.restart_budget
+    # the t=0 restart leaves the 300 s window: half-close and rejoin
+    clock.advance(30.0)                       # t=310
+    sup.poll()
+    assert sup.state(0) == BACKOFF
+    sup.poll()                                # due immediately
+    assert sup.state(0) == RUNNING
+    assert len(spawned) == 2 + cfg.restart_budget
+
+
+def test_revive_escape_hatch_resets_budget_and_respawns():
+    """revive(slot) is the operator's override for a lifetime-budget
+    FAILED slot: back in play NOW with a FRESH budget (a revive that
+    instantly re-tripped would be no escape), lifetime restart
+    telemetry preserved. A no-op on any non-FAILED slot."""
+    sup, clock, spawned = make_sup()
+    sup.revive(0)                             # no-op on a live slot
+    assert sup.state(0) == RUNNING
+    for _ in range(CFG.restart_budget + 1):
+        spawned[-1].die()
+        sup.poll()
+        clock.advance(60.0)
+        sup.poll()
+    assert sup.state(0) == FAILED
+    lifetime = sup.restarts[0]
+    assert lifetime == CFG.restart_budget
+    clock.advance(3600.0)                     # no window: FAILED stays
+    sup.poll()
+    assert sup.state(0) == FAILED
+    sup.revive(0)
+    assert sup.state(0) == BACKOFF
+    sup.poll()                                # due immediately
+    assert sup.state(0) == RUNNING
+    assert len(spawned) == 2 + CFG.restart_budget
+    assert sup.restarts[0] == lifetime        # telemetry preserved
+    # the budget really is fresh: the next death restarts, no re-trip
+    spawned[-1].die()
+    sup.poll()
+    assert sup.state(0) == BACKOFF
+    clock.advance(60.0)
+    sup.poll()
+    assert sup.state(0) == RUNNING
+
+
 def test_spawn_failure_consumes_budget_and_reschedules():
     """A spec that cannot boot must walk the same backoff->budget->
     FAILED path as a crash loop, not spin forever."""

@@ -4,7 +4,10 @@ real jsonl/command deliveries (no network).
 
 The backoff schedule is pinned against utils/backoff.py backoff_delay
 itself (the shared-schedule contract every retry loop in this repo
-holds), and the breaker is pinned to never call a dead sink again.
+holds). The breaker is HALF-OPEN: a tripped sink keeps exactly one
+queued edge (the newest) and re-probes it every probe_cooldown_s — the
+schedule, the single-edge queue, and the recovery path are all pinned
+here on an injected clock.
 """
 
 import json
@@ -69,7 +72,7 @@ def test_retry_backoff_matches_shared_schedule():
         assert len(attempts) == k + 2          # at the edge: retried
 
 
-def test_dead_sink_breaker_stops_calling_and_drops_pending():
+def test_dead_sink_breaker_goes_half_open_with_one_kept_edge():
     clock = _Clock()
     calls = []
     reg = MetricsRegistry()
@@ -82,15 +85,64 @@ def test_dead_sink_breaker_stops_calling_and_drops_pending():
     clock.t = 10.0
     sinks.flush()
     st = {s["sink"]: s for s in sinks.state()}
-    assert st["command:x"]["dead"] and st["command:x"]["pending"] == 0
+    # tripped — but half-open: exactly ONE edge stays queued for the
+    # probe (the breaker sheds the backlog, not the comeback path)
+    assert st["command:x"]["dead"] and st["command:x"]["pending"] == 1
     assert not st["jsonl:y"]["dead"] and st["jsonl:y"]["delivered"] == 1
     n = len(calls)
+    # a send while dead REPLACES the kept edge (newest wins, displaced
+    # edge counts as dropped) and does not wake the sink early
     sinks.send({"event": "trip", "objective": "b"})
-    clock.t = 100.0
+    assert calls[n:] == ["jsonl"]
+    st = {s["sink"]: s for s in sinks.state()}
+    assert st["command:x"]["pending"] == 1
+    assert st["command:x"]["dropped"] == 1
+    # no probe before the cool-down edge...
+    n = len(calls)
+    clock.t = 10.0 + sinks.probe_cooldown_s - 1e-6
     sinks.flush()
-    # the dead sink was never called again; the live one delivered
-    assert [c for c in calls[n:]] == ["jsonl"]
+    assert calls[n:] == []
+    # ...exactly one probe attempt AT it; failure stays dead and
+    # re-arms the FIXED cool-down (no exponential schedule for probes)
+    clock.t = 10.0 + sinks.probe_cooldown_s
+    sinks.flush()
+    assert calls[n:] == ["command"]
+    assert {s["sink"]: s for s in sinks.state()}["command:x"]["dead"]
+    n = len(calls)
+    clock.t += sinks.probe_cooldown_s - 1e-6
+    sinks.flush()
+    assert calls[n:] == []
     assert sinks.any_alive
+
+
+def test_dead_sink_recovers_via_half_open_probe():
+    clock = _Clock()
+    back = {"up": False}
+    calls = []
+    sinks = AlertSinks(["command:x"], clock=clock, max_failures=1,
+                       base_s=0.1, seed=0,
+                       deliver=lambda s, e: (calls.append(dict(e)),
+                                             back["up"])[1])
+    sinks.send({"event": "trip", "objective": "a"})
+    assert not sinks.any_alive            # one failure trips at cap 1
+    sinks.send({"event": "trip", "objective": "b"})
+    sinks.send({"event": "resolve", "objective": "b"})
+    s = sinks.state()[0]
+    assert s["pending"] == 1 and s["dropped"] == 2
+    back["up"] = True                     # the pager comes back
+    n = len(calls)
+    clock.t = sinks.probe_cooldown_s      # cool-down from the t=0 trip
+    sinks.flush()
+    # the probe delivered the NEWEST edge (current state of the world,
+    # not the stale alarm) and closed the breaker
+    assert [e["event"] for e in calls[n:]] == ["resolve"]
+    s = sinks.state()[0]
+    assert not s["dead"] and s["pending"] == 0 and s["failures"] == 0
+    assert sinks.any_alive
+    # alive again for subsequent sends — straight-through delivery
+    sinks.send({"event": "trip", "objective": "c"})
+    assert calls[-1]["objective"] == "c"
+    assert sinks.state()[0]["delivered"] == 2
 
 
 def test_pending_queue_is_bounded():

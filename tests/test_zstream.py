@@ -1,0 +1,405 @@
+"""Streaming exactly-once contract (serve/router.py TokenStream),
+host-pure.
+
+The router's streaming plane is policy over the ReplicaHandle seam —
+so it is pinned here against scripted fake replicas on a FakeClock,
+with no engine and no jit: chunk splicing, the dedup cursor across a
+mid-stream crash (resume marker, suppressed re-decode, zero consumer
+duplicates/gaps), the error-retry resume edge, the typed end a shed
+mid-stream must produce instead of silence, and the offline
+check_stream audit over a pumped TelemetryExporter file — both ways
+(the real run passes; a corrupted copy fails).
+
+The real-engine end of the same contract (scheduler chunk emission,
+worker pub frames, SIGKILL chaos) lives in tests/test_serve_scheduler
+.py::test_stream_chunks_match_completions and tests/test_worker_stream
+.py — this file is the fast tier-1 core.
+"""
+
+import json
+
+import pytest
+
+from ddp_practice_tpu.serve import (
+    FakeClock,
+    ReplicaCrashed,
+    Request,
+    Router,
+    RouterConfig,
+)
+from ddp_practice_tpu.serve.scheduler import Completion, TokenChunk
+
+VOCAB = 32
+
+
+def oracle(prompt, n):
+    """The fake fleet's greedy decode: a pure function of the prefix,
+    like real greedy decoding — so a failover's re-decode of
+    prompt+salvage reproduces the suffix exactly."""
+    out = []
+    cur = list(prompt)
+    for _ in range(n):
+        nxt = (sum(cur[-3:]) * 7 + len(cur)) % VOCAB
+        out.append(nxt)
+        cur.append(nxt)
+    return out
+
+
+class FakeReplica:
+    """Scripted in-process replica implementing the ReplicaHandle seam:
+    1 token per request per tick, one TokenChunk per tick (the burst),
+    deterministic oracle decode. `crash_at` raises ReplicaCrashed on
+    that step call; `salvage_lag` makes evacuate() return that many
+    fewer tokens than the chunks already published — the survivor then
+    RE-decodes tokens the consumer has seen, which the dedup cursor
+    must suppress."""
+
+    def __init__(self, rid, clock, *, slots=4, crash_at=None,
+                 salvage_lag=0, error_rids=(), restartable=True):
+        self.id = rid
+        self.clock = clock
+        self.slots = slots
+        self.crash_at = crash_at
+        self.salvage_lag = salvage_lag
+        self.error_rids = set(error_rids)
+        self.restartable = restartable
+        self.health = None          # armed by Router.__init__
+        self.running = {}           # rid -> {req, tokens, base}
+        self.queue = []
+        self.completions = []
+        self.chunks = []
+        self.consumed = 0
+        self.chunks_consumed = 0
+        self._chunk_seq = {}
+        self.steps = 0
+
+    # ---------------------------------------------------- the seam
+    def submit(self, req):
+        self.queue.append(req)
+
+    def step(self):
+        self.steps += 1
+        if self.crash_at is not None and self.steps >= self.crash_at:
+            self.crash_at = None
+            raise ReplicaCrashed(f"scripted crash on replica {self.id}")
+        while self.queue and len(self.running) < self.slots:
+            req = self.queue.pop(0)
+            self.running[req.rid] = {"req": req, "tokens": [],
+                                     "done": req.max_new_tokens}
+        self.clock.tick()
+        for rid in list(self.running):
+            st = self.running[rid]
+            req = st["req"]
+            prefix = list(req.prompt) + st["tokens"]
+            tok = oracle(prefix, 1)[0]
+            start = len(st["tokens"])
+            st["tokens"].append(tok)
+            if len(st["tokens"]) >= st["done"]:
+                status = ("error" if rid in self.error_rids
+                          else "length")
+                if rid in self.error_rids:
+                    self.error_rids.discard(rid)
+                self._emit(rid, req.trace_id, start, [tok],
+                           final=True, status=status)
+                self.completions.append(Completion(
+                    rid=rid, tokens=st["tokens"], status=status,
+                    arrival=req.arrival, finish=self.clock.now(),
+                    ttft=0.01, flight={"queue_s": 0.0, "prefill_s": 0.0,
+                                       "decode_s": 0.01},
+                    trace_id=req.trace_id,
+                ))
+                del self.running[rid]
+            else:
+                self._emit(rid, req.trace_id, start, [tok])
+
+    def _emit(self, rid, trace_id, start, tokens, final=False,
+              status=None):
+        seq = self._chunk_seq.get(rid, 0)
+        self._chunk_seq[rid] = seq + 1
+        self.chunks.append(TokenChunk(
+            rid=rid, trace_id=trace_id, seq=seq, start=start,
+            tokens=tokens, t=self.clock.now(), final=final,
+            status=status,
+        ))
+        if final:
+            self._chunk_seq.pop(rid, None)
+
+    def poll(self):
+        new = self.completions[self.consumed:]
+        self.consumed = len(self.completions)
+        return new
+
+    def poll_chunks(self):
+        new = self.chunks[self.chunks_consumed:]
+        self.chunks_consumed = len(self.chunks)
+        return new
+
+    def evacuate(self):
+        out = []
+        for rid, st in self.running.items():
+            toks = st["tokens"]
+            if self.salvage_lag:
+                toks = toks[:max(0, len(toks) - self.salvage_lag)]
+            out.append((st["req"], list(toks), None,
+                        {"queue_s": 0.0, "prefill_s": 0.0,
+                         "decode_s": 0.0}))
+        for req in self.queue:  # queued work is harvested too
+            out.append((req, [], None,
+                        {"queue_s": 0.0, "prefill_s": 0.0,
+                         "decode_s": 0.0}))
+        self.running.clear()
+        self.queue.clear()
+        self._chunk_seq.clear()
+        return out
+
+    def shed_queued(self, min_priority):
+        keep, shed = [], []
+        for r in self.queue:
+            (shed if r.priority >= min_priority else keep).append(r)
+        self.queue = keep
+        return [r.rid for r in shed]
+
+    # ------------------------------------------------- observables
+    @property
+    def load(self):
+        return len(self.queue) + len(self.running)
+
+    @property
+    def has_queue_space(self):
+        return len(self.queue) < 64
+
+    @property
+    def max_slots(self):
+        return self.slots
+
+    @property
+    def queue_len(self):
+        return len(self.queue)
+
+    @property
+    def active(self):
+        return len(self.running)
+
+    def fits_prompt(self, n_tokens):
+        return n_tokens <= 64
+
+    # --------------------------------------------------- lifecycle
+    def probe_ok(self, now):
+        return self.restartable
+
+    def restart(self):
+        self.running.clear()
+        self.queue.clear()
+        self.steps = 0
+
+    def warmup(self, widths=None):
+        pass
+
+    def compile_stats(self):
+        return {}
+
+
+def _mk_router(replica_factory, n=2, telemetry=None, **cfg_kw):
+    clock = FakeClock(step_s=0.01)
+    reps = [replica_factory(i, clock) for i in range(n)]
+    cfg = RouterConfig(retry_jitter=0.0, probe_base_s=0.05,
+                       retry_base_s=0.02, **cfg_kw)
+    return Router(reps, clock=clock, config=cfg,
+                  telemetry=telemetry), reps
+
+
+def _submit_all(router, reqs):
+    for r in reqs:
+        router.submit(r)
+
+
+def _reqs(n, max_new=6):
+    return [Request(rid=i, prompt=[3 + i, 1, 4], max_new_tokens=max_new,
+                    arrival=0.0) for i in range(n)]
+
+
+def test_stream_happy_path_incremental_and_typed_end():
+    """No faults: tokens arrive incrementally (more than one tokens
+    event), seq is contiguous, the end is typed, and the stream's
+    concatenation equals both the completion and the oracle."""
+    router, _ = _mk_router(lambda i, c: FakeReplica(i, c))
+    _submit_all(router, _reqs(3, max_new=6))
+    comps = {c.rid: c for c in router.run_until_idle()}
+    assert set(comps) == {0, 1, 2}
+    for rid, c in comps.items():
+        st = router.stream(rid)
+        assert st is not None and st.closed
+        assert st.status == c.status == "length"
+        assert st.tokens() == c.tokens == oracle([3 + rid, 1, 4], 6)
+        assert [ev.seq for ev in st.events] \
+            == list(range(len(st.events)))
+        kinds = [ev.kind for ev in st.events]
+        assert kinds.count("end") == 1 and kinds[-1] == "end"
+        # streaming means incremental: several tokens edges, not one
+        # end-of-request lump
+        assert kinds.count("tokens") >= 3
+        assert st.suppressed == 0 and st.gaps == 0
+
+
+def test_streaming_off_is_end_of_request_only():
+    """The control arm: streaming=False drains replica chunks (handle
+    state stays bounded) but exposes no streams."""
+    router, reps = _mk_router(lambda i, c: FakeReplica(i, c),
+                              streaming=False)
+    _submit_all(router, _reqs(2, max_new=4))
+    comps = router.run_until_idle()
+    assert len(comps) == 2
+    assert router.stream(0) is None and not router.streams
+    # chunks were consumed off the replicas even with no stream
+    for r in reps:
+        assert r.chunks_consumed == len(r.chunks) > 0
+
+
+def test_crash_mid_stream_resumes_exactly_once():
+    """Replica 0 dies mid-decode with its salvage point BEHIND what it
+    already streamed (salvage_lag=2): the survivor re-decodes tokens
+    the consumer has seen. The consumer must observe: one resumed
+    marker, the oracle's exact token sequence (no duplicate, no hole),
+    contiguous seq, suppressed > 0 (the re-decode was absorbed by the
+    cursor, not delivered)."""
+    def factory(i, clock):
+        return FakeReplica(i, clock,
+                           crash_at=4 if i == 0 else None,
+                           salvage_lag=2 if i == 0 else 0,
+                           restartable=False)
+
+    router, _ = _mk_router(factory)
+    reqs = _reqs(4, max_new=8)
+    _submit_all(router, reqs)
+    comps = {c.rid: c for c in router.run_until_idle()}
+    assert set(comps) == {0, 1, 2, 3}
+
+    resumed_streams = 0
+    suppressed_total = 0
+    for rid, c in comps.items():
+        st = router.stream(rid)
+        want = oracle([3 + rid, 1, 4], 8)
+        assert c.status == "length" and c.tokens == want
+        # the consumer's spliced view is EXACTLY the fault-free decode
+        assert st.tokens() == want
+        assert st.closed and st.status == "length"
+        assert [ev.seq for ev in st.events] \
+            == list(range(len(st.events)))
+        assert st.gaps == 0
+        kinds = [ev.kind for ev in st.events]
+        if "resumed" in kinds:
+            resumed_streams += 1
+            ev = st.events[kinds.index("resumed")]
+            assert ev.attrs["reason"] == "failover"
+            assert ev.attrs["from_replica"] == 0
+            # resume stall is measured at the consumer
+            assert st.resume_gap_s > 0.0
+        suppressed_total += st.suppressed
+    # the crash hit mid-decode with requests on replica 0
+    assert resumed_streams >= 1
+    # salvage_lag forced a re-decode of already-delivered tokens:
+    # the cursor absorbed them
+    assert suppressed_total > 0
+
+
+def test_error_retry_marks_resume_and_dedups():
+    """A replica 'error' completion (transient fault) retries on the
+    fleet: the stream carries a reason=retry resume marker and the
+    re-decode of the salvaged prefix never reaches the consumer."""
+    def factory(i, clock):
+        return FakeReplica(i, clock, error_rids={0} if i == 0 else ())
+
+    router, _ = _mk_router(factory, max_retries=2)
+    router.submit(Request(rid=0, prompt=[3, 1, 4], max_new_tokens=6,
+                          arrival=0.0))
+    comps = {c.rid: c for c in router.run_until_idle()}
+    c = comps[0]
+    st = router.stream(0)
+    want = oracle([3, 1, 4], 6)
+    assert c.status == "length" and c.tokens == want
+    assert st.tokens() == want
+    kinds = [ev.kind for ev in st.events]
+    assert "resumed" in kinds
+    ev = st.events[kinds.index("resumed")]
+    assert ev.attrs["reason"] == "retry"
+    assert st.gaps == 0
+
+
+def test_shed_mid_stream_ends_typed_not_silent():
+    """Every replica dies permanently mid-stream: the in-flight
+    streams must terminate with a typed end (status shed/timeout) —
+    a consumer waiting on the stream learns its fate, never hangs on
+    silence."""
+    def factory(i, clock):
+        return FakeReplica(i, clock, crash_at=3, restartable=False)
+
+    router, _ = _mk_router(factory)
+    _submit_all(router, _reqs(3, max_new=10))
+    comps = {c.rid: c for c in router.run_until_idle()}
+    assert set(comps) == {0, 1, 2}
+    for rid, c in comps.items():
+        st = router.stream(rid)
+        assert c.status == "shed"
+        assert st.closed and st.status == "shed"
+        assert st.events[-1].kind == "end"
+        assert st.events[-1].status == "shed"
+
+
+def test_rejected_at_door_still_ends_stream():
+    router, _ = _mk_router(lambda i, c: FakeReplica(i, c))
+    router.submit(Request(rid=9, prompt=[1], max_new_tokens=0,
+                          arrival=0.0))
+    st = router.stream(9)
+    assert st.closed and st.status == "rejected"
+    assert [ev.kind for ev in st.events] == ["end"]
+
+
+def test_telemetry_chunk_lines_pass_check_stream_both_ways(tmp_path):
+    """The JSONL the router writes under chaos IS the audit artifact:
+    tools/check_stream.py must pass it verbatim and fail a corrupted
+    copy (one duplicated delivery line)."""
+    from ddp_practice_tpu.utils.telemetry import TelemetryExporter
+    from tools.check_stream import (
+        OK, VIOLATION, load_jsonl, main, stream_verdict,
+    )
+
+    path = str(tmp_path / "run.jsonl")
+    exp = TelemetryExporter(path, clock=lambda: 0.0, start=False)
+
+    def factory(i, clock):
+        return FakeReplica(i, clock,
+                           crash_at=4 if i == 0 else None,
+                           salvage_lag=1 if i == 0 else 0,
+                           restartable=False)
+
+    clock = FakeClock(step_s=0.01)
+    reps = [factory(i, clock) for i in range(2)]
+    router = Router(reps, clock=clock,
+                    config=RouterConfig(retry_jitter=0.0),
+                    telemetry=exp)
+    _submit_all(router, _reqs(4, max_new=8))
+    router.run_until_idle()
+    exp.pump()
+    exp.close()
+
+    lines = load_jsonl(path)
+    ok, report = stream_verdict(lines)
+    assert ok, report
+    assert report["streams"] == 4
+    # resumed markers are part of the PASSING record, not a violation
+    assert any(ln.get("event") == "resumed" for ln in lines)
+    assert main([path]) == OK
+
+    # corrupt: replay one token-carrying chunk line (a duplicate
+    # delivery) — the audit must catch it
+    bad = tmp_path / "bad.jsonl"
+    out, dup = [], None
+    for ln in lines:
+        out.append(json.dumps(ln))
+        if (dup is None and ln.get("kind") == "chunk"
+                and ln.get("event") == "tokens" and ln.get("n")):
+            dup = json.dumps(ln)
+            out.append(dup)
+    assert dup is not None
+    bad.write_text("\n".join(out) + "\n")
+    assert main([str(bad)]) == VIOLATION

@@ -70,6 +70,24 @@ DEFAULT_GATES: Dict[str, dict] = {
     # the prefix cache's reason to exist
     "prefix_cache_100rps.prefix_vs_paged":
         {"direction": "higher", "tol": 0.20},
+    # streaming delivery (ISSUE 10): per-burst chunks must be ~free vs
+    # end-of-request delivery (acceptance gate: mean <= 1.05x), and the
+    # exactly-once contract under real SIGKILL is ABSOLUTE — the
+    # consumer-side recount of duplicated/missing tokens is gated at a
+    # baseline of 0 with tol 0 (one duplicate is a broken contract, not
+    # drift); resume-gap/inter-token p99s may drift, not creep
+    "streaming_overhead_8rps.latency_ratio_mean":
+        {"direction": "lower", "tol": 0.10},
+    "fleet_x2_stream_sigkill_100rps.chunk_dupes":
+        {"direction": "lower", "tol": 0.0},
+    "fleet_x2_stream_sigkill_100rps.chunk_gaps":
+        {"direction": "lower", "tol": 0.0},
+    "fleet_x2_stream_sigkill_100rps.lost":
+        {"direction": "lower", "tol": 0.0},
+    "fleet_x2_stream_sigkill_100rps.resume_gap_p99_s":
+        {"direction": "lower", "tol": 0.50},
+    "fleet_x2_stream_sigkill_100rps.inter_token_p99_s":
+        {"direction": "lower", "tol": 0.50},
 }
 
 
