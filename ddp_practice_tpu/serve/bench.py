@@ -610,6 +610,9 @@ def fleet_bench(
     fault_plan=None,
     metrics_port: Optional[int] = None,
     trace_out: Optional[str] = None,
+    trace_sample: float = 1.0,
+    trace_keep_slow_s: Optional[float] = None,
+    otlp_out: Optional[str] = None,
 ) -> dict:
     """One Poisson trace through `procs` worker OS PROCESSES behind the
     RPC seam (serve/worker.py + serve/supervisor.py) AND through
@@ -702,8 +705,12 @@ def fleet_bench(
             "eos_id": eos_id,
         },
         max_queue=max_queue,
-        trace=trace_out is not None,
+        trace=trace_out is not None or otlp_out is not None,
+        trace_sample=trace_sample,
+        trace_keep_slow_s=trace_keep_slow_s,
     )
+    if tracer is None and otlp_out:
+        tracer = _make_tracer()
     fleet_router, sup, handles = make_fleet_router(
         spec, procs, sup_config=SupervisorConfig(restart_base_s=0.25),
         tracer=tracer,
@@ -821,9 +828,13 @@ def fleet_bench(
         if fault_plan is not None:
             report["fault_plan"] = fault_plan.to_json()
         if tracer is not None:
-            tracer.save(trace_out)
             col = fleet_router.trace_collector
-            report["trace_out"] = trace_out
+            if trace_out:
+                tracer.save(trace_out)
+                report["trace_out"] = trace_out
+            if otlp_out:
+                tracer.save_otlp(otlp_out)
+                report["otlp_out"] = otlp_out
             report["trace_events"] = len(tracer)
             report["trace_plane"] = {
                 "worker_frames": col.frames if col else 0,
@@ -831,6 +842,9 @@ def fleet_bench(
                 "dropped": tracer.dropped,
                 "skew_bound_s": col.skew_bound() if col else None,
             }
+            meta = tracer.sampling_meta()
+            if meta is not None:
+                report["sampling"] = meta
         return report
     finally:
         if server is not None:
@@ -988,6 +1002,210 @@ def fleet_trace_overhead_bench(
         if trace_out:
             tracer.save(trace_out)
             report["trace_out"] = trace_out
+        return report
+    finally:
+        sup.stop()
+
+
+def fleet_trace_sampling_bench(
+    *,
+    n_requests: int = 200,
+    rate_hz: float = 100.0,
+    procs: int = 2,
+    max_slots: int = 8,
+    vocab: int = 64,
+    hidden: int = 128,
+    depth: int = 2,
+    heads: int = 4,
+    mlp: int = 256,
+    max_len: int = 128,
+    prompt_buckets=(8, 16),
+    prompt_len_range=(2, 16),
+    max_new_range=(2, 32),
+    decode_burst: int = 8,
+    eos_id: Optional[int] = 46,
+    seed: int = 0,
+    pairs: int = 6,
+    sample: float = 0.01,
+    keep_slow_s: Optional[float] = None,
+    trace_out: Optional[str] = None,
+    otlp_out: Optional[str] = None,
+) -> dict:
+    """Head-sampled trace plane at 100 rps: three arms against ONE warm
+    worker fleet — ``sampled`` (head rate `sample`, default 1%),
+    ``full`` (rate 1.0) and ``off`` (plane disabled), rotated in
+    order-balanced rounds (the PR-5/7 drift-cancelling methodology).
+
+    The two acceptance numbers:
+
+    - ``span_reduction``: 1 - sampled/full recorded-span count (median
+      over rounds; gate >= 0.95 at 1%) — upstream SUPPRESSION, counted
+      at the fleet recorder after worker streaming, so it proves the
+      workers never recorded/streamed the suppressed spans, not that a
+      collector filtered them;
+    - ``mean_ratio``: sampled-arm / off-arm mean latency (median over
+      rounds; gate <= 1.02x) — what the 1% plane costs against no
+      plane at all.
+
+    Both ends of the RPC seam hold a sampler over the SAME crc32 hash
+    (utils/trace.py head_keep) and the router's verdict additionally
+    rides each submit frame, so worker and router cannot disagree; the
+    per-arm rate flips live via the rpc ``trace`` op's ``sample``
+    field. The final sampled rep's merged timeline is saved to
+    `trace_out` (Chrome) and `otlp_out` (OTLP-JSON,
+    tools/check_otlp.py)."""
+    from ddp_practice_tpu.serve.supervisor import (
+        SupervisorConfig,
+        make_fleet_router,
+    )
+    from ddp_practice_tpu.serve.worker import WorkerSpec
+
+    model_kw = {
+        "vocab_size": vocab, "max_len": max_len, "hidden_dim": hidden,
+        "depth": depth, "num_heads": heads, "mlp_dim": mlp,
+        "pos_emb": "rope",
+    }
+    trace = build_trace(
+        n_requests=n_requests, rate_hz=rate_hz, vocab=vocab,
+        prompt_len_range=prompt_len_range, max_new_range=max_new_range,
+        seed=seed,
+    )
+    tracer = _make_tracer()
+    spec = WorkerSpec(
+        model=model_kw,
+        engine={
+            "max_slots": max_slots, "max_len": max_len,
+            "prompt_buckets": list(prompt_buckets),
+            "temperature": 0.0, "decode_burst": decode_burst,
+            "eos_id": eos_id,
+        },
+        max_queue=len(trace) * (3 * pairs + 2),
+        trace=True,
+        trace_sample=sample,
+        trace_keep_slow_s=keep_slow_s,
+    )
+    router, sup, handles = make_fleet_router(
+        spec, procs, sup_config=SupervisorConfig(restart_base_s=0.25),
+        tracer=tracer,
+    )
+    if tracer.sampler is None:  # --trace-sample 1.0: still need a knob
+        from ddp_practice_tpu.utils.trace import TraceSampler
+
+        tracer.set_sampler(TraceSampler(sample, keep_slow_s=keep_slow_s))
+    arms = ("sampled", "full", "off")
+    rates = {"sampled": sample, "full": 1.0}
+
+    def set_arm(arm: str) -> None:
+        if arm == "off":
+            for h in handles:
+                h.set_trace(False)
+            tracer.disable()
+            return
+        for h in handles:
+            h.set_trace(True, sample=rates[arm])
+        tracer.sampler.rate = rates[arm]
+        tracer.enable()
+
+    def drain_frames() -> None:
+        # trace frames ride the push stream behind the pub frames —
+        # give the last worker flush a moment to land before counting
+        deadline = time.monotonic() + 0.5
+        while time.monotonic() < deadline:
+            router.step()
+            _fleet_wait(router, 0.01)
+
+    rows = {a: [] for a in arms}
+    spans = {a: [] for a in arms}
+    try:
+        # untimed shakeout: streams connect, offsets sampled, compiles
+        # long since amortized by make_fleet_router's warm boot
+        set_arm("sampled")
+        _replay_through_router(router, trace, rid_offset=90_000_000,
+                               fleet=True)
+        drain_frames()
+        tracer.clear()
+        for i in range(pairs):
+            order = arms[i % 3:] + arms[:i % 3]
+            for arm in order:
+                set_arm(arm)
+                rows[arm].append(_replay_through_router(
+                    router, trace,
+                    rid_offset=(3 * i + order.index(arm)) * 1_000_000,
+                    fleet=True,
+                ))
+                if arm != "off":
+                    drain_frames()
+                spans[arm].append(len(tracer))
+                tracer.clear()
+        # one final SAMPLED rep, kept in the recorder: the exported
+        # artifacts show what a 1% operator actually ships
+        set_arm("sampled")
+        _replay_through_router(router, trace, rid_offset=91_000_000,
+                               fleet=True)
+        drain_frames()
+
+        def med(xs):
+            s = sorted(xs)
+            n = len(s)
+            return (s[n // 2] if n % 2
+                    else 0.5 * (s[n // 2 - 1] + s[n // 2]))
+
+        mean_ratios = [
+            s["latency_s"]["mean"] / o["latency_s"]["mean"]
+            for s, o in zip(rows["sampled"], rows["off"])
+        ]
+        # headline = ratio of per-arm MEDIAN means, not the median of
+        # per-round ratios: one scheduler hiccup in one round inflates
+        # a paired ratio permanently, while the pooled medians shrug
+        # off a spiked round on either side (the per-round ratios stay
+        # in the report to keep the spread visible)
+        pooled_mean_ratio = (
+            med([r["latency_s"]["mean"] for r in rows["sampled"]])
+            / med([r["latency_s"]["mean"] for r in rows["off"]])
+        )
+        reductions = [
+            1.0 - (s / f) if f else 0.0
+            for s, f in zip(spans["sampled"], spans["full"])
+        ]
+        col = router.trace_collector
+        report = {
+            "trace": {
+                "n_requests": n_requests, "rate_hz": rate_hz,
+                "seed": seed,
+                "prompt_len_range": list(prompt_len_range),
+                "max_new_range": list(max_new_range),
+            },
+            "procs": procs,
+            "pairs": pairs,
+            "head_rate": sample,
+            "keep_slow_s": keep_slow_s,
+            "gate": "mean <= 1.02x vs off; span reduction >= 0.95",
+            "mean_ratio": pooled_mean_ratio,
+            "mean_ratio_per_round": mean_ratios,
+            "span_reduction": med(reductions),
+            "span_reduction_per_round": reductions,
+            "spans_per_rep": {a: spans[a] for a in arms},
+            "sampled": {
+                "latency_s": rows["sampled"][-1]["latency_s"],
+                "lost": sum(r["lost"] for r in rows["sampled"]),
+            },
+            "off": {"latency_s": rows["off"][-1]["latency_s"],
+                    "lost": sum(r["lost"] for r in rows["off"])},
+            "full": {"lost": sum(r["lost"] for r in rows["full"])},
+            "sampling": tracer.sampling_meta(),
+            "trace_plane": {
+                "worker_frames": col.frames if col else 0,
+                "worker_events": col.events if col else 0,
+                "dropped": tracer.dropped,
+                "skew_bound_s": col.skew_bound() if col else None,
+            },
+        }
+        if trace_out:
+            tracer.save(trace_out)
+            report["trace_out"] = trace_out
+        if otlp_out:
+            tracer.save_otlp(otlp_out)
+            report["otlp_out"] = otlp_out
         return report
     finally:
         sup.stop()
@@ -1871,6 +2089,37 @@ def build_parser() -> argparse.ArgumentParser:
                         "<=2%% acceptance gate judges, saves the merged "
                         "ON-rep timeline to --trace-out, and checks "
                         "/metrics bucket exemplars resolve into it")
+    p.add_argument("--trace-sampling", dest="trace_sampling",
+                   action="store_true",
+                   help="with --procs: bench the HEAD-SAMPLED trace "
+                        "plane (utils/trace.py TraceSampler) at the "
+                        "--rate operating point — three arms (sampled/"
+                        "full/off) rotated against ONE warm fleet; "
+                        "reports span_reduction (gate >= 0.95 at 1%%) "
+                        "and mean latency vs off (gate <= 1.02x); "
+                        "saves the final sampled timeline to "
+                        "--trace-out / --otlp-out")
+    p.add_argument("--trace-sample", dest="trace_sample", type=float,
+                   default=None, metavar="RATE",
+                   help="head-sampling rate in [0,1]: one deterministic "
+                        "keep/stage decision per trace_id (crc32 hash — "
+                        "every process agrees), staged spans promoted "
+                        "by the tail keep-rules (errors, sheds, "
+                        "retries, failovers, resumes, preemptions, "
+                        "--trace-keep-slow-s). Default: no sampling "
+                        "(rate 1.0); the sampling bench defaults 0.01")
+    p.add_argument("--trace-keep-slow-s", dest="trace_keep_slow_s",
+                   type=float, default=None, metavar="S",
+                   help="tail keep-rule: a request slower than this "
+                        "end-to-end is kept regardless of the head "
+                        "decision (set from the SLO: ~2x the latency "
+                        "p99 target)")
+    p.add_argument("--otlp-out", "--otlp_out", dest="otlp_out",
+                   default=None, metavar="PATH",
+                   help="write the run's request spans as OTLP-JSON "
+                        "(ExportTraceServiceRequest shape — POST-able "
+                        "to any OTLP/HTTP collector's /v1/traces); "
+                        "validate with tools/check_otlp.py")
     p.add_argument("--max-len", dest="max_len", type=int, default=None,
                    help="bench: slot-pool span / paged pool sizing "
                         "(default 128); the slot engine's decode cost "
@@ -1976,6 +2225,44 @@ def main(argv=None) -> int:
                       f"{report['kv_bytes_per_token_f32']:.0f} "
                       f"({report['kv_bytes_ratio']:.2f}x)")
         return 0
+    if args.procs and args.trace_sampling:
+        report = fleet_trace_sampling_bench(
+            n_requests=args.requests, rate_hz=args.rate,
+            max_slots=args.max_slots, procs=args.procs,
+            seed=args.seed, trace_out=args.trace_out,
+            otlp_out=args.otlp_out,
+            keep_slow_s=args.trace_keep_slow_s,
+            **({"sample": args.trace_sample}
+               if args.trace_sample is not None else {}),
+            **({"decode_burst": args.decode_burst}
+               if args.decode_burst is not None else {}),
+        )
+        if args.json:
+            print(json.dumps(report))
+        else:
+            print(f"[fleet_trace_sampling] {args.requests} requests @ "
+                  f"{args.rate}/s, {args.procs} workers, head rate "
+                  f"{report['head_rate']}, {report['pairs']} "
+                  f"order-balanced rounds")
+            print(f"  span reduction vs full tracing: "
+                  f"{report['span_reduction']:.3f}  latency mean vs "
+                  f"off: {report['mean_ratio']:.3f}x  "
+                  f"({report['gate']})")
+            sm = report.get("sampling") or {}
+            print(f"  traces: {sm.get('traces_sampled', 0)} head-"
+                  f"sampled, {sm.get('traces_kept', 0)} tail-kept "
+                  f"{dict(sm.get('kept_reasons') or {})}, "
+                  f"{sm.get('traces_suppressed', 0)} suppressed; "
+                  f"spans suppressed "
+                  f"{sm.get('spans_suppressed', 0)}")
+            if "trace_out" in report:
+                print(f"  wrote sampled timeline to "
+                      f"{report['trace_out']} — validate with "
+                      f"tools/check_traces.py --fleet")
+            if "otlp_out" in report:
+                print(f"  wrote OTLP export to {report['otlp_out']} — "
+                      f"validate with tools/check_otlp.py")
+        return 0
     if args.procs and args.trace_overhead:
         report = fleet_trace_overhead_bench(
             n_requests=args.requests, rate_hz=args.rate,
@@ -2063,6 +2350,10 @@ def main(argv=None) -> int:
             seed=args.seed, fault_plan=plan,
             metrics_port=args.metrics_port,
             trace_out=args.trace_out,
+            otlp_out=args.otlp_out,
+            trace_keep_slow_s=args.trace_keep_slow_s,
+            **({"trace_sample": args.trace_sample}
+               if args.trace_sample is not None else {}),
             **({"decode_burst": args.decode_burst}
                if args.decode_burst is not None else {}),
         )
@@ -2100,6 +2391,16 @@ def main(argv=None) -> int:
                       f"{tp['worker_events']} from workers, dropped "
                       f"{tp['dropped']}) — validate with "
                       f"tools/check_traces.py --fleet")
+            if "sampling" in report:
+                sm = report["sampling"]
+                print(f"  sampling: head rate {sm['head_rate']:g} — "
+                      f"{sm['traces_sampled']} head-sampled, "
+                      f"{sm['traces_kept']} tail-kept "
+                      f"{sm['kept_reasons']}, "
+                      f"{sm['traces_suppressed']} suppressed")
+            if "otlp_out" in report:
+                print(f"  wrote OTLP export to {report['otlp_out']} — "
+                      f"validate with tools/check_otlp.py")
         return 0
     if args.trace_overhead:
         raise SystemExit("--trace-overhead needs --procs N (it measures "

@@ -461,9 +461,14 @@ class SlotEngine(_EngineBase):
         tr = self.tracer
         if tr is not None and tr.enabled:
             ids = self._dispatch_ids()
+            # sampled_only: the burst span names no trace_id (it is a
+            # shared engine-lane record), so under head sampling it is
+            # kept only while some SAMPLED request is in flight —
+            # otherwise an idle 1%-sampled fleet would still record a
+            # span per burst and the plane would never shrink
             span = tr.span("decode_burst", pid=self.replica,
                            tid=ENGINE_LANE, burst=k, active=len(ids),
-                           cursor=self.cursor)
+                           cursor=self.cursor, sampled_only=True)
             ann = jax.profiler.TraceAnnotation(
                 "serve:decode[" + ",".join(ids) + "]"
             )
@@ -1163,10 +1168,14 @@ class PagedEngine(_EngineBase):
         tr = self.tracer
         if tr is not None and tr.enabled:
             ids = self._dispatch_ids()
+            # sampled_only: same head-sampling gate as SlotEngine's
+            # burst span — no trace_id, so it rides only while a
+            # sampled request is flowing
             span = tr.span("decode_burst", pid=self.replica,
                            tid=ENGINE_LANE, burst=k, active=len(ids),
                            blocks_grown=grown, cow_splits=splits,
-                           blocks_free=self.blocks.num_free)
+                           blocks_free=self.blocks.num_free,
+                           sampled_only=True)
             ann = jax.profiler.TraceAnnotation(
                 "serve:decode[" + ",".join(ids) + "]"
             )

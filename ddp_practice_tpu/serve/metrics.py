@@ -99,13 +99,15 @@ class ServeMetrics:
         self.tokens_total.inc(len(completion.tokens))
         # exemplar = the completion's trace_id: the latency histograms
         # in /metrics carry a per-bucket pointer back into the trace
-        # timeline (render_text emits OpenMetrics `# {trace_id=...}`)
+        # timeline (render_text emits OpenMetrics `# {trace_id=...}`).
+        # Only KEPT traces may be cited — an exemplar naming a
+        # sampling-suppressed trace_id is a dead link by construction.
+        ex = (completion.trace_id
+              if getattr(completion, "trace_sampled", True) else None)
         if completion.ttft is not None:
-            self.ttft.observe(completion.ttft,
-                              exemplar=completion.trace_id)
+            self.ttft.observe(completion.ttft, exemplar=ex)
         if completion.tpot is not None:
-            self.tpot.observe(completion.tpot,
-                              exemplar=completion.trace_id)
+            self.tpot.observe(completion.tpot, exemplar=ex)
 
     # reporting ------------------------------------------------------------
     def report(self, elapsed_s: Optional[float] = None) -> dict:
@@ -168,12 +170,13 @@ class RouterMetrics:
             f"serve_router_requests_{completion.status}"
         ).inc()
         self.tokens_total.inc(len(completion.tokens))
+        # kept-only exemplars, same contract as ServeMetrics.on_complete
+        ex = (completion.trace_id
+              if getattr(completion, "trace_sampled", True) else None)
         if completion.ttft is not None:
-            self.ttft.observe(completion.ttft,
-                              exemplar=completion.trace_id)
+            self.ttft.observe(completion.ttft, exemplar=ex)
         if completion.tpot is not None:
-            self.tpot.observe(completion.tpot,
-                              exemplar=completion.trace_id)
+            self.tpot.observe(completion.tpot, exemplar=ex)
 
     def report(self) -> dict:
         return self.registry.snapshot()

@@ -71,6 +71,7 @@ from ddp_practice_tpu.utils.backoff import backoff_delay
 from ddp_practice_tpu.utils.metrics import MetricsRegistry
 from ddp_practice_tpu.utils.trace import (
     ROUTER_PID,
+    TraceSampler,
     label_replica,
     label_router,
 )
@@ -413,6 +414,12 @@ class Router:
             # stamped ONCE here: every retry/failover re-admission below
             # reuses it, so a migrated request is one timeline
             req.trace_id = f"r{req.rid}"
+        if self.tracer is not None:
+            # the head-sampling decision, stamped once with the trace_id
+            # and propagated to every sub-request (and across the RPC
+            # seam) — workers honor it instead of re-deciding
+            req.sampled = self.tracer.begin_trace(req.trace_id,
+                                                  req.sampled)
         cfg = self.config
         if req.deadline is None and cfg.request_timeout_s is not None:
             req.deadline = req.arrival + cfg.request_timeout_s
@@ -469,6 +476,11 @@ class Router:
         if kind == "resumed":
             if st._resumed_at is None:
                 st._resumed_at = now
+            if self.tracer is not None:
+                # a resume splice is a tail keep-rule of its own: the
+                # staged timeline promotes the moment the consumer saw
+                # the seam, not at completion
+                self.tracer.note_keep(st.trace_id, "resumed")
         elif st._resumed_at is not None:
             # the resume gap closes at the next consumer-visible edge
             # (first post-splice tokens, or the end if none ever came) —
@@ -584,7 +596,22 @@ class Router:
                 # the ORIGINAL trace_id: the survivor's spans join the
                 # migrated request's timeline (tests/test_trace.py)
                 trace_id=req.trace_id,
+                # a request that already retried / failed over IS the
+                # anomaly tail sampling exists to keep: upgrade the
+                # decision so the post-fault attempt records fully on
+                # the worker (its pre-fault spans were tail-promoted by
+                # the retry/failover markers)
+                sampled=(True if (tr.retries or tr.failovers)
+                         else req.sampled),
             )
+            # stamp the dispatch time BEFORE the submit hop: a remote
+            # worker can queue and even start prefill while the RPC is
+            # still in flight, and a post-submit stamp would put the
+            # dispatch instant AFTER the worker's spans — backwards
+            # causality the fleet validator rightly rejects
+            rec = self.tracer
+            t_dispatch = (rec.now() if rec is not None and rec.enabled
+                          else None)
             h.submit(sub)
             if getattr(h, "last_submit_refused", False):
                 # a DRAINING worker refused at the door — typed and
@@ -592,12 +619,13 @@ class Router:
                 # of writing the replica off (it is finishing in-flight
                 # streams and will exit on its own)
                 continue
-            rec = self.tracer
-            if rec is not None and rec.enabled:
-                rec.instant(
-                    "dispatch", trace_id=req.trace_id, pid=ROUTER_PID,
-                    replica=h.id, attempt=tr.retries + tr.failovers,
-                    salvaged=len(tr.prefix),
+            if t_dispatch is not None:
+                rec.record_instant(
+                    "dispatch", t_dispatch, trace_id=req.trace_id,
+                    pid=ROUTER_PID,
+                    attrs={"replica": h.id,
+                           "attempt": tr.retries + tr.failovers,
+                           "salvaged": len(tr.prefix)},
                 )
             return True
         return False
@@ -886,6 +914,14 @@ class Router:
             arrival=req.arrival, finish=now, ttft=ttft, tpot=tpot,
             flight=flight, trace_id=req.trace_id,
         )
+        if self.tracer is not None:
+            # tail verdict on the ROUTER's recorder (the fleet
+            # timeline): keeps on bad status, any retry/failover hop,
+            # or end-to-end latency past the slow threshold. The
+            # outcome gates the fleet histogram exemplars below.
+            c.trace_sampled = self.tracer.finish_trace(
+                req.trace_id, status=status, latency_s=total,
+                retries=tr.retries, failovers=tr.failovers)
         tr.done = True
         self._pending -= 1
         # drop the tracking entry so live state stays O(in-flight) and
@@ -952,6 +988,8 @@ def make_router(
     tracer=None,
     slo=None,
     telemetry=None,
+    trace_sample: float = 1.0,
+    trace_keep_slow_s: Optional[float] = None,
 ) -> Router:
     """Build a fleet of identical replicas (replicated params — the
     sharded-params variant is ROADMAP follow-up) on one shared clock,
@@ -959,10 +997,18 @@ def make_router(
     FaultPlan targets it, its own deterministic injector. `tracer`
     (utils/trace.py TraceRecorder) threads one recorder through the
     router, every scheduler, and every engine — pid=replica, labelled
-    lanes — for `--trace-out` Chrome-trace export."""
+    lanes — for `--trace-out` Chrome-trace export. `trace_sample` /
+    `trace_keep_slow_s` attach the head-sampling + tail-keep policy to
+    that recorder (default: record everything)."""
     if n_replicas < 1:
         raise ValueError("n_replicas must be >= 1")
     clock = clock or MonotonicClock()
+    if tracer is not None and (trace_sample < 1.0
+                               or trace_keep_slow_s is not None):
+        tracer.set_sampler(
+            TraceSampler(trace_sample, keep_slow_s=trace_keep_slow_s),
+            registry=registry,
+        )
     schedulers = []
     for i in range(n_replicas):
         engine = SlotEngine(

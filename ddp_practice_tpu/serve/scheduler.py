@@ -109,6 +109,12 @@ class Request:
     # it through to sub-requests, so a crash-migrated request renders as
     # ONE timeline). Stamped "r{rid}" by submit() when None.
     trace_id: Optional[str] = None
+    # the head-sampling decision for trace_id (Dapper coherence: decided
+    # ONCE at router/scheduler admission, propagated through the RPC
+    # seam so a worker never re-rolls it). None = undecided — stamped by
+    # submit() from the tracer's sampler; stays None when sampling is
+    # off (everything records, the pre-sampling behavior).
+    sampled: Optional[bool] = None
     # when submit() actually ran (clock domain; stamped by submit) —
     # flight records measure in-queue wait from here. `arrival` may
     # predate it (trace replays poll late; failover re-admissions keep
@@ -178,6 +184,11 @@ class Completion:
     # exemplars (utils/metrics.py) and telemetry flight lines can point
     # BACK into the trace timeline — a p99 bucket names the offender
     trace_id: Optional[str] = None
+    # whether trace_id actually made it into the timeline (head-sampled
+    # or tail-kept). False = suppressed by sampling: exemplars must NOT
+    # cite it — an exemplar pointing at a suppressed trace is a dead
+    # link. True whenever sampling is off.
+    trace_sampled: bool = True
 
 
 def _attempt_phases(req: Request, now: float,
@@ -271,6 +282,13 @@ class Scheduler:
             req.arrival = self.clock.now()
         if req.trace_id is None:
             req.trace_id = f"r{req.rid}"
+        if self.tracer is not None:
+            # the head decision, made exactly once per trace_id: reuse
+            # an upstream stamp (router / RPC seam) when present, roll
+            # the deterministic hash otherwise. Unsampled requests'
+            # spans stage until the tail verdict in _finish.
+            req.sampled = self.tracer.begin_trace(req.trace_id,
+                                                  req.sampled)
         req.submitted = self.clock.now()
         if req.max_new_tokens < 1:
             # needed=0 would slip past every headroom guard and a
@@ -376,6 +394,14 @@ class Scheduler:
                 attrs={"rid": req.rid, "status": status,
                        "tokens": len(tokens)},
             )
+        if tr is not None:
+            # tail verdict: promote the staged spans when a keep-rule
+            # fires (bad status / slow / an anomaly marker already
+            # promoted them), else discard as suppressed. The outcome
+            # rides the completion so exemplars only cite kept traces.
+            c.trace_sampled = tr.finish_trace(
+                req.trace_id, status=status,
+                latency_s=now - req.arrival)
         self.completions.append(c)
         if self.metrics:
             self.metrics.on_complete(c, self)
@@ -405,6 +431,7 @@ class Scheduler:
             rid=orig.rid, prompt=prompt, max_new_tokens=max_new,
             deadline=orig.deadline, seed=orig.seed, arrival=orig.arrival,
             priority=orig.priority, trace_id=orig.trace_id,
+            sampled=orig.sampled,
         )
         creq.submitted = self.clock.now()
         return creq

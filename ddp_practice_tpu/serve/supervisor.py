@@ -582,7 +582,7 @@ class RemoteReplicaHandle:
             "max_new_tokens": req.max_new_tokens,
             "deadline": req.deadline, "seed": req.seed,
             "arrival": req.arrival, "priority": req.priority,
-            "trace_id": req.trace_id,
+            "trace_id": req.trace_id, "sampled": req.sampled,
         }
 
     @staticmethod
@@ -592,6 +592,7 @@ class RemoteReplicaHandle:
             arrival=d["arrival"], finish=d["finish"],
             ttft=d.get("ttft"), tpot=d.get("tpot"),
             flight=d.get("flight"), trace_id=d.get("trace_id"),
+            trace_sampled=d.get("sampled", True),
         )
 
     # ---------------- the seam: submit down, completions watermark up
@@ -843,15 +844,18 @@ class RemoteReplicaHandle:
             self._clock_sample(r, t0, self.clock.now())
         return self.trace_collector.skew_bound(self.id)
 
-    def set_trace(self, enabled: bool) -> bool:
+    def set_trace(self, enabled: bool,
+                  sample: Optional[float] = None) -> bool:
         """Toggle the worker's span recording (the overhead bench's
-        on/off lever); False when the worker has no tracer or the call
-        failed (a disabled plane, not an error)."""
+        on/off lever); `sample` adjusts the worker's head rate in place
+        (the sampling bench's per-arm knob). False when the worker has
+        no tracer or the call failed (a disabled plane, not an
+        error)."""
         c = self._client()
         if c is None:
             return False
         try:
-            r = c.call("trace", enabled=enabled,
+            r = c.call("trace", enabled=enabled, sample=sample,
                        timeout_s=self.poll_timeout_s)
         except (RpcError, RpcRemoteError):
             return False
@@ -1048,6 +1052,19 @@ def make_fleet_router(
         for i in range(n_workers):
             collector.label_worker(
                 i, specs[i].engine.get("max_slots", 4))
+        if (base_spec.trace_sample < 1.0
+                or base_spec.trace_keep_slow_s is not None):
+            # the fleet-side half of the coherent-sampling contract:
+            # the router stamps one head decision per trace_id with the
+            # SAME hash the workers use, so both ends of the RPC seam
+            # agree without ever exchanging a verdict
+            from ddp_practice_tpu.utils.trace import TraceSampler
+
+            tracer.set_sampler(
+                TraceSampler(base_spec.trace_sample,
+                             keep_slow_s=base_spec.trace_keep_slow_s),
+                registry=registry,
+            )
     supervisor = Supervisor(specs, sup_config, spawn_fn=spawn_fn,
                             clock=clock)
     supervisor.start()

@@ -72,6 +72,15 @@ class WorkerSpec:
     # disabled-tracer contract).
     trace: bool = False
     trace_buffer: int = 4096    # pending-events bound (drops counted)
+    # head-sampling rate for the trace plane (1.0 = record everything,
+    # the pre-sampling behavior). The ROUTER decides per trace_id and
+    # propagates the decision on the wire; this local policy covers
+    # direct submits and lets the worker agree deterministically when
+    # no upstream decision rode along (same crc32 hash, same answer).
+    trace_sample: float = 1.0
+    # tail keep-rule: head-unsampled requests slower than this are
+    # promoted to kept at completion (None = no latency rule)
+    trace_keep_slow_s: Optional[float] = None
     # token streaming: the scheduler emits per-burst TokenChunks and
     # the worker ships them inside its `pub` push frames (atomically
     # with the inflight salvage point — a dropped frame loses both
@@ -225,6 +234,7 @@ class WorkerServer:
             # enter the stream (the bench/router warmup-clear contract)
             from ddp_practice_tpu.utils.trace import (
                 TraceRecorder,
+                TraceSampler,
                 label_replica,
             )
 
@@ -232,6 +242,17 @@ class WorkerServer:
             self._tracer = TraceRecorder(
                 max_events=spec.trace_buffer, sink=self._trace_buf.put,
             )
+            if (spec.trace_sample < 1.0
+                    or spec.trace_keep_slow_s is not None):
+                # upstream suppression is THE point: unsampled requests
+                # never enter this buffer or the push stream — they wait
+                # in the recorder's per-request staging for a tail
+                # verdict, and only kept spans ride the wire
+                self._tracer.set_sampler(
+                    TraceSampler(spec.trace_sample,
+                                 keep_slow_s=spec.trace_keep_slow_s),
+                    registry=self.registry,
+                )
             label_replica(self._tracer, spec.replica,
                           self.engine.config.max_slots)
             self.scheduler.tracer = self._tracer
@@ -319,6 +340,10 @@ class WorkerServer:
                 arrival=r.get("arrival"),
                 priority=r.get("priority", 0),
                 trace_id=r.get("trace_id"),
+                # the router's head decision rides the wire (Dapper
+                # coherence); absent → the scheduler re-derives it from
+                # the same deterministic hash and agrees anyway
+                sampled=r.get("sampled"),
             ))
             self._seen_rids[rid] = True
             # the dedup window only needs to outlive a transport retry
@@ -337,6 +362,9 @@ class WorkerServer:
             "arrival": c.arrival, "finish": c.finish,
             "ttft": c.ttft, "tpot": c.tpot, "flight": c.flight,
             "trace_id": c.trace_id,
+            # the worker-side keep verdict, so the router's exemplar
+            # gating sees whether this attempt's spans are in the stream
+            "sampled": getattr(c, "trace_sampled", True),
         }
 
     def _publish(self) -> None:
@@ -440,18 +468,35 @@ class WorkerServer:
         """Toggle span recording at runtime (idempotent). The overhead
         bench flips the whole trace plane off/on per rep against the
         same warm fleet — `enabled=false` also clears anything pending,
-        so a later re-enable starts a clean stream."""
+        so a later re-enable starts a clean stream. An optional
+        ``sample`` adjusts the head rate in place (the sampling bench
+        compares 1% / full / off against ONE warm fleet)."""
         enabled = bool(req.get("enabled", True))
+        sample = req.get("sample")
         if self._tracer is None:
             return {"supported": False, "enabled": False}
         with self._lock:
+            if sample is not None:
+                if self._tracer.sampler is None:
+                    from ddp_practice_tpu.utils.trace import TraceSampler
+
+                    self._tracer.set_sampler(
+                        TraceSampler(
+                            float(sample),
+                            keep_slow_s=self.spec.trace_keep_slow_s),
+                        registry=self.registry,
+                    )
+                else:
+                    self._tracer.sampler.rate = float(sample)
             if enabled:
                 self._tracer.enable()
             else:
                 self._tracer.disable()
                 self._tracer.clear()
                 self._trace_buf.clear()
-        return {"supported": True, "enabled": enabled}
+        return {"supported": True, "enabled": enabled,
+                "sample": (None if self._tracer.sampler is None
+                           else self._tracer.sampler.rate)}
 
     def _op_poll(self, req: dict) -> dict:
         """The heartbeat + completions-watermark read. `watermark` is

@@ -85,6 +85,11 @@ class FlightStats:
 
     def on_completion(self, completion, **_kw) -> None:
         tid = getattr(completion, "trace_id", None)
+        if not getattr(completion, "trace_sampled", True):
+            # suppressed by sampling: the latency sample still counts,
+            # but the p99 exemplar must not point at a trace that is
+            # not in the timeline
+            tid = None
         with self._lock:
             if completion.flight is not None:
                 self._flights.append(completion.flight)

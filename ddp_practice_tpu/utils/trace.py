@@ -59,10 +59,12 @@ spans by name (utils/xprof.py reads the device side back).
 from __future__ import annotations
 
 import contextlib
+import hashlib
 import itertools
 import json
 import threading
 import time
+import zlib
 from collections import defaultdict, deque
 from typing import Dict, Optional
 
@@ -93,6 +95,95 @@ def _resolve_clock(clock):
     raise TypeError(f"clock must have .now() or be callable: {clock!r}")
 
 
+# ------------------------------------------------------------- sampling
+# Tail-keep markers: an instant/span with one of these names arriving for
+# a staged (head-unsampled) request promotes the whole staged timeline on
+# the spot — anomalies keep their traces even if the process dies before
+# the request completes. The names match what the router/scheduler/engine
+# already record ("retry"/"failover" instants, "preempted"/"preempt",
+# "stale_retry", "replica_dead") plus the terminal status instants the
+# scheduler stamps for non-eos/length outcomes.
+KEEP_MARKERS = frozenset({
+    "preempt", "preempted", "retry", "failover", "resumed",
+    "stale_retry", "replica_dead", "shed", "timeout", "error",
+    "rejected",
+})
+
+# statuses that terminate cleanly — anything else is a keep-worthy outcome
+_CLEAN_STATUSES = ("eos", "length")
+
+
+def head_keep(trace_id: str, rate: float) -> bool:
+    """The deterministic head-sampling decision: keep `trace_id` at
+    `rate`. Dapper's coherence rule is that this decision is made ONCE
+    per request and honored by every process the request touches — so it
+    must be a pure function of the trace_id, stable across OS processes.
+    Python's builtin hash() is salted per interpreter (PYTHONHASHSEED)
+    and would give the router and a worker process DIFFERENT answers;
+    crc32 is stable everywhere."""
+    if rate >= 1.0:
+        return True
+    if rate <= 0.0:
+        return False
+    h = zlib.crc32(trace_id.encode("utf-8")) & 0xFFFFFFFF
+    return (h / 4294967296.0) < rate
+
+
+class TraceSampler:
+    """Sampling policy: head rate + tail keep-rules.
+
+    `rate` is the head-sampling probability (decided per trace_id by
+    `head_keep`, or by an injected `decide` callable in tests);
+    `keep_slow_s` is the latency threshold above which a completed
+    request is tail-kept even when head-unsampled (SLO-derived: a
+    straggler IS the interesting trace); `stage_limit` bounds the
+    per-request staging area a head-unsampled request's spans wait in
+    until its tail verdict."""
+
+    def __init__(self, rate: float = 1.0, *,
+                 keep_slow_s: Optional[float] = None,
+                 stage_limit: int = 256, decide=None) -> None:
+        if stage_limit < 1:
+            raise ValueError("stage_limit must be positive")
+        self.rate = float(rate)
+        self.keep_slow_s = keep_slow_s
+        self.stage_limit = stage_limit
+        self._decide = decide
+
+    def sampled(self, trace_id: str) -> bool:
+        if self._decide is not None:
+            return bool(self._decide(trace_id))
+        return head_keep(trace_id, self.rate)
+
+    def keep_reason(self, *, status: Optional[str] = None,
+                    latency_s: Optional[float] = None,
+                    retries: int = 0, failovers: int = 0
+                    ) -> Optional[str]:
+        """Tail verdict at completion: the keep reason, or None to
+        suppress. Any non-clean terminal status, any retry/failover hop,
+        or a latency past the slow threshold keeps the trace."""
+        if status is not None and status not in _CLEAN_STATUSES:
+            return str(status)
+        if failovers:
+            return "failover"
+        if retries:
+            return "retry"
+        if (self.keep_slow_s is not None and latency_s is not None
+                and latency_s > self.keep_slow_s):
+            return "slow"
+        return None
+
+
+class _TailStage:
+    """One head-unsampled request's bounded span staging area."""
+
+    __slots__ = ("records", "dropped")
+
+    def __init__(self, limit: int) -> None:
+        self.records: deque = deque(maxlen=limit)
+        self.dropped = 0
+
+
 class _Rec:
     __slots__ = ("kind", "name", "t0", "t1", "pid", "tid", "trace_id",
                  "attrs", "seq")
@@ -112,15 +203,18 @@ class _Rec:
 class _Span:
     """Context manager for one lane span; created only when enabled."""
 
-    __slots__ = ("rec", "name", "trace_id", "pid", "tid", "attrs", "t0")
+    __slots__ = ("rec", "name", "trace_id", "pid", "tid", "attrs", "t0",
+                 "sampled_only")
 
-    def __init__(self, rec, name, trace_id, pid, tid, attrs):
+    def __init__(self, rec, name, trace_id, pid, tid, attrs,
+                 sampled_only=False):
         self.rec = rec
         self.name = name
         self.trace_id = trace_id
         self.pid = pid
         self.tid = tid
         self.attrs = attrs
+        self.sampled_only = sampled_only
 
     def __enter__(self):
         self.t0 = self.rec._now()
@@ -130,6 +224,7 @@ class _Span:
         self.rec.record_span(
             self.name, self.t0, self.rec._now(), trace_id=self.trace_id,
             pid=self.pid, tid=self.tid, attrs=self.attrs,
+            sampled_only=self.sampled_only,
         )
         return False
 
@@ -164,6 +259,22 @@ class TraceRecorder:
         # run's events survive outside this ring buffer. None = the
         # exit-time export (save()) is the only output.
         self._sink = None
+        # head sampling + tail keep (None = record everything, the
+        # pre-sampling behavior; see set_sampler / begin_trace)
+        self.sampler: Optional[TraceSampler] = None
+        self._head: Dict[str, int] = {}        # 0 staged / 1 head / 2 kept
+        self._staged: Dict[str, _TailStage] = {}
+        self._outcomes: Dict[str, bool] = {}   # finished trace -> recorded
+        self._active_flowing = 0               # in-flight head/kept traces
+        self.spans_sampled = 0                 # records kept by the head decision
+        self.spans_kept = 0                    # records kept by a tail rule
+        self.spans_suppressed = 0              # staged records discarded
+        self.traces_sampled = 0
+        self.traces_kept = 0
+        self.traces_suppressed = 0
+        self.kept_reasons: Dict[str, int] = {}
+        self._c_sampled = self._c_kept = self._c_suppressed = None
+        self._keep_registry = None
         if sink is not None:
             self.set_sink(sink)
 
@@ -222,22 +333,232 @@ class TraceRecorder:
         loss accounting — one number answers "is this timeline whole"."""
         self._note_drops(n)
 
+    # ------------------------------------------------------------- sampling
+    def set_sampler(self, sampler: Optional[TraceSampler], *,
+                    registry=None) -> None:
+        """Attach the sampling policy. With `registry` (utils/metrics.py
+        MetricsRegistry), mints the accounting counters —
+        trace_spans_sampled/kept/suppressed_total plus a per-reason
+        trace_traces_kept_total{reason=...} family."""
+        self.sampler = sampler
+        if registry is not None:
+            self._c_sampled = registry.counter("trace_spans_sampled_total")
+            self._c_kept = registry.counter("trace_spans_kept_total")
+            self._c_suppressed = registry.counter(
+                "trace_spans_suppressed_total")
+            self._keep_registry = registry
+
+    def begin_trace(self, trace_id: Optional[str],
+                    sampled: Optional[bool] = None) -> bool:
+        """Stamp the head decision for one request at admission.
+        Idempotent per trace_id (the router and a scheduler sharing one
+        recorder both call it); `sampled` carries an upstream decision
+        across the RPC seam (Dapper coherence: decided once, honored
+        everywhere). Returns whether the request's spans flow."""
+        if self.sampler is None or trace_id is None or not self.enabled:
+            return True if sampled is None else bool(sampled)
+        v = self._head.get(trace_id)
+        if v is not None:
+            return v != 0
+        if sampled is None:
+            sampled = self.sampler.sampled(trace_id)
+        if len(self._head) >= 16384:
+            # runaway begin/finish imbalance must not leak: evict the
+            # oldest in-flight trace, suppressing anything it staged
+            old, ov = next(iter(self._head.items()))
+            del self._head[old]
+            stg = self._staged.pop(old, None)
+            if stg is not None:
+                self._suppress(len(stg.records) + stg.dropped)
+            elif ov != 0:
+                self._active_flowing -= 1
+        if sampled:
+            self._head[trace_id] = 1
+            self._active_flowing += 1
+            self.traces_sampled += 1
+        else:
+            self._head[trace_id] = 0
+            self._staged[trace_id] = _TailStage(self.sampler.stage_limit)
+        return bool(sampled)
+
+    def note_keep(self, trace_id: Optional[str],
+                  reason: str = "marked") -> None:
+        """Promote a staged request to kept RIGHT NOW (flush its staged
+        spans; everything it records from here on flows). No-op for
+        head-sampled / unknown / already-resolved traces."""
+        if self.sampler is None or trace_id is None:
+            return
+        if self._head.get(trace_id) == 0:
+            self._promote(trace_id, reason)
+
+    def trace_recorded(self, trace_id: Optional[str]) -> bool:
+        """Is this trace_id in the timeline (head-sampled, tail-kept, or
+        sampling off)? The exemplar gate: a histogram exemplar citing a
+        suppressed trace is a dead link."""
+        if self.sampler is None or trace_id is None:
+            return True
+        v = self._head.get(trace_id)
+        if v is not None:
+            return v != 0
+        return self._outcomes.get(trace_id, True)
+
+    def finish_trace(self, trace_id: Optional[str], *,
+                     status: Optional[str] = None,
+                     latency_s: Optional[float] = None,
+                     retries: int = 0, failovers: int = 0) -> bool:
+        """The tail verdict at request completion: promote the staged
+        spans when any keep-rule fires, otherwise discard them as
+        suppressed. Returns whether the trace is in the timeline (the
+        exemplar gate). Idempotent: a second finish (router after
+        scheduler on a shared recorder) returns the first outcome."""
+        if self.sampler is None or trace_id is None or not self.enabled:
+            return True
+        v = self._head.pop(trace_id, None)
+        if v is None:
+            return self._outcomes.get(trace_id, True)
+        if v != 0:
+            self._active_flowing -= 1
+            self._remember(trace_id, True)
+            return True
+        stg = self._staged.pop(trace_id, None)
+        reason = self.sampler.keep_reason(
+            status=status, latency_s=latency_s, retries=retries,
+            failovers=failovers)
+        if reason is not None:
+            self.traces_kept += 1
+            self._count_reason(reason)
+            if stg is not None:
+                for r in stg.records:
+                    self._flush_rec(r)
+                self.spans_kept += len(stg.records)
+                if self._c_kept is not None:
+                    self._c_kept.inc(len(stg.records))
+                if stg.dropped:
+                    self._note_drops(stg.dropped)
+            self._remember(trace_id, True)
+            return True
+        self.traces_suppressed += 1
+        if stg is not None:
+            self._suppress(len(stg.records) + stg.dropped)
+        self._remember(trace_id, False)
+        return False
+
+    def _remember(self, trace_id: str, recorded: bool) -> None:
+        self._outcomes[trace_id] = recorded
+        if len(self._outcomes) > 8192:
+            self._outcomes.pop(next(iter(self._outcomes)))
+
+    def _suppress(self, n: int) -> None:
+        if n <= 0:
+            return
+        self.spans_suppressed += n
+        if self._c_suppressed is not None:
+            self._c_suppressed.inc(n)
+
+    def _count_reason(self, reason: str) -> None:
+        self.kept_reasons[reason] = self.kept_reasons.get(reason, 0) + 1
+        if self._keep_registry is not None:
+            from .metrics import labelled
+            self._keep_registry.counter(
+                labelled("trace_traces_kept_total", reason=reason)).inc()
+
+    def _promote(self, trace_id: str, reason: str) -> None:
+        """Staged -> kept: flush the staging area into the ring + sink,
+        record the reason, let subsequent records flow."""
+        self._head[trace_id] = 2
+        self._active_flowing += 1
+        self.traces_kept += 1
+        self._count_reason(reason)
+        stg = self._staged.pop(trace_id, None)
+        if stg is None:
+            return
+        for r in stg.records:
+            self._flush_rec(r)
+        self.spans_kept += len(stg.records)
+        if self._c_kept is not None:
+            self._c_kept.inc(len(stg.records))
+        if stg.dropped:
+            # staged overflow became real loss the moment we kept the
+            # trace — fold it into the recorder's drop accounting
+            self._note_drops(stg.dropped)
+
+    def _flush_rec(self, r: "_Rec") -> None:
+        self._append(r)
+        if self._sink is None:
+            return
+        if r.kind == _DUR:
+            self._stream_record("span", r.name, r.pid, r.tid,
+                                r.trace_id, r.attrs, t0=r.t0, t1=r.t1)
+        elif r.kind == _ASYNC:
+            self._stream_record("async", r.name, r.pid, None,
+                                r.trace_id, r.attrs, t0=r.t0, t1=r.t1)
+        else:
+            self._stream_record("instant", r.name, r.pid, r.tid,
+                                r.trace_id, r.attrs, t=r.t0)
+
+    def _admit(self, rec: "_Rec", sampled_only: bool = False) -> bool:
+        """The sampling gate on every record: True = record now, False =
+        staged or suppressed. Marker-named records promote their staged
+        trace on the spot (anomalies survive even a later SIGKILL)."""
+        tid_ = rec.trace_id
+        if tid_ is None:
+            # shared lane work (decode bursts): recorded only while some
+            # sampled/kept request is in flight when the producer asked
+            # for the gate — the residual cost at a 1% head rate
+            if sampled_only and self._active_flowing == 0:
+                self._suppress(1)
+                return False
+            return True
+        v = self._head.get(tid_)
+        if v is None:
+            return True
+        if v != 0:
+            if v == 1:
+                self.spans_sampled += 1
+                if self._c_sampled is not None:
+                    self._c_sampled.inc()
+            else:
+                self.spans_kept += 1
+                if self._c_kept is not None:
+                    self._c_kept.inc()
+            return True
+        if rec.name in KEEP_MARKERS:
+            self._promote(tid_, rec.name)
+            self.spans_kept += 1
+            if self._c_kept is not None:
+                self._c_kept.inc()
+            return True
+        stg = self._staged.get(tid_)
+        if stg is None:    # defensive: decision says staged, stage gone
+            return True
+        if len(stg.records) == stg.records.maxlen:
+            stg.dropped += 1
+        stg.records.append(rec)
+        return False
+
     def span(self, name: str, *, trace_id: Optional[str] = None,
-             pid: int = 0, tid: int = 0, **attrs):
-        """Lane span context manager; a shared no-op when disabled."""
+             pid: int = 0, tid: int = 0, sampled_only: bool = False,
+             **attrs):
+        """Lane span context manager; a shared no-op when disabled.
+        `sampled_only` marks shared-lane work (no trace_id of its own)
+        that should be suppressed while nothing sampled is in flight."""
         if not self.enabled:
             return _NULL_SPAN
-        return _Span(self, name, trace_id, pid, tid, attrs)
+        return _Span(self, name, trace_id, pid, tid, attrs, sampled_only)
 
     def record_span(self, name: str, t0: float, t1: float, *,
                     trace_id: Optional[str] = None, pid: int = 0,
-                    tid: int = 0, attrs: Optional[dict] = None) -> None:
+                    tid: int = 0, attrs: Optional[dict] = None,
+                    sampled_only: bool = False) -> None:
         """Explicit-timestamp lane span (for intervals the caller timed)."""
         if not self.enabled:
             return
-        self._append(_Rec(
+        rec = _Rec(
             _DUR, name, t0, t1, pid, tid, trace_id, attrs, next(self._seq)
-        ))
+        )
+        if self.sampler is not None and not self._admit(rec, sampled_only):
+            return
+        self._append(rec)
         if self._sink is not None:
             self._stream_record("span", name, pid, tid, trace_id, attrs,
                                 t0=t0, t1=t1)
@@ -249,9 +570,12 @@ class TraceRecorder:
         so overlapping requests never fight over one lane's B/E stack."""
         if not self.enabled:
             return
-        self._append(_Rec(
+        rec = _Rec(
             _ASYNC, name, t0, t1, pid, 0, trace_id, attrs, next(self._seq)
-        ))
+        )
+        if self.sampler is not None and not self._admit(rec):
+            return
+        self._append(rec)
         if self._sink is not None:
             self._stream_record("async", name, pid, None, trace_id,
                                 attrs, t0=t0, t1=t1)
@@ -271,10 +595,13 @@ class TraceRecorder:
         with the measured offset already applied)."""
         if not self.enabled:
             return
-        self._append(_Rec(
+        rec = _Rec(
             _INSTANT, name, t, t, pid, tid, trace_id, attrs or None,
             next(self._seq)
-        ))
+        )
+        if self.sampler is not None and not self._admit(rec):
+            return
+        self._append(rec)
         if self._sink is not None:
             self._stream_record("instant", name, pid, tid, trace_id,
                                 attrs or None, t=t)
@@ -296,9 +623,16 @@ class TraceRecorder:
 
     def clear(self) -> None:
         """Drop recorded events (lane labels survive) — e.g. after a
-        warmup phase whose compile-time spans would dwarf the workload."""
+        warmup phase whose compile-time spans would dwarf the workload.
+        In-flight sampling decisions survive (a cleared recorder must
+        still resolve its open requests coherently); their already-staged
+        records are dropped with the ring, uncounted, like everything
+        else clear() discards."""
         with self._lock:
             self._records.clear()
+            for stg in self._staged.values():
+                stg.records.clear()
+                stg.dropped = 0
 
     def disable(self) -> None:
         self.enabled = False
@@ -392,17 +726,154 @@ class TraceRecorder:
             ev["s"] = "t"  # thread-scoped instant
             events.append(ev)
         out = {"traceEvents": events, "displayTimeUnit": "ms"}
+        meta = {}
         if self.dropped:
             # a flight recorder that lost events must SAY so: the
             # validator (tools/check_traces.py) warns on this instead of
             # blessing a quietly truncated timeline
-            out["metadata"] = {"trace_events_dropped": self.dropped}
+            meta["trace_events_dropped"] = self.dropped
+        sm = self.sampling_meta()
+        if sm is not None:
+            # ...and a SAMPLED timeline must say it is partial BY POLICY
+            # (suppressed != dropped): check_traces reads this back so a
+            # missing lane for an unsampled request is not a loss warning
+            meta["sampling"] = sm
+        if meta:
+            out["metadata"] = meta
         return out
+
+    def sampling_meta(self) -> Optional[dict]:
+        """The export-header sampling block; None when sampling is off."""
+        if self.sampler is None:
+            return None
+        return {
+            "head_rate": self.sampler.rate,
+            "keep_slow_s": self.sampler.keep_slow_s,
+            "traces_sampled": self.traces_sampled,
+            "traces_kept": self.traces_kept,
+            "traces_suppressed": self.traces_suppressed,
+            "spans_sampled": self.spans_sampled,
+            "spans_kept": self.spans_kept,
+            "spans_suppressed": self.spans_suppressed,
+            "kept_reasons": dict(self.kept_reasons),
+        }
 
     def save(self, path: str) -> None:
         """Write the Chrome trace JSON (open in Perfetto / chrome://tracing)."""
         with open(path, "w") as f:
             json.dump(self.to_chrome_trace(), f)
+
+    # --------------------------------------------------------- OTLP export
+    def to_otlp(self, service_name: str = "ddp-serve") -> dict:
+        """Render the per-request records as an OTLP-JSON
+        ``ExportTraceServiceRequest`` (the shape an OTLP/HTTP collector
+        accepts at /v1/traces), alongside the Chrome export.
+
+        Mapping: every record carrying a trace_id becomes one span;
+        traceId (16 bytes) / spanId (8 bytes) are derived by stable hash
+        from the request's trace_id and the record identity, the
+        "request" async span is the trace root and every other record
+        parents onto it (lane spans and instants are children — instants
+        become zero-duration spans). Records WITHOUT a trace_id (shared
+        decode-burst lanes, clock_offset instants) are infrastructure,
+        not request traces, and stay in the Chrome export only.
+        Timestamps are the recorder's clock domain as unix-nanos strings
+        (proto3 JSON int64); the original trace_id and lane rides along
+        as ``ddp.*`` attributes, so tools/check_otlp.py can round-trip
+        against the Chrome export. Resource attributes carry the
+        sampling header."""
+        with self._lock:
+            records = [r for r in self._records if r.trace_id is not None]
+        by_trace: Dict[str, list] = defaultdict(list)
+        for r in records:
+            by_trace[str(r.trace_id)].append(r)
+        spans = []
+        for tid_, recs in sorted(by_trace.items()):
+            recs.sort(key=lambda r: (r.t0, r.seq))
+            trace_hex = _otlp_trace_id(tid_)
+            root = None
+            for r in recs:
+                if r.kind == _ASYNC and r.name == "request":
+                    root = r
+                    break
+            root_sid = _otlp_span_id(tid_, root.seq) if root else None
+            for r in recs:
+                sid = _otlp_span_id(tid_, r.seq)
+                attrs = {"ddp.trace_id": tid_, "ddp.pid": r.pid,
+                         "ddp.kind": ("span", "async", "instant")[r.kind]}
+                if r.kind == _DUR:
+                    attrs["ddp.tid"] = r.tid
+                if r.attrs:
+                    attrs.update(r.attrs)
+                span = {
+                    "traceId": trace_hex,
+                    "spanId": sid,
+                    "name": str(r.name),
+                    "kind": 1,  # SPAN_KIND_INTERNAL
+                    "startTimeUnixNano": str(int(round(r.t0 * 1e9))),
+                    "endTimeUnixNano": str(int(round(r.t1 * 1e9))),
+                    "attributes": _otlp_attrs(attrs),
+                }
+                if root is not None and r is not root:
+                    span["parentSpanId"] = root_sid
+                status = (r.attrs or {}).get("status")
+                if r is root and status is not None:
+                    span["status"] = (
+                        {"code": 1} if status in _CLEAN_STATUSES
+                        else {"code": 2, "message": str(status)})
+                spans.append(span)
+        resource_attrs = {"service.name": service_name}
+        sm = self.sampling_meta()
+        if sm is not None:
+            resource_attrs["ddp.sampling.head_rate"] = sm["head_rate"]
+            resource_attrs["ddp.sampling.traces_kept"] = sm["traces_kept"]
+            resource_attrs["ddp.sampling.traces_suppressed"] = (
+                sm["traces_suppressed"])
+            resource_attrs["ddp.sampling.spans_suppressed"] = (
+                sm["spans_suppressed"])
+        if self.dropped:
+            resource_attrs["ddp.trace.dropped_events"] = self.dropped
+        return {"resourceSpans": [{
+            "resource": {"attributes": _otlp_attrs(resource_attrs)},
+            "scopeSpans": [{
+                "scope": {"name": "ddp_practice_tpu.trace"},
+                "spans": spans,
+            }],
+        }]}
+
+    def save_otlp(self, path: str,
+                  service_name: str = "ddp-serve") -> None:
+        """Write the OTLP-JSON export (tools/check_otlp.py validates)."""
+        with open(path, "w") as f:
+            json.dump(self.to_otlp(service_name=service_name), f)
+
+
+def _otlp_trace_id(trace_id: str) -> str:
+    """16-byte OTLP traceId as 32 hex chars, stable-hashed from the
+    request trace_id (md5 as a hash, not a credential)."""
+    return hashlib.md5(("ddp:" + trace_id).encode("utf-8")).hexdigest()
+
+
+def _otlp_span_id(trace_id: str, seq: int) -> str:
+    """8-byte OTLP spanId as 16 hex chars, unique per record."""
+    return hashlib.md5(
+        f"{trace_id}#{seq}".encode("utf-8")).hexdigest()[:16]
+
+
+def _otlp_attrs(attrs: dict) -> list:
+    """dict -> OTLP KeyValue list (string/bool/int/double values)."""
+    out = []
+    for k, v in attrs.items():
+        if isinstance(v, bool):
+            val = {"boolValue": v}
+        elif isinstance(v, int):
+            val = {"intValue": str(v)}
+        elif isinstance(v, float):
+            val = {"doubleValue": v}
+        else:
+            val = {"stringValue": str(v)}
+        out.append({"key": str(k), "value": val})
+    return out
 
 
 # ------------------------------------------------------- lane label helpers
@@ -623,6 +1094,15 @@ class TraceCollector:
             return 0
         off = self.offset(worker)
         rec = self.recorder
+        # sampling coherence: a worker only streams spans for requests
+        # it decided belong in the timeline (head-sampled or tail-kept).
+        # If the router staged its own records for such a trace (its
+        # dispatch/failover instants), honor the worker's keep decision
+        # — one request, one verdict, fleet-wide.
+        if rec.sampler is not None:
+            for t in {ev.get("trace_id") for ev in frame.get("events", ())
+                      if ev.get("trace_id") is not None}:
+                rec.note_keep(t, "remote")
         n = 0
         for ev in frame.get("events", ()):
             kind = ev.get("kind")

@@ -26,6 +26,9 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+import json  # noqa: E402
+import time  # noqa: E402
+
 import jax  # noqa: E402
 
 # Suspenders: pytest plugins may have imported jax already (before this
@@ -41,6 +44,50 @@ except AttributeError:
     pass
 
 import pytest  # noqa: E402
+
+# ------------------------------------------------------ tier-1 time ledger
+# The tier-1 gate runs under a HARD 870 s `timeout` that truncates the
+# suite silently — a run that creeps past the budget loses its tail
+# tests without any failure saying so. Every run therefore keeps a
+# per-test duration ledger (setup+call+teardown summed per nodeid):
+# tests/test_zzz_t1_budget.py audits it in-run (z-named so the
+# alphabetical order of `-p no:randomly` runs it LAST, when the ledger
+# is complete), and sessionfinish writes it as JSON for
+# tools/check_durations.py to audit offline.
+T1_BUDGET_S = 870.0
+_T1_LEDGER: dict = {}
+_T1_START = time.monotonic()
+
+
+def pytest_runtest_logreport(report):
+    _T1_LEDGER[report.nodeid] = (
+        _T1_LEDGER.get(report.nodeid, 0.0) + report.duration
+    )
+
+
+def pytest_sessionfinish(session):
+    out = os.environ.get(
+        "DDP_T1_DURATIONS_OUT", "/tmp/_t1_durations.json"
+    )
+    try:
+        with open(out, "w") as f:
+            json.dump({
+                "markexpr": getattr(
+                    session.config.option, "markexpr", "") or "",
+                "wall_s": round(time.monotonic() - _T1_START, 3),
+                "budget_s": T1_BUDGET_S,
+                "tests": {
+                    k: round(v, 4) for k, v in _T1_LEDGER.items()
+                },
+            }, f)
+    except OSError:
+        pass  # an unwritable /tmp must not fail the suite itself
+
+
+@pytest.fixture(scope="session")
+def t1_duration_ledger():
+    """The live per-nodeid duration dict (see ledger comment above)."""
+    return _T1_LEDGER
 
 
 @pytest.fixture(scope="session")

@@ -331,6 +331,114 @@ def test_check_stream_exit_codes_both_ways(tmp_path):
     assert v["ok"] is True and v["streams"] > 0
 
 
+# --------------------------------------- ISSUE 11: OTLP artifact pair
+# a deterministic SAMPLED mini-fleet timeline (1% head rate): one
+# head-sampled request (r64 — a crc32 pin, see utils/trace.head_keep),
+# one tail-kept failover (r3), two clean suppressed requests — exported
+# BOTH ways from one recorder, so the pair must round-trip forever;
+# _bad is the OTLP form with one planted instance of every failure
+# class the validator names (bad hex, int timestamp, duplicate spanId,
+# orphaned parent)
+OTLP_OK = os.path.join(ROOT, "tests", "data", "otlp_trace.json")
+OTLP_CHROME = os.path.join(ROOT, "tests", "data",
+                           "otlp_trace_chrome.json")
+OTLP_BAD = os.path.join(ROOT, "tests", "data", "otlp_trace_bad.json")
+
+
+def test_check_otlp_exit_codes_both_ways(tmp_path):
+    # the good export validates AND round-trips against its chrome twin
+    r = _run("tools/check_otlp.py", OTLP_OK, "--chrome", OTLP_CHROME)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "OK" in r.stdout and "round-trip" in r.stdout
+    # the corrupted copy fails on every planted class, by name
+    r = _run("tools/check_otlp.py", OTLP_BAD)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "INVALID" in r.stdout
+    assert "lowercase hex" in r.stdout
+    assert "digit-string" in r.stdout
+    assert "duplicate spanId" in r.stdout
+    assert "orphaned" in r.stdout
+    # a round-trip mismatch is a failure even when both files are
+    # individually well-formed (the chrome twin of a DIFFERENT run)
+    r = _run("tools/check_otlp.py", OTLP_OK, "--chrome", TRACE)
+    assert r.returncode == 1
+    # unreadable input is exit 2, not a fake verdict
+    garbage = tmp_path / "garbage.json"
+    garbage.write_text("{broken")
+    assert _run("tools/check_otlp.py", str(garbage)).returncode == 2
+    assert _run("tools/check_otlp.py",
+                str(tmp_path / "missing.json")).returncode == 2
+    # --json appends the machine-readable report after the verdict line
+    r = _run("tools/check_otlp.py", "--json", OTLP_OK)
+    assert r.returncode == 0
+    rep = json.loads(r.stdout.split("\n", 1)[1])[0]
+    assert rep["ok"] is True and rep["spans"] == 10
+    assert rep["traces"] == 2
+
+
+def test_check_otlp_sampling_metadata_in_artifact():
+    """The checked-in export carries the sampling header as resource
+    attributes — a collector can tell a 1%-sampled partial timeline
+    from span loss without any side channel."""
+    otlp = json.load(open(OTLP_OK))
+    res = {kv["key"]: kv["value"] for kv in
+           otlp["resourceSpans"][0]["resource"]["attributes"]}
+    assert res["service.name"] == {"stringValue": "ddp-serve"}
+    assert res["ddp.sampling.head_rate"] == {"doubleValue": 0.01}
+    assert res["ddp.sampling.traces_suppressed"] == {"intValue": "2"}
+    # ...and the chrome twin says the same thing in its metadata block
+    chrome = json.load(open(OTLP_CHROME))
+    assert chrome["metadata"]["sampling"]["head_rate"] == 0.01
+    assert chrome["metadata"]["sampling"]["kept_reasons"] == {
+        "failover": 1}
+
+
+def test_check_durations_exit_codes(tmp_path):
+    """ISSUE 11 satellite: the tier-1 duration auditor's verdicts
+    pinned through its real CLI — fits (0), projects past the 870 s
+    wrapper timeout (1), unreadable ledger (2)."""
+    fits = tmp_path / "fits.json"
+    fits.write_text(json.dumps({
+        "markexpr": "not slow", "wall_s": 500.0, "budget_s": 870.0,
+        "tests": {"tests/test_a.py::t1": 3.0,
+                  "tests/test_b.py::t2": 12.5},
+    }))
+    r = _run("tools/check_durations.py", str(fits))
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "OK" in r.stdout
+    # the 12.5 s test inside a 'not slow' run draws the marker warning
+    assert "mark it" in r.stdout and "test_b" in r.stdout
+    # ...which --strict-slow escalates to a failure
+    assert _run("tools/check_durations.py", "--strict-slow",
+                str(fits)).returncode == 1
+    over = tmp_path / "over.json"
+    over.write_text(json.dumps({
+        "markexpr": "not slow", "wall_s": 900.0, "budget_s": 870.0,
+        "tests": {"tests/test_a.py::t1": 880.0},
+    }))
+    r = _run("tools/check_durations.py", str(over))
+    assert r.returncode == 1
+    assert "OVER BUDGET" in r.stdout and "truncates" in r.stdout
+    # no wall_s: projection falls back to padded sum
+    nowall = tmp_path / "nowall.json"
+    nowall.write_text(json.dumps({
+        "markexpr": "not slow",
+        "tests": {"tests/test_a.py::t1": 850.0},
+    }))
+    assert _run("tools/check_durations.py",
+                str(nowall)).returncode == 1
+    garbage = tmp_path / "garbage.json"
+    garbage.write_text("{broken")
+    assert _run("tools/check_durations.py",
+                str(garbage)).returncode == 2
+    assert _run("tools/check_durations.py",
+                str(tmp_path / "missing.json")).returncode == 2
+    notledger = tmp_path / "notledger.json"
+    notledger.write_text('{"tests": "oops"}')
+    assert _run("tools/check_durations.py",
+                str(notledger)).returncode == 2
+
+
 def test_check_stream_as_library():
     """stream_verdict() is the pure seam the bench's chaos rep calls
     in-process — pinned on the same artifacts the CLI sees."""

@@ -1,0 +1,51 @@
+"""Tier-1 runtime-budget sentinel (runs LAST by alphabetical order).
+
+The tier-1 gate wraps pytest in ``timeout -k 10 870`` — a suite that
+outgrows the budget is TRUNCATED, and truncation reads as "fewer dots",
+not as a failure. This file is the in-run alarm: z-named so the
+``-p no:randomly`` alphabetical collection order schedules it after
+every other test, when the conftest duration ledger is complete, it
+projects the full-session wall time and fails LOUDLY while there is
+still budget left to report in.
+
+Offline twin: tools/check_durations.py audits the JSON ledger the
+conftest writes at sessionfinish (env ``DDP_T1_DURATIONS_OUT``,
+default /tmp/_t1_durations.json) — same projection, same budget.
+"""
+
+import pytest
+
+# the tier-1 wrapper's hard timeout (also in conftest.T1_BUDGET_S;
+# tests/ is not a package, so the constant is repeated, not imported)
+T1_BUDGET_S = 870.0
+
+# projection model: summed per-test durations undercount collection,
+# imports, and fixture teardown still to come — pad by 5% plus a flat
+# tail allowance before comparing against the hard timeout
+OVERHEAD_FACTOR = 1.05
+TAIL_ALLOWANCE_S = 45.0
+# a partial run (-k, a single file) proves nothing about the suite;
+# only audit when the ledger looks like the real tier-1 population
+MIN_REPORTS = 100
+
+
+def test_t1_suite_fits_the_timeout(request, t1_duration_ledger):
+    markexpr = getattr(request.config.option, "markexpr", "") or ""
+    if "not slow" not in markexpr.replace("(", "").replace(")", ""):
+        pytest.skip("budget sentinel audits only the tier-1 "
+                    "(-m 'not slow') run")
+    if len(t1_duration_ledger) < MIN_REPORTS:
+        pytest.skip(f"partial run ({len(t1_duration_ledger)} reports "
+                    f"< {MIN_REPORTS}) — not the tier-1 population")
+    total = sum(t1_duration_ledger.values())
+    projected = total * OVERHEAD_FACTOR + TAIL_ALLOWANCE_S
+    slowest = sorted(t1_duration_ledger.items(),
+                     key=lambda kv: -kv[1])[:10]
+    detail = "\n".join(f"  {d:7.2f}s  {n}" for n, d in slowest)
+    assert projected < T1_BUDGET_S, (
+        f"tier-1 projects to {projected:.0f}s against the hard "
+        f"{T1_BUDGET_S:.0f}s timeout ({total:.0f}s measured across "
+        f"{len(t1_duration_ledger)} tests) — the timeout TRUNCATES "
+        f"silently, so shed load now: mark the slowest tests "
+        f"@pytest.mark.slow (>10 s belongs there).\nslowest:\n{detail}"
+    )

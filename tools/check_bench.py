@@ -88,6 +88,14 @@ DEFAULT_GATES: Dict[str, dict] = {
         {"direction": "lower", "tol": 0.50},
     "fleet_x2_stream_sigkill_100rps.inter_token_p99_s":
         {"direction": "lower", "tol": 0.50},
+    # sampled trace plane (ISSUE 11): a 1% head rate must stay ~free
+    # (acceptance: mean <= 1.02x vs tracing off) and must actually
+    # shed spans — the reduction vs full tracing is gated near its
+    # >= 0.95 acceptance floor, drift-tolerant but not collapse-blind
+    "trace_sampling_100rps.mean_ratio":
+        {"direction": "lower", "tol": 0.05},
+    "trace_sampling_100rps.span_reduction":
+        {"direction": "higher", "tol": 0.04},
 }
 
 

@@ -1,0 +1,150 @@
+"""Tier-1 duration-ledger auditor (tests/conftest.py sessionfinish).
+
+The tier-1 gate runs under ``timeout -k 10 870`` — a hard ceiling that
+TRUNCATES a too-slow suite silently (fewer dots, no failure). Every
+pytest session writes a per-test duration ledger at exit
+(``DDP_T1_DURATIONS_OUT``, default /tmp/_t1_durations.json); this tool
+audits it offline, the twin of the in-run sentinel
+tests/test_zzz_t1_budget.py::
+
+    python tools/check_durations.py [/tmp/_t1_durations.json]
+        [--budget-s 870] [--top 10] [--json]
+
+Exit codes: 0 the run fits its budget, 1 it projects past the budget,
+2 unreadable/shape-invalid ledger.
+
+What it checks:
+
+- **projection**: measured wall time (or summed durations padded 5% +
+  45 s when wall is absent) against the budget — the "will the NEXT
+  run be truncated" question;
+- **slow-marker hygiene** (WARNINGs): any test over 10 s inside a
+  ``not slow`` run belongs behind ``@pytest.mark.slow`` (the repo's
+  marker contract) — printed per offender so the fix is a one-line
+  diff, escalated to exit 1 under ``--strict-slow``.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import List
+
+DEFAULT_LEDGER = "/tmp/_t1_durations.json"
+DEFAULT_BUDGET_S = 870.0
+SLOW_MARK_S = 10.0     # pytest.ini: >10 s individually => mark slow
+OVERHEAD_FACTOR = 1.05
+TAIL_ALLOWANCE_S = 45.0
+
+
+def audit(ledger: dict, budget_s: float = DEFAULT_BUDGET_S):
+    """-> (errors, warnings, report) for one parsed ledger object."""
+    errors: List[str] = []
+    warnings: List[str] = []
+    if not isinstance(ledger, dict) or not isinstance(
+            ledger.get("tests"), dict):
+        return (["ledger must be an object with a 'tests' mapping"],
+                [], {})
+    tests = {
+        k: float(v) for k, v in ledger["tests"].items()
+        if isinstance(v, (int, float))
+    }
+    total = sum(tests.values())
+    wall = ledger.get("wall_s")
+    projected = (float(wall) if isinstance(wall, (int, float))
+                 else total * OVERHEAD_FACTOR + TAIL_ALLOWANCE_S)
+    markexpr = str(ledger.get("markexpr", ""))
+    report = {
+        "tests": len(tests), "sum_s": round(total, 1),
+        "wall_s": wall, "projected_s": round(projected, 1),
+        "budget_s": budget_s, "markexpr": markexpr,
+    }
+    if projected >= budget_s:
+        errors.append(
+            f"run projects to {projected:.0f}s against the hard "
+            f"{budget_s:.0f}s timeout — the wrapper truncates "
+            f"silently; mark the slowest tests @pytest.mark.slow"
+        )
+    if "not slow" in markexpr:
+        for nodeid, d in sorted(tests.items(), key=lambda kv: -kv[1]):
+            if d > SLOW_MARK_S:
+                warnings.append(
+                    f"{nodeid} took {d:.1f}s inside a 'not slow' run "
+                    f"(> {SLOW_MARK_S:.0f}s) — mark it "
+                    f"@pytest.mark.slow"
+                )
+    return errors, warnings, report
+
+
+def main(argv=None) -> int:
+    args = sys.argv[1:] if argv is None else list(argv)
+    if args and args[0] in ("-h", "--help"):
+        print(__doc__)
+        return 0
+    budget_s = DEFAULT_BUDGET_S
+    top = 10
+    as_json = False
+    strict_slow = False
+    path = None
+    it = iter(args)
+    for a in it:
+        if a == "--budget-s":
+            try:
+                budget_s = float(next(it))
+            except (StopIteration, ValueError):
+                print("--budget-s wants a number (seconds)")
+                return 2
+        elif a == "--top":
+            try:
+                top = int(next(it))
+            except (StopIteration, ValueError):
+                print("--top wants an integer")
+                return 2
+        elif a == "--json":
+            as_json = True
+        elif a == "--strict-slow":
+            strict_slow = True
+        elif path is None:
+            path = a
+        else:
+            print(f"unexpected argument {a!r}")
+            return 2
+    path = path or DEFAULT_LEDGER
+    try:
+        with open(path) as f:
+            ledger = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"{path}: UNREADABLE — {e}")
+        return 2
+    errors, warnings, report = audit(ledger, budget_s)
+    if not report:
+        print(f"{path}: INVALID — {errors[0]}")
+        return 2
+    rc = 1 if errors or (strict_slow and warnings) else 0
+    verdict = "OVER BUDGET" if errors else "OK"
+    print(f"{path}: {verdict} — {report['tests']} tests, "
+          f"projected {report['projected_s']}s of "
+          f"{report['budget_s']}s budget "
+          f"(markexpr: {report['markexpr'] or 'none'})")
+    for e in errors:
+        print(f"  ERROR: {e}")
+    for w in warnings:
+        print(f"  WARNING: {w}")
+    tests = ledger.get("tests", {})
+    slowest = sorted(
+        ((k, v) for k, v in tests.items()
+         if isinstance(v, (int, float))),
+        key=lambda kv: -kv[1])[:top]
+    if slowest and not as_json:
+        print("  slowest:")
+        for n, d in slowest:
+            print(f"    {d:7.2f}s  {n}")
+    if as_json:
+        print(json.dumps({**report, "errors": errors,
+                          "warnings": warnings,
+                          "slowest": slowest}, indent=2))
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,284 @@
+"""OTLP-JSON trace export validator (utils/trace.py ``to_otlp``).
+
+The OTLP export exists so a real collector (Jaeger / Tempo / any
+OTLP/HTTP endpoint) can ingest the serve timeline — which means the
+artifact must be shape-correct down to the proto3-JSON conventions an
+actual collector enforces, not just "some JSON with spans in it". This
+validator makes that a checkable contract, used two ways:
+
+- from tests: ``from tools.check_otlp import validate_otlp`` — returns
+  a list of error strings (empty = clean);
+- as a CLI::
+
+      python tools/check_otlp.py export.json [--chrome trace.json] [--json]
+
+  exit 0 clean, 1 invalid, 2 unreadable/unparseable input.
+
+Shape checks (each one a real way to lose data inside a collector):
+
+- top level is ``{"resourceSpans": [...]}`` with resource/scopeSpans/
+  spans nesting;
+- **id hygiene**: traceId is 32 lowercase hex chars, spanId is 16,
+  neither all-zero (collectors DROP zero-id spans silently), spanIds
+  unique within the export;
+- **parent linkage**: every parentSpanId resolves to a spanId in the
+  SAME trace — an orphaned parent renders as a broken trace tree;
+- **time sanity**: start/end are digit-strings (proto3 JSON int64),
+  end >= start;
+- **names and attributes**: non-empty span names; attributes are
+  KeyValue lists (``{"key": ..., "value": {<type>Value: ...}}``).
+
+Round-trip mode (``--chrome chrome_trace.json``): the OTLP export and
+the Chrome export come from the SAME recorder, so the set of request
+trace_ids must match — every span's ``ddp.trace_id`` attribute against
+the Chrome events' ``args.trace_id``. A mismatch means one exporter
+filtered what the other kept (the bug this mode exists to catch:
+sampling decisions applied to one export path but not the other).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import List
+
+_HEX = set("0123456789abcdef")
+
+
+def _is_hex(s, width: int) -> bool:
+    return (isinstance(s, str) and len(s) == width
+            and set(s) <= _HEX and set(s) != {"0"})
+
+
+def _attr_errors(attrs, where: str) -> List[str]:
+    errors = []
+    if not isinstance(attrs, list):
+        return [f"{where}: attributes must be a KeyValue list"]
+    for j, kv in enumerate(attrs):
+        if not (isinstance(kv, dict) and isinstance(kv.get("key"), str)
+                and isinstance(kv.get("value"), dict)):
+            errors.append(
+                f"{where}: attribute {j} is not a "
+                "{key, value: {...}} pair")
+            continue
+        val = kv["value"]
+        if not any(k in val for k in (
+                "stringValue", "boolValue", "intValue", "doubleValue",
+                "arrayValue", "kvlistValue", "bytesValue")):
+            errors.append(
+                f"{where}: attribute {kv['key']!r} has no typed value")
+        if "intValue" in val and not isinstance(val["intValue"], str):
+            # proto3 JSON renders int64 as a STRING; a bare JSON number
+            # silently loses precision past 2^53 inside collectors
+            errors.append(
+                f"{where}: attribute {kv['key']!r} intValue must be a "
+                "string (proto3 JSON int64)")
+    return errors
+
+
+def attrs_dict(span: dict) -> dict:
+    """KeyValue list -> plain dict (first value field wins)."""
+    out = {}
+    for kv in span.get("attributes") or []:
+        if not isinstance(kv, dict):
+            continue
+        val = kv.get("value")
+        if isinstance(val, dict) and val:
+            out[kv.get("key")] = next(iter(val.values()))
+    return out
+
+
+def iter_spans(export: dict):
+    """Flatten resourceSpans -> scopeSpans -> spans."""
+    for rs in export.get("resourceSpans", []) or []:
+        if not isinstance(rs, dict):
+            continue
+        for ss in rs.get("scopeSpans", []) or []:
+            if not isinstance(ss, dict):
+                continue
+            for span in ss.get("spans", []) or []:
+                if isinstance(span, dict):
+                    yield span
+
+
+def validate_otlp(export) -> List[str]:
+    """Validate a parsed OTLP-JSON export; return error strings."""
+    errors: List[str] = []
+    if not isinstance(export, dict) or not isinstance(
+            export.get("resourceSpans"), list):
+        return ["top level must be an object with a 'resourceSpans' list"]
+    for ri, rs in enumerate(export["resourceSpans"]):
+        if not isinstance(rs, dict):
+            errors.append(f"resourceSpans[{ri}]: not an object")
+            continue
+        res = rs.get("resource")
+        if not isinstance(res, dict):
+            errors.append(f"resourceSpans[{ri}]: missing resource")
+        else:
+            errors += _attr_errors(
+                res.get("attributes", []),
+                f"resourceSpans[{ri}].resource")
+        if not isinstance(rs.get("scopeSpans"), list):
+            errors.append(f"resourceSpans[{ri}]: missing scopeSpans list")
+    spans = list(iter_spans(export))
+    seen_sids = {}
+    by_trace = {}
+    for i, span in enumerate(spans):
+        name = span.get("name")
+        where = f"span {i} ({name!r})"
+        if not isinstance(name, str) or not name:
+            errors.append(f"span {i}: missing/empty name")
+        tid = span.get("traceId")
+        sid = span.get("spanId")
+        if not _is_hex(tid, 32):
+            errors.append(
+                f"{where}: traceId must be 32 lowercase hex chars "
+                f"(non-zero), got {tid!r}")
+            continue
+        if not _is_hex(sid, 16):
+            errors.append(
+                f"{where}: spanId must be 16 lowercase hex chars "
+                f"(non-zero), got {sid!r}")
+            continue
+        if sid in seen_sids:
+            errors.append(
+                f"{where}: duplicate spanId {sid} "
+                f"(also span {seen_sids[sid]}) — collectors keep one")
+        seen_sids[sid] = i
+        by_trace.setdefault(tid, set()).add(sid)
+        t0, t1 = span.get("startTimeUnixNano"), span.get("endTimeUnixNano")
+        for label, t in (("startTimeUnixNano", t0),
+                         ("endTimeUnixNano", t1)):
+            if not (isinstance(t, str) and t.isdigit()):
+                errors.append(
+                    f"{where}: {label} must be a digit-string "
+                    f"(proto3 JSON int64), got {t!r}")
+        if (isinstance(t0, str) and isinstance(t1, str)
+                and t0.isdigit() and t1.isdigit() and int(t1) < int(t0)):
+            errors.append(
+                f"{where}: ends before it starts ({t0} -> {t1})")
+        errors += _attr_errors(span.get("attributes", []), where)
+    # parent linkage: second pass, after every spanId is known
+    for i, span in enumerate(spans):
+        parent = span.get("parentSpanId")
+        if parent is None:
+            continue
+        tid = span.get("traceId")
+        if parent not in by_trace.get(tid, ()):
+            errors.append(
+                f"span {i} ({span.get('name')!r}): parentSpanId "
+                f"{parent!r} resolves to no span in trace {tid!r} — "
+                "orphaned subtree")
+    return errors
+
+
+def crosscheck_chrome(export: dict, chrome: dict) -> List[str]:
+    """Same-recorder round-trip: request trace_id sets must match.
+
+    OTLP side: each span's ``ddp.trace_id`` attribute. Chrome side:
+    every event's ``args.trace_id``. Events without a trace_id
+    (decode_burst lanes, clock_offset instants) are infrastructure and
+    intentionally absent from OTLP — only the request-tagged population
+    is compared."""
+    errors: List[str] = []
+    otlp_tids = set()
+    for span in iter_spans(export):
+        t = attrs_dict(span).get("ddp.trace_id")
+        if t is not None:
+            otlp_tids.add(str(t))
+    chrome_tids = set()
+    for ev in chrome.get("traceEvents", []) or []:
+        if not isinstance(ev, dict) or ev.get("ph") == "M":
+            continue
+        t = (ev.get("args") or {}).get("trace_id")
+        if t is not None:
+            chrome_tids.add(str(t))
+    only_chrome = sorted(chrome_tids - otlp_tids)
+    only_otlp = sorted(otlp_tids - chrome_tids)
+    if only_chrome:
+        errors.append(
+            f"round-trip: {len(only_chrome)} trace_id(s) in the Chrome "
+            f"export but not in OTLP (first: {only_chrome[:5]}) — the "
+            "OTLP path filtered spans the recorder kept")
+    if only_otlp:
+        errors.append(
+            f"round-trip: {len(only_otlp)} trace_id(s) in OTLP but not "
+            f"in the Chrome export (first: {only_otlp[:5]}) — the OTLP "
+            "path invented or resurrected spans")
+    return errors
+
+
+def summarize(export: dict) -> dict:
+    spans = list(iter_spans(export))
+    traces = {s.get("traceId") for s in spans}
+    roots = [s for s in spans if "parentSpanId" not in s]
+    return {"spans": len(spans), "traces": len(traces),
+            "roots": len(roots)}
+
+
+def main(argv=None) -> int:
+    args = sys.argv[1:] if argv is None else list(argv)
+    if not args or args[0] in ("-h", "--help"):
+        print(__doc__)
+        return 0
+    chrome_path = None
+    as_json = False
+    paths = []
+    it = iter(args)
+    for a in it:
+        if a == "--chrome":
+            try:
+                chrome_path = next(it)
+            except StopIteration:
+                print("--chrome wants a Chrome trace JSON path")
+                return 2
+        elif a == "--json":
+            as_json = True
+        else:
+            paths.append(a)
+    if not paths:
+        print("no OTLP export files given")
+        return 2
+    chrome = None
+    if chrome_path is not None:
+        try:
+            with open(chrome_path) as f:
+                chrome = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"{chrome_path}: UNREADABLE chrome trace — {e}")
+            return 2
+    rc = 0
+    report = []
+    for path in paths:
+        try:
+            with open(path) as f:
+                export = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"{path}: UNREADABLE — {e}")
+            return 2
+        errors = validate_otlp(export)
+        if chrome is not None:
+            errors += crosscheck_chrome(export, chrome)
+        s = summarize(export)
+        report.append({"path": path, "ok": not errors,
+                       "errors": errors, **s})
+        if errors:
+            rc = 1
+            print(f"{path}: INVALID ({len(errors)} error(s); "
+                  f"{s['spans']} spans)")
+            for e in errors[:20]:
+                print(f"  - {e}")
+            if len(errors) > 20:
+                print(f"  ... and {len(errors) - 20} more")
+        else:
+            extra = " (round-trip vs chrome OK)" if chrome is not None \
+                else ""
+            print(f"{path}: OK — {s['spans']} spans across "
+                  f"{s['traces']} trace(s), {s['roots']} root(s){extra}")
+    if as_json:
+        print(json.dumps(report, indent=2))
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

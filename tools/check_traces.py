@@ -54,7 +54,14 @@ cross-process timeline (utils/trace.py TraceCollector):
   spans are not an error (the spans that did arrive pre-crash still
   validate), only an out-of-order one is;
 - dropped-event metadata (``trace_events_dropped``) prints as a WARNING
-  either way — a lossy timeline is usable but must say so.
+  either way — a lossy timeline is usable but must say so;
+- a SAMPLED timeline (``metadata.sampling``, utils/trace.py
+  TraceSampler) is a *partial by policy* timeline: a dispatch whose
+  worker lane is absent is exactly what a 1% head rate produces, so
+  the missing-lane tolerance above is load-bearing, not charity. The
+  sampling header prints as an INFO line — suppressed-by-policy spans
+  are an operator choice and must never be confused with
+  dropped-by-buffer spans (data loss), which keep their WARNING.
 """
 
 from __future__ import annotations
@@ -442,10 +449,13 @@ def main(argv=None) -> int:
         if fleet:
             errors += validate_fleet(trace, skew_s)
         dropped = 0
+        sampling = None
         if isinstance(trace, dict):
             md = trace.get("metadata")
             if isinstance(md, dict):
                 dropped = md.get("trace_events_dropped", 0) or 0
+                if isinstance(md.get("sampling"), dict):
+                    sampling = md["sampling"]
         s = summarize(trace)
         if errors:
             rc = 1
@@ -460,12 +470,27 @@ def main(argv=None) -> int:
             spans = ", ".join(f"{n} x{c}" for n, c in top) or "none"
             print(f"{path}: OK — {s['events']} events, "
                   f"pids {s['pids']}, spans: {spans}{note}")
+        if sampling:
+            # informational, NOT a warning: suppressed spans are an
+            # operator policy (head rate), not data loss — the tail
+            # keep-rules promoted every anomalous trace regardless
+            kept = sampling.get("kept_reasons") or {}
+            reasons = ", ".join(
+                f"{k}={v}" for k, v in sorted(kept.items())) or "none"
+            print(f"{path}: INFO — sampled timeline (head rate "
+                  f"{sampling.get('head_rate')}): "
+                  f"{sampling.get('spans_suppressed', 0)} span(s) "
+                  f"suppressed by policy, "
+                  f"{sampling.get('traces_kept', 0)} trace(s) "
+                  f"tail-kept ({reasons}); partial lanes here are "
+                  f"sampling, not loss")
         if dropped:
             # a warning, not a verdict: the timeline is valid but has a
             # hole — whoever reads it should know before trusting gaps
             print(f"{path}: WARNING — {dropped} trace event(s) were "
-                  f"dropped (bounded buffers); the timeline is "
-                  f"truncated, not corrupt")
+                  f"dropped (bounded buffers, distinct from sampling "
+                  f"suppression); the timeline is truncated, not "
+                  f"corrupt")
     return rc
 
 
