@@ -35,6 +35,27 @@ Examples:
                                        # stream back + merge into ONE
                                        # clock-aligned timeline; validate
                                        # with check_traces.py --fleet
+  python -m ddp_practice_tpu.cli serve --procs 2 \\
+      --otlp-endpoint http://collector:4318/v1/traces
+                                       # LIVE egress: kept spans batch-
+                                       # POST to an OTLP/HTTP collector
+                                       # as they land (bounded queue,
+                                       # retry backoff, dead-endpoint
+                                       # breaker; at-least-once with
+                                       # batch-id dedup)
+  python -m ddp_practice_tpu.cli serve --procs 2 --rate 100 \\
+      --adaptive-sampling --trace-budget-sps 150
+                                       # adaptive head rate: a feedback
+                                       # loop steers kept-spans/s to the
+                                       # budget through a 4x load step,
+                                       # pushing rate changes live over
+                                       # the rpc trace op
+  python -m ddp_practice_tpu.cli serve --procs 2 \\
+      --trace-tenant-rates '{"acme": 1.0, "free-tier": 0.01}'
+                                       # per-tenant head rates: tenants
+                                       # keep their own sampling floor;
+                                       # tail keeps (faults, failovers)
+                                       # stay tenant-blind
 """
 
 from __future__ import annotations

@@ -613,6 +613,8 @@ def fleet_bench(
     trace_sample: float = 1.0,
     trace_keep_slow_s: Optional[float] = None,
     otlp_out: Optional[str] = None,
+    otlp_endpoint: Optional[str] = None,
+    trace_tenant_rates: Optional[dict] = None,
 ) -> dict:
     """One Poisson trace through `procs` worker OS PROCESSES behind the
     RPC seam (serve/worker.py + serve/supervisor.py) AND through
@@ -705,16 +707,26 @@ def fleet_bench(
             "eos_id": eos_id,
         },
         max_queue=max_queue,
-        trace=trace_out is not None or otlp_out is not None,
+        trace=(trace_out is not None or otlp_out is not None
+               or otlp_endpoint is not None),
         trace_sample=trace_sample,
         trace_keep_slow_s=trace_keep_slow_s,
+        trace_tenant_rates=trace_tenant_rates,
     )
-    if tracer is None and otlp_out:
+    if tracer is None and (otlp_out or otlp_endpoint):
         tracer = _make_tracer()
     fleet_router, sup, handles = make_fleet_router(
         spec, procs, sup_config=SupervisorConfig(restart_base_s=0.25),
         tracer=tracer,
     )
+    pusher = None
+    if otlp_endpoint is not None and tracer is not None:
+        # live egress for the whole run: kept spans drain to the
+        # collector as they land, not at exit — the operator posture
+        # the ISSUE-12 plane exists for
+        from ddp_practice_tpu.utils.telemetry import OtlpPusher
+
+        pusher = OtlpPusher(otlp_endpoint, tracer)
     server = None
     rep_rows = {"in_process": [], "fleet": []}
     ratios_p50 = []
@@ -845,8 +857,21 @@ def fleet_bench(
             meta = tracer.sampling_meta()
             if meta is not None:
                 report["sampling"] = meta
+        if pusher is not None:
+            pusher.close()  # final drain before the counters are read
+            report["otlp_push"] = {
+                "endpoint": otlp_endpoint,
+                "batches_sent": pusher.batches_sent,
+                "spans_sent": pusher.spans_sent,
+                "batches_dropped": pusher.batches_dropped,
+                "post_failures": pusher.post_failures,
+                "dead": pusher.dead,
+            }
+            pusher = None
         return report
     finally:
+        if pusher is not None:
+            pusher.close()
         if server is not None:
             server.close()
         sup.stop()
@@ -1207,6 +1232,341 @@ def fleet_trace_sampling_bench(
             tracer.save_otlp(otlp_out)
             report["otlp_out"] = otlp_out
         return report
+    finally:
+        sup.stop()
+
+
+def fleet_otlp_push_bench(
+    *,
+    n_requests: int = 200,
+    rate_hz: float = 100.0,
+    procs: int = 2,
+    max_slots: int = 8,
+    vocab: int = 64,
+    hidden: int = 128,
+    depth: int = 2,
+    heads: int = 4,
+    mlp: int = 256,
+    max_len: int = 128,
+    prompt_buckets=(8, 16),
+    prompt_len_range=(2, 16),
+    max_new_range=(2, 32),
+    decode_burst: int = 8,
+    eos_id: Optional[int] = 46,
+    seed: int = 0,
+    pairs: int = 6,
+    sample: float = 1.0,
+    otlp_endpoint: Optional[str] = None,
+    capture_dir: Optional[str] = None,
+) -> dict:
+    """Live OTLP/HTTP push vs file-only export at 100 rps: two arms
+    against ONE warm worker fleet — ``file`` (tracer on, spans kept in
+    memory for an exit-time save, the PR-11 posture) and ``push`` (the
+    same tracer drained live by a background OtlpPusher POSTing real
+    batches over real HTTP), rotated in order-balanced rounds.
+
+    The acceptance number is ``mean_ratio``: push-arm / file-arm mean
+    latency (ratio of per-arm median means; gate <= 1.02x) — what
+    LIVE egress costs the serve loop against batching to disk. The
+    tracer runs at FULL head rate by default so the pusher is fed the
+    worst-case span flow, not a 1% trickle.
+
+    With no ``otlp_endpoint`` the bench stands up its own
+    StubOtlpCollector and additionally audits COMPLETENESS: every span
+    the pusher claims to have sent must be present in the collector's
+    batch-id-deduped capture (``spans_delivered`` == ``spans_pushed``).
+    Each push round gets a fresh pusher whose final flush happens in
+    ``close()`` OUTSIDE the timed window — the timed cost is the
+    concurrent drain/POST traffic, which is the thing the gate is
+    about."""
+    from ddp_practice_tpu.serve.supervisor import (
+        SupervisorConfig,
+        make_fleet_router,
+    )
+    from ddp_practice_tpu.serve.worker import WorkerSpec
+    from ddp_practice_tpu.utils.telemetry import (
+        OtlpPusher,
+        StubOtlpCollector,
+    )
+
+    model_kw = {
+        "vocab_size": vocab, "max_len": max_len, "hidden_dim": hidden,
+        "depth": depth, "num_heads": heads, "mlp_dim": mlp,
+        "pos_emb": "rope",
+    }
+    trace = build_trace(
+        n_requests=n_requests, rate_hz=rate_hz, vocab=vocab,
+        prompt_len_range=prompt_len_range, max_new_range=max_new_range,
+        seed=seed,
+    )
+    tracer = _make_tracer()
+    spec = WorkerSpec(
+        model=model_kw,
+        engine={
+            "max_slots": max_slots, "max_len": max_len,
+            "prompt_buckets": list(prompt_buckets),
+            "temperature": 0.0, "decode_burst": decode_burst,
+            "eos_id": eos_id,
+        },
+        max_queue=len(trace) * (2 * pairs + 2),
+        trace=True,
+        trace_sample=sample,
+    )
+    router, sup, handles = make_fleet_router(
+        spec, procs, sup_config=SupervisorConfig(restart_base_s=0.25),
+        tracer=tracer,
+    )
+    collector = None
+    endpoint = otlp_endpoint
+    if endpoint is None:
+        collector = StubOtlpCollector(capture_dir=capture_dir)
+        endpoint = collector.endpoint
+
+    def drain_frames() -> None:
+        deadline = time.monotonic() + 0.5
+        while time.monotonic() < deadline:
+            router.step()
+            _fleet_wait(router, 0.01)
+
+    arms = ("file", "push")
+    rows = {a: [] for a in arms}
+    push_stats = {"batches_sent": 0, "spans_sent": 0,
+                  "post_failures": 0, "batches_dropped": 0}
+    try:
+        # untimed shakeout (streams, offsets, warm boot amortized)
+        _replay_through_router(router, trace, rid_offset=90_000_000,
+                               fleet=True)
+        drain_frames()
+        tracer.clear()
+        for i in range(pairs):
+            order = arms if i % 2 == 0 else arms[::-1]
+            for arm in order:
+                rid_offset = (2 * i + order.index(arm)) * 1_000_000
+                if arm == "push":
+                    pusher = OtlpPusher(endpoint, tracer,
+                                        interval_s=0.25)
+                    try:
+                        rows[arm].append(_replay_through_router(
+                            router, trace, rid_offset=rid_offset,
+                            fleet=True))
+                        drain_frames()
+                    finally:
+                        pusher.close()  # final flush, untimed
+                    for k in push_stats:
+                        push_stats[k] += getattr(pusher, k)
+                else:
+                    rows[arm].append(_replay_through_router(
+                        router, trace, rid_offset=rid_offset,
+                        fleet=True))
+                    drain_frames()
+                tracer.clear()
+
+        def med(xs):
+            s = sorted(xs)
+            n = len(s)
+            return (s[n // 2] if n % 2
+                    else 0.5 * (s[n // 2 - 1] + s[n // 2]))
+
+        mean_ratios = [
+            p["latency_s"]["mean"] / f["latency_s"]["mean"]
+            for p, f in zip(rows["push"], rows["file"])
+        ]
+        pooled_mean_ratio = (
+            med([r["latency_s"]["mean"] for r in rows["push"]])
+            / med([r["latency_s"]["mean"] for r in rows["file"]])
+        )
+        report = {
+            "trace": {
+                "n_requests": n_requests, "rate_hz": rate_hz,
+                "seed": seed,
+                "prompt_len_range": list(prompt_len_range),
+                "max_new_range": list(max_new_range),
+            },
+            "procs": procs,
+            "pairs": pairs,
+            "head_rate": sample,
+            "gate": "mean <= 1.02x vs file-only export",
+            "mean_ratio": pooled_mean_ratio,
+            "mean_ratio_per_round": mean_ratios,
+            "push": {
+                **push_stats,
+                "spans_pushed": push_stats["spans_sent"],
+            },
+            "file": {"latency_s": rows["file"][-1]["latency_s"],
+                     "lost": sum(r["lost"] for r in rows["file"])},
+            "push_arm": {"latency_s": rows["push"][-1]["latency_s"],
+                         "lost": sum(r["lost"] for r in rows["push"])},
+        }
+        if collector is not None:
+            report["push"]["spans_delivered"] = collector.spans
+            report["push"]["batches_received"] = len(collector.seen)
+            report["push"]["duplicate_batches"] = collector.duplicates
+            report["push"]["complete"] = bool(
+                collector.spans == push_stats["spans_sent"])
+            if capture_dir:
+                report["push"]["capture_dir"] = capture_dir
+        return report
+    finally:
+        sup.stop()
+        if collector is not None:
+            collector.close()
+
+
+def fleet_adaptive_sampling_bench(
+    *,
+    rate_hz: float = 100.0,
+    step_factor: float = 4.0,
+    budget_sps: float = 150.0,
+    chunk_s: float = 1.0,
+    chunks_base: int = 2,
+    chunks_step: int = 5,
+    chunks_measure: int = 3,
+    procs: int = 2,
+    max_slots: int = 8,
+    vocab: int = 64,
+    hidden: int = 128,
+    depth: int = 2,
+    heads: int = 4,
+    mlp: int = 256,
+    max_len: int = 128,
+    prompt_buckets=(8, 16),
+    prompt_len_range=(2, 16),
+    max_new_range=(2, 32),
+    decode_burst: int = 8,
+    eos_id: Optional[int] = 46,
+    seed: int = 0,
+) -> dict:
+    """Adaptive head-rate control under a real load step: one warm
+    fleet driven in ~`chunk_s` arrival chunks at `rate_hz`, then
+    stepped to `rate_hz * step_factor` (default 4x), with an
+    AdaptiveHeadRateController stepping between chunks and pushing
+    every rate change to the workers via the live rpc ``trace`` op.
+
+    The acceptance pair, measured over the FINAL `chunks_measure`
+    chunks (after the controller has had the step phase to converge):
+
+    - ``kept_sps`` vs ``budget_sps`` as ``budget_err`` (relative), and
+    - ``within_budget``: 1.0 iff the error is <= 0.20 — the ±20%
+      contract, reported as a 0/1 so check_bench can gate it
+      absolutely (baseline 1, tol 0).
+
+    Both the controller's observations and the final measurement use
+    the same wall-clock basis (real elapsed time including inter-chunk
+    drains), so the loop is judged against exactly the flow it could
+    see. ``rate_changes``/``rate_log`` keep the correction history
+    visible — a converged run makes 2-4 changes, not a change per
+    evaluation."""
+    from ddp_practice_tpu.serve.supervisor import (
+        SupervisorConfig,
+        make_fleet_router,
+    )
+    from ddp_practice_tpu.serve.worker import WorkerSpec
+    from ddp_practice_tpu.utils.trace import AdaptiveHeadRateController
+
+    model_kw = {
+        "vocab_size": vocab, "max_len": max_len, "hidden_dim": hidden,
+        "depth": depth, "num_heads": heads, "mlp_dim": mlp,
+        "pos_emb": "rope",
+    }
+
+    def chunk(rate: float, k: int):
+        return build_trace(
+            n_requests=max(8, int(rate * chunk_s)), rate_hz=rate,
+            vocab=vocab, prompt_len_range=prompt_len_range,
+            max_new_range=max_new_range, seed=seed + 7 * k + 1,
+        )
+
+    step_rate = rate_hz * step_factor
+    total_chunks = chunks_base + chunks_step + chunks_measure
+    tracer = _make_tracer()
+    spec = WorkerSpec(
+        model=model_kw,
+        engine={
+            "max_slots": max_slots, "max_len": max_len,
+            "prompt_buckets": list(prompt_buckets),
+            "temperature": 0.0, "decode_burst": decode_burst,
+            "eos_id": eos_id,
+        },
+        max_queue=int(step_rate * chunk_s) * (total_chunks + 2),
+        trace=True,
+        trace_sample=1.0,
+    )
+    router, sup, handles = make_fleet_router(
+        spec, procs, sup_config=SupervisorConfig(restart_base_s=0.25),
+        tracer=tracer,
+    )
+    if tracer.sampler is None:  # rate 1.0 attaches no sampler by itself
+        from ddp_practice_tpu.utils.trace import TraceSampler
+
+        tracer.set_sampler(TraceSampler(1.0))
+
+    def push_rate(rate: float) -> None:
+        for h in handles:
+            h.set_trace(True, sample=rate)
+
+    ctl = AdaptiveHeadRateController(
+        tracer, budget_sps, interval_s=0.5, hold_s=1.0,
+        apply_fn=push_rate,
+    )
+
+    def drain_frames() -> None:
+        deadline = time.monotonic() + 0.3
+        while time.monotonic() < deadline:
+            router.step()
+            _fleet_wait(router, 0.01)
+
+    lost = 0
+
+    def run_chunk(rate: float, k: int) -> None:
+        nonlocal lost
+        r = _replay_through_router(router, chunk(rate, k),
+                                   rid_offset=(k + 1) * 1_000_000,
+                                   fleet=True)
+        lost += r["lost"]
+        drain_frames()
+        ctl.step()
+
+    try:
+        # untimed shakeout, then the controller's measurement baseline
+        _replay_through_router(router, chunk(rate_hz, 0),
+                               rid_offset=90_000_000, fleet=True)
+        drain_frames()
+        ctl.step()
+        k = 1
+        for _ in range(chunks_base):
+            run_chunk(rate_hz, k)
+            k += 1
+        for _ in range(chunks_step):
+            run_chunk(step_rate, k)
+            k += 1
+        # final window: same wall-clock basis the controller steers by
+        k0 = tracer.spans_sampled + tracer.spans_kept
+        t0 = time.monotonic()
+        for _ in range(chunks_measure):
+            run_chunk(step_rate, k)
+            k += 1
+        kept_sps = ((tracer.spans_sampled + tracer.spans_kept) - k0) \
+            / (time.monotonic() - t0)
+        budget_err = abs(kept_sps - budget_sps) / budget_sps
+        return {
+            "rate_hz": rate_hz,
+            "step_rate_hz": step_rate,
+            "step_factor": step_factor,
+            "budget_sps": budget_sps,
+            "chunk_s": chunk_s,
+            "chunks": {"base": chunks_base, "step": chunks_step,
+                       "measure": chunks_measure},
+            "procs": procs,
+            "gate": "kept_sps within ±20% of budget after the step",
+            "kept_sps": kept_sps,
+            "budget_err": budget_err,
+            "within_budget": 1.0 if budget_err <= 0.20 else 0.0,
+            "rate_final": ctl.rate,
+            "rate_changes": ctl.changes,
+            "rate_log": ctl.rate_log,
+            "lost": lost,
+            "sampling": tracer.sampling_meta(),
+        }
     finally:
         sup.stop()
 
@@ -2120,6 +2480,47 @@ def build_parser() -> argparse.ArgumentParser:
                         "(ExportTraceServiceRequest shape — POST-able "
                         "to any OTLP/HTTP collector's /v1/traces); "
                         "validate with tools/check_otlp.py")
+    p.add_argument("--otlp-endpoint", "--otlp_endpoint",
+                   dest="otlp_endpoint", default=None, metavar="URL",
+                   help="push kept spans LIVE to this OTLP/HTTP "
+                        "collector (.../v1/traces) from a background "
+                        "batcher (utils/telemetry.py OtlpPusher: "
+                        "bounded queue, retry backoff, dead-endpoint "
+                        "breaker; at-least-once with ddp.push.batch_id "
+                        "for collector-side dedup). With "
+                        "--otlp-push-overhead and no endpoint, a stub "
+                        "collector is stood up automatically")
+    p.add_argument("--otlp-push-overhead", dest="otlp_push_overhead",
+                   action="store_true",
+                   help="with --procs: A/B the LIVE push pipeline "
+                        "against file-only export over order-balanced "
+                        "rounds on ONE warm fleet (gate: mean latency "
+                        "<= 1.02x) and audit capture completeness "
+                        "against the batch-id-deduped collector")
+    p.add_argument("--adaptive-sampling", dest="adaptive_sampling",
+                   action="store_true",
+                   help="with --procs: drive a 4x arrival step through "
+                        "one warm fleet with the adaptive head-rate "
+                        "controller active (utils/trace.py "
+                        "AdaptiveHeadRateController) and report "
+                        "kept-spans/s vs --trace-budget-sps (gate: "
+                        "within ±20%% after the step, no thrash)")
+    p.add_argument("--trace-budget-sps", dest="trace_budget_sps",
+                   type=float, default=None, metavar="SPS",
+                   help="kept-spans-per-second budget the adaptive "
+                        "controller steers the fleet head rate toward "
+                        "(multiplicative correction, deadband + hold "
+                        "window; every change stamped as a trace_rate "
+                        "instant and pushed live over the rpc trace op)")
+    p.add_argument("--trace-tenant-rates", "--trace_tenant_rates",
+                   dest="trace_tenant_rates", default=None,
+                   metavar="JSON",
+                   help="per-tenant head-rate overrides as a JSON "
+                        'object, e.g. \'{"acme": 1.0, "free-tier": '
+                        "0.01}' — tenants not listed use the fleet "
+                        "rate; tail keep-rules stay tenant-blind, so "
+                        "fault-affected requests are kept for EVERY "
+                        "tenant")
     p.add_argument("--max-len", dest="max_len", type=int, default=None,
                    help="bench: slot-pool span / paged pool sizing "
                         "(default 128); the slot engine's decode cost "
@@ -2224,6 +2625,62 @@ def main(argv=None) -> int:
                       f"{pf['kv_bytes_per_token']:.0f} vs f32 "
                       f"{report['kv_bytes_per_token_f32']:.0f} "
                       f"({report['kv_bytes_ratio']:.2f}x)")
+        return 0
+    if args.procs and args.otlp_push_overhead:
+        report = fleet_otlp_push_bench(
+            n_requests=args.requests, rate_hz=args.rate,
+            max_slots=args.max_slots, procs=args.procs,
+            seed=args.seed, otlp_endpoint=args.otlp_endpoint,
+            **({"sample": args.trace_sample}
+               if args.trace_sample is not None else {}),
+            **({"decode_burst": args.decode_burst}
+               if args.decode_burst is not None else {}),
+        )
+        if args.json:
+            print(json.dumps(report))
+        else:
+            pu = report["push"]
+            print(f"[fleet_otlp_push] {args.requests} requests @ "
+                  f"{args.rate}/s, {args.procs} workers, head rate "
+                  f"{report['head_rate']}, {report['pairs']} "
+                  f"order-balanced rounds")
+            print(f"  push vs file-only: latency mean "
+                  f"{report['mean_ratio']:.3f}x  ({report['gate']})")
+            print(f"  pushed {pu['spans_sent']} spans in "
+                  f"{pu['batches_sent']} batches "
+                  f"(dropped {pu['batches_dropped']}, post failures "
+                  f"{pu['post_failures']})")
+            if "spans_delivered" in pu:
+                print(f"  collector: {pu['spans_delivered']} spans "
+                      f"after dedup of {pu['duplicate_batches']} "
+                      f"duplicate batch(es) — complete="
+                      f"{pu['complete']}")
+        return 0
+    if args.procs and args.adaptive_sampling:
+        report = fleet_adaptive_sampling_bench(
+            rate_hz=args.rate, procs=args.procs,
+            max_slots=args.max_slots, seed=args.seed,
+            **({"budget_sps": args.trace_budget_sps}
+               if args.trace_budget_sps is not None else {}),
+            **({"decode_burst": args.decode_burst}
+               if args.decode_burst is not None else {}),
+        )
+        if args.json:
+            print(json.dumps(report))
+        else:
+            print(f"[fleet_adaptive_sampling] {args.rate}/s -> "
+                  f"{report['step_rate_hz']}/s step, {args.procs} "
+                  f"workers, budget {report['budget_sps']} kept "
+                  f"spans/s")
+            print(f"  kept {report['kept_sps']:.1f} spans/s in the "
+                  f"final window — err {report['budget_err']:.2f} "
+                  f"({report['gate']}; within_budget="
+                  f"{report['within_budget']:.0f})")
+            print(f"  head rate {report['rate_final']:.4f} after "
+                  f"{report['rate_changes']} change(s): "
+                  + ", ".join(
+                      f"{c['prev']:.3f}->{c['rate']:.3f}"
+                      for c in report["rate_log"]))
         return 0
     if args.procs and args.trace_sampling:
         report = fleet_trace_sampling_bench(
@@ -2351,7 +2808,11 @@ def main(argv=None) -> int:
             metrics_port=args.metrics_port,
             trace_out=args.trace_out,
             otlp_out=args.otlp_out,
+            otlp_endpoint=args.otlp_endpoint,
             trace_keep_slow_s=args.trace_keep_slow_s,
+            trace_tenant_rates=(
+                json.loads(args.trace_tenant_rates)
+                if args.trace_tenant_rates else None),
             **({"trace_sample": args.trace_sample}
                if args.trace_sample is not None else {}),
             **({"decode_burst": args.decode_burst}

@@ -97,6 +97,18 @@ class ServeMetrics:
     def on_complete(self, completion, scheduler) -> None:
         self.registry.counter(f"serve_requests_{completion.status}").inc()
         self.tokens_total.inc(len(completion.tokens))
+        tenant = getattr(completion, "tenant", None)
+        if tenant is not None:
+            # per-tenant attribution, behind the labelled() cardinality
+            # guard: past the per-label limit an adversarial flood of
+            # tenant ids lands in tenant="other" instead of growing the
+            # registry without bound
+            self.registry.counter(labelled(
+                "serve_tenant_requests_total",
+                tenant=tenant, status=completion.status)).inc()
+            self.registry.counter(labelled(
+                "serve_tenant_tokens_total", tenant=tenant)).inc(
+                    len(completion.tokens))
         # exemplar = the completion's trace_id: the latency histograms
         # in /metrics carry a per-bucket pointer back into the trace
         # timeline (render_text emits OpenMetrics `# {trace_id=...}`).
@@ -177,6 +189,21 @@ class RouterMetrics:
             self.ttft.observe(completion.ttft, exemplar=ex)
         if completion.tpot is not None:
             self.tpot.observe(completion.tpot, exemplar=ex)
+        tenant = getattr(completion, "tenant", None)
+        if tenant is not None:
+            # fleet-level per-tenant attribution: request/token counters
+            # and a TTFT histogram per tenant, all behind the labelled()
+            # cardinality guard (overflow tenants fold into "other")
+            self.registry.counter(labelled(
+                "serve_router_tenant_requests_total",
+                tenant=tenant, status=completion.status)).inc()
+            self.registry.counter(labelled(
+                "serve_router_tenant_tokens_total", tenant=tenant)).inc(
+                    len(completion.tokens))
+            if completion.ttft is not None:
+                self.registry.histogram(labelled(
+                    "serve_router_tenant_ttft_s", tenant=tenant)).observe(
+                        completion.ttft, exemplar=ex)
 
     def report(self) -> dict:
         return self.registry.snapshot()

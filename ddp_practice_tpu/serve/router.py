@@ -419,7 +419,8 @@ class Router:
             # and propagated to every sub-request (and across the RPC
             # seam) — workers honor it instead of re-deciding
             req.sampled = self.tracer.begin_trace(req.trace_id,
-                                                  req.sampled)
+                                                  req.sampled,
+                                                  tenant=req.tenant)
         cfg = self.config
         if req.deadline is None and cfg.request_timeout_s is not None:
             req.deadline = req.arrival + cfg.request_timeout_s
@@ -603,6 +604,7 @@ class Router:
                 # the retry/failover markers)
                 sampled=(True if (tr.retries or tr.failovers)
                          else req.sampled),
+                tenant=req.tenant,
             )
             # stamp the dispatch time BEFORE the submit hop: a remote
             # worker can queue and even start prefill while the RPC is
@@ -912,7 +914,7 @@ class Router:
         c = Completion(
             rid=req.rid, tokens=tokens, status=status,
             arrival=req.arrival, finish=now, ttft=ttft, tpot=tpot,
-            flight=flight, trace_id=req.trace_id,
+            flight=flight, trace_id=req.trace_id, tenant=req.tenant,
         )
         if self.tracer is not None:
             # tail verdict on the ROUTER's recorder (the fleet
@@ -990,6 +992,7 @@ def make_router(
     telemetry=None,
     trace_sample: float = 1.0,
     trace_keep_slow_s: Optional[float] = None,
+    trace_tenant_rates: Optional[dict] = None,
 ) -> Router:
     """Build a fleet of identical replicas (replicated params — the
     sharded-params variant is ROADMAP follow-up) on one shared clock,
@@ -998,15 +1001,17 @@ def make_router(
     (utils/trace.py TraceRecorder) threads one recorder through the
     router, every scheduler, and every engine — pid=replica, labelled
     lanes — for `--trace-out` Chrome-trace export. `trace_sample` /
-    `trace_keep_slow_s` attach the head-sampling + tail-keep policy to
-    that recorder (default: record everything)."""
+    `trace_keep_slow_s` / `trace_tenant_rates` attach the head-sampling
+    + tail-keep policy to that recorder (default: record everything)."""
     if n_replicas < 1:
         raise ValueError("n_replicas must be >= 1")
     clock = clock or MonotonicClock()
     if tracer is not None and (trace_sample < 1.0
-                               or trace_keep_slow_s is not None):
+                               or trace_keep_slow_s is not None
+                               or trace_tenant_rates):
         tracer.set_sampler(
-            TraceSampler(trace_sample, keep_slow_s=trace_keep_slow_s),
+            TraceSampler(trace_sample, keep_slow_s=trace_keep_slow_s,
+                         tenant_rates=trace_tenant_rates),
             registry=registry,
         )
     schedulers = []

@@ -10,9 +10,12 @@ JSON over a localhost TCP socket, stdlib only.
 Framing: every message is a 4-byte big-endian length followed by that
 many bytes of UTF-8 JSON. One request frame in, one response frame out,
 strictly alternating per connection. JSON because every payload already
-IS json-shaped (requests carry rid/prompt/deadline/priority/trace_id,
-completions carry tokens/status/flight records — the same dicts the
-telemetry stream writes), and because a human can tcpdump it.
+IS json-shaped (requests carry rid/prompt/deadline/priority/trace_id/
+tenant, completions carry tokens/status/tenant/flight records — the
+same dicts the telemetry stream writes), and because a human can
+tcpdump it. The live ``trace`` op carries the sampling levers the same
+way: ``sample`` (fleet head rate) and ``tenant_rates`` (per-tenant
+overrides), applied by the worker without a restart.
 
 Failure semantics (the part that matters for a chaos-tested fleet):
 

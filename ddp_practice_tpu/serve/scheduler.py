@@ -115,6 +115,12 @@ class Request:
     # submit() from the tracer's sampler; stays None when sampling is
     # off (everything records, the pre-sampling behavior).
     sampled: Optional[bool] = None
+    # tenant id — rides like trace_id across every seam (router, RPC,
+    # worker, completion, flight record). It is the per-tenant sampling
+    # key (TraceSampler.tenant_rates overrides) and the tenant= metric
+    # label (behind the labelled() cardinality guard). None = untenanted
+    # (single-tenant deployments pay nothing).
+    tenant: Optional[str] = None
     # when submit() actually ran (clock domain; stamped by submit) —
     # flight records measure in-queue wait from here. `arrival` may
     # predate it (trace replays poll late; failover re-admissions keep
@@ -189,6 +195,9 @@ class Completion:
     # cite it — an exemplar pointing at a suppressed trace is a dead
     # link. True whenever sampling is off.
     trace_sampled: bool = True
+    # the request's tenant, carried through so per-tenant metrics and
+    # telemetry flight lines can attribute the completion
+    tenant: Optional[str] = None
 
 
 def _attempt_phases(req: Request, now: float,
@@ -288,7 +297,8 @@ class Scheduler:
             # the deterministic hash otherwise. Unsampled requests'
             # spans stage until the tail verdict in _finish.
             req.sampled = self.tracer.begin_trace(req.trace_id,
-                                                  req.sampled)
+                                                  req.sampled,
+                                                  tenant=req.tenant)
         req.submitted = self.clock.now()
         if req.max_new_tokens < 1:
             # needed=0 would slip past every headroom guard and a
@@ -375,7 +385,7 @@ class Scheduler:
         c = Completion(
             rid=req.rid, tokens=tokens, status=status,
             arrival=req.arrival, finish=now, ttft=ttft, tpot=tpot,
-            flight=flight, trace_id=req.trace_id,
+            flight=flight, trace_id=req.trace_id, tenant=req.tenant,
         )
         tr = self.tracer
         if tr is not None and tr.enabled:
@@ -431,7 +441,7 @@ class Scheduler:
             rid=orig.rid, prompt=prompt, max_new_tokens=max_new,
             deadline=orig.deadline, seed=orig.seed, arrival=orig.arrival,
             priority=orig.priority, trace_id=orig.trace_id,
-            sampled=orig.sampled,
+            sampled=orig.sampled, tenant=orig.tenant,
         )
         creq.submitted = self.clock.now()
         return creq

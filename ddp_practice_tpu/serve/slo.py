@@ -543,14 +543,9 @@ def _deliver_real(spec: AlertSinkSpec, event: dict) -> bool:
             f.write(line + "\n")
         return True
     if spec.kind == "webhook":
-        import urllib.request
+        from ddp_practice_tpu.utils.http_post import post_json
 
-        req = urllib.request.Request(
-            spec.target, data=line.encode("utf-8"),
-            headers={"Content-Type": "application/json"},
-        )
-        with urllib.request.urlopen(req, timeout=spec.timeout_s) as r:
-            return r.status < 400
+        return post_json(spec.target, line, timeout_s=spec.timeout_s)
     if spec.kind == "command":
         import shlex
         import subprocess

@@ -583,6 +583,7 @@ class RemoteReplicaHandle:
             "deadline": req.deadline, "seed": req.seed,
             "arrival": req.arrival, "priority": req.priority,
             "trace_id": req.trace_id, "sampled": req.sampled,
+            "tenant": req.tenant,
         }
 
     @staticmethod
@@ -593,6 +594,7 @@ class RemoteReplicaHandle:
             ttft=d.get("ttft"), tpot=d.get("tpot"),
             flight=d.get("flight"), trace_id=d.get("trace_id"),
             trace_sampled=d.get("sampled", True),
+            tenant=d.get("tenant"),
         )
 
     # ---------------- the seam: submit down, completions watermark up
@@ -845,17 +847,20 @@ class RemoteReplicaHandle:
         return self.trace_collector.skew_bound(self.id)
 
     def set_trace(self, enabled: bool,
-                  sample: Optional[float] = None) -> bool:
+                  sample: Optional[float] = None,
+                  tenant_rates: Optional[dict] = None) -> bool:
         """Toggle the worker's span recording (the overhead bench's
         on/off lever); `sample` adjusts the worker's head rate in place
-        (the sampling bench's per-arm knob). False when the worker has
-        no tracer or the call failed (a disabled plane, not an
-        error)."""
+        (the sampling bench's per-arm knob, the adaptive controller's
+        fleet push), `tenant_rates` replaces its per-tenant override
+        table. False when the worker has no tracer or the call failed
+        (a disabled plane, not an error)."""
         c = self._client()
         if c is None:
             return False
         try:
             r = c.call("trace", enabled=enabled, sample=sample,
+                       tenant_rates=tenant_rates,
                        timeout_s=self.poll_timeout_s)
         except (RpcError, RpcRemoteError):
             return False
@@ -1053,16 +1058,19 @@ def make_fleet_router(
             collector.label_worker(
                 i, specs[i].engine.get("max_slots", 4))
         if (base_spec.trace_sample < 1.0
-                or base_spec.trace_keep_slow_s is not None):
+                or base_spec.trace_keep_slow_s is not None
+                or base_spec.trace_tenant_rates):
             # the fleet-side half of the coherent-sampling contract:
             # the router stamps one head decision per trace_id with the
-            # SAME hash the workers use, so both ends of the RPC seam
-            # agree without ever exchanging a verdict
+            # SAME hash (and the same per-tenant override table) the
+            # workers use, so both ends of the RPC seam agree without
+            # ever exchanging a verdict
             from ddp_practice_tpu.utils.trace import TraceSampler
 
             tracer.set_sampler(
                 TraceSampler(base_spec.trace_sample,
-                             keep_slow_s=base_spec.trace_keep_slow_s),
+                             keep_slow_s=base_spec.trace_keep_slow_s,
+                             tenant_rates=base_spec.trace_tenant_rates),
                 registry=registry,
             )
     supervisor = Supervisor(specs, sup_config, spawn_fn=spawn_fn,

@@ -81,6 +81,11 @@ class WorkerSpec:
     # tail keep-rule: head-unsampled requests slower than this are
     # promoted to kept at completion (None = no latency rule)
     trace_keep_slow_s: Optional[float] = None
+    # per-tenant head-rate overrides (tenant id -> rate). Same Dapper
+    # coherence as trace_sample: the router decides per trace_id and
+    # the decision rides the wire, but a direct submit consults the
+    # same table and agrees.
+    trace_tenant_rates: Optional[dict] = None
     # token streaming: the scheduler emits per-burst TokenChunks and
     # the worker ships them inside its `pub` push frames (atomically
     # with the inflight salvage point — a dropped frame loses both
@@ -243,14 +248,16 @@ class WorkerServer:
                 max_events=spec.trace_buffer, sink=self._trace_buf.put,
             )
             if (spec.trace_sample < 1.0
-                    or spec.trace_keep_slow_s is not None):
+                    or spec.trace_keep_slow_s is not None
+                    or spec.trace_tenant_rates):
                 # upstream suppression is THE point: unsampled requests
                 # never enter this buffer or the push stream — they wait
                 # in the recorder's per-request staging for a tail
                 # verdict, and only kept spans ride the wire
                 self._tracer.set_sampler(
                     TraceSampler(spec.trace_sample,
-                                 keep_slow_s=spec.trace_keep_slow_s),
+                                 keep_slow_s=spec.trace_keep_slow_s,
+                                 tenant_rates=spec.trace_tenant_rates),
                     registry=self.registry,
                 )
             label_replica(self._tracer, spec.replica,
@@ -344,6 +351,7 @@ class WorkerServer:
                 # coherence); absent → the scheduler re-derives it from
                 # the same deterministic hash and agrees anyway
                 sampled=r.get("sampled"),
+                tenant=r.get("tenant"),
             ))
             self._seen_rids[rid] = True
             # the dedup window only needs to outlive a transport retry
@@ -365,6 +373,7 @@ class WorkerServer:
             # the worker-side keep verdict, so the router's exemplar
             # gating sees whether this attempt's spans are in the stream
             "sampled": getattr(c, "trace_sampled", True),
+            "tenant": getattr(c, "tenant", None),
         }
 
     def _publish(self) -> None:
@@ -470,33 +479,45 @@ class WorkerServer:
         same warm fleet — `enabled=false` also clears anything pending,
         so a later re-enable starts a clean stream. An optional
         ``sample`` adjusts the head rate in place (the sampling bench
-        compares 1% / full / off against ONE warm fleet)."""
+        compares 1% / full / off against ONE warm fleet; the adaptive
+        controller steers it live), and an optional ``tenant_rates``
+        dict replaces the per-tenant override table the same way."""
         enabled = bool(req.get("enabled", True))
         sample = req.get("sample")
+        tenant_rates = req.get("tenant_rates")
         if self._tracer is None:
             return {"supported": False, "enabled": False}
         with self._lock:
-            if sample is not None:
+            if sample is not None or tenant_rates is not None:
                 if self._tracer.sampler is None:
                     from ddp_practice_tpu.utils.trace import TraceSampler
 
                     self._tracer.set_sampler(
                         TraceSampler(
-                            float(sample),
-                            keep_slow_s=self.spec.trace_keep_slow_s),
+                            float(sample) if sample is not None else 1.0,
+                            keep_slow_s=self.spec.trace_keep_slow_s,
+                            tenant_rates=tenant_rates),
                         registry=self.registry,
                     )
                 else:
-                    self._tracer.sampler.rate = float(sample)
+                    if sample is not None:
+                        self._tracer.sampler.rate = float(sample)
+                    if tenant_rates is not None:
+                        self._tracer.sampler.tenant_rates = {
+                            str(k): float(v)
+                            for k, v in tenant_rates.items()
+                        } or None
             if enabled:
                 self._tracer.enable()
             else:
                 self._tracer.disable()
                 self._tracer.clear()
                 self._trace_buf.clear()
+        sampler = self._tracer.sampler
         return {"supported": True, "enabled": enabled,
-                "sample": (None if self._tracer.sampler is None
-                           else self._tracer.sampler.rate)}
+                "sample": None if sampler is None else sampler.rate,
+                "tenant_rates": (None if sampler is None
+                                 else sampler.tenant_rates)}
 
     def _op_poll(self, req: dict) -> dict:
         """The heartbeat + completions-watermark read. `watermark` is

@@ -198,6 +198,9 @@ class TelemetryExporter:
             "tokens": len(completion.tokens),
             "trace_id": getattr(completion, "trace_id", None),
         }
+        tenant = getattr(completion, "tenant", None)
+        if tenant is not None:
+            ev["tenant"] = tenant
         if slo_exempt:
             ev["slo_exempt"] = True
         if completion.flight is not None:
@@ -428,6 +431,464 @@ class TelemetryServer:
         self._thread = None
 
     def __enter__(self) -> "TelemetryServer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+# ------------------------------------------------------------ OTLP push
+class OtlpPusher:
+    """Live OTLP/HTTP egress: drain kept spans into batched
+    ``ExportTraceServiceRequest`` payloads and POST them to a collector
+    at `endpoint` (the /v1/traces URL) — Monarch-style push instead of
+    the exit-time file `save_otlp` writes.
+
+    Contracts, all inherited from this repo's existing planes:
+
+    - **Never stalls serving.** A background daemon thread collects
+      (TraceRecorder.drain_otlp) and delivers on its own cadence; the
+      pending queue is BOUNDED (`max_pending` batches) and overflow
+      drops the OLDEST batch, counted in ``otlp_batches_dropped_total``
+      — dropped telemetry is a metric, stalled serving is an outage
+      (the TelemetryExporter rule).
+    - **At-least-once, deduped by batch id.** A batch stays pending
+      until a POST SUCCEEDS, so a delivered-but-response-lost attempt
+      is retried and arrives twice; every batch carries a stable
+      ``ddp.push.batch_id`` resource attribute so the collector keeps
+      the first copy and drops the rest. A SIGKILL therefore loses at
+      most what was drained but never acknowledged — and each span
+      lives in exactly ONE batch (the drain's seq watermark), so the
+      deduped capture never holds a duplicate spanId.
+    - **AlertSinks breaker.** Consecutive delivery failures back off on
+      the utils/backoff.py schedule; at `max_failures` the endpoint is
+      declared DEAD (``otlp_endpoint_dead`` gauge = 1) keeping only the
+      single NEWEST batch, and a half-open probe every
+      `probe_cooldown_s` retries it — success closes the breaker, a
+      failed probe re-arms the FIXED cooldown (never exponential: the
+      probe cadence is the detection latency for recovery).
+
+    `post` / `clock` are injectable (tests drive `pump(now)` with a
+    FakeClock and a fake transport); the default transport is the
+    shared utils/http_post.py helper the SLO webhook sink uses.
+    """
+
+    def __init__(self, endpoint: str, recorder, *,
+                 registry: Optional[MetricsRegistry] = None,
+                 clock=None, interval_s: float = 0.5,
+                 timeout_s: float = 3.0, max_pending: int = 64,
+                 max_failures: int = 5, base_s: float = 0.5,
+                 max_s: float = 30.0, probe_cooldown_s: float = 30.0,
+                 seed: int = 0, service_name: str = "ddp-serve",
+                 run_token: Optional[str] = None, post=None,
+                 start: bool = True) -> None:
+        if max_pending < 1:
+            raise ValueError("max_pending must be positive")
+        from ddp_practice_tpu.utils.http_post import post_json
+
+        self.endpoint = endpoint
+        self.recorder = recorder
+        self.interval_s = interval_s
+        self.timeout_s = timeout_s
+        self.max_pending = max_pending
+        self.max_failures = max_failures
+        self.base_s = base_s
+        self.max_s = max_s
+        self.probe_cooldown_s = probe_cooldown_s
+        self.seed = seed
+        self.service_name = service_name
+        self._post = post if post is not None else post_json
+        self._now = _resolve_clock(clock)
+        # batch identity: unique per pusher incarnation (a restarted
+        # process is a new producer) + a per-batch sequence — the dedup
+        # key the collector keeps first-writer-wins on
+        if run_token is None:
+            import os
+            import zlib as _zlib
+
+            run_token = "%08x" % (_zlib.crc32(
+                f"{os.getpid()}:{time.monotonic_ns()}".encode()))
+        self.run_token = run_token
+        self._batch_seq = 0
+        self._pending: deque = deque()
+        self._lock = threading.Lock()
+        self.failures = 0          # consecutive delivery failures
+        self.dead = False
+        self._next_at = 0.0        # earliest next delivery attempt
+        self.batches_sent = 0
+        self.spans_sent = 0
+        self.batches_dropped = 0
+        self.post_failures = 0
+        r = registry
+        self._c_sent = (r.counter("otlp_batches_sent_total")
+                        if r is not None else None)
+        self._c_spans = (r.counter("otlp_spans_sent_total")
+                         if r is not None else None)
+        self._c_dropped = (r.counter("otlp_batches_dropped_total")
+                           if r is not None else None)
+        self._c_failures = (r.counter("otlp_post_failures_total")
+                            if r is not None else None)
+        self._g_dead = (r.gauge("otlp_endpoint_dead")
+                        if r is not None else None)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        if start:
+            self.start()
+
+    # --------------------------------------------------------- produce
+    @staticmethod
+    def _span_count(export: dict) -> int:
+        return sum(len(ss.get("spans", ()))
+                   for rs in export.get("resourceSpans", ())
+                   for ss in rs.get("scopeSpans", ()))
+
+    def _drop_batch(self, batch: dict) -> None:
+        self.batches_dropped += 1
+        if self._c_dropped is not None:
+            self._c_dropped.inc()
+
+    def collect(self) -> int:
+        """Drain newly-kept spans into one pending batch; returns the
+        spans batched (0 when the recorder had nothing new)."""
+        export = self.recorder.drain_otlp(service_name=self.service_name)
+        if export is None:
+            return 0
+        with self._lock:
+            self._batch_seq += 1
+            bid = f"{self.run_token}-{self._batch_seq}"
+            res = export["resourceSpans"][0]["resource"]["attributes"]
+            res.append({"key": "ddp.push.batch_id",
+                        "value": {"stringValue": bid}})
+            res.append({"key": "ddp.push.seq",
+                        "value": {"intValue": str(self._batch_seq)}})
+            n = self._span_count(export)
+            batch = {"id": bid, "export": export, "spans": n}
+            if self.dead:
+                # dead endpoint holds exactly ONE newest batch (the
+                # half-open probe's payload) — the AlertSinks contract
+                while self._pending:
+                    self._drop_batch(self._pending.popleft())
+            elif len(self._pending) >= self.max_pending:
+                self._drop_batch(self._pending.popleft())
+            self._pending.append(batch)
+        return n
+
+    # --------------------------------------------------------- deliver
+    def _try_post(self, batch: dict) -> bool:
+        try:
+            return bool(self._post(self.endpoint, batch["export"],
+                                   timeout_s=self.timeout_s))
+        except Exception:
+            return False
+
+    def _book_sent(self, batch: dict) -> None:
+        self.batches_sent += 1
+        self.spans_sent += batch["spans"]
+        if self._c_sent is not None:
+            self._c_sent.inc()
+        if self._c_spans is not None:
+            self._c_spans.inc(batch["spans"])
+
+    def flush(self, now: Optional[float] = None) -> int:
+        """Deliver pending batches in order (oldest first); returns
+        spans delivered. Honors the backoff/breaker clock — a call
+        before `_next_at` is a no-op, not a hammer."""
+        from ddp_practice_tpu.utils.backoff import backoff_delay
+
+        if now is None:
+            now = self._now()
+        sent = 0
+        with self._lock:
+            if not self._pending or now < self._next_at:
+                return 0
+            if self.dead:
+                # half-open probe with the single kept batch
+                batch = self._pending[0]
+                if self._try_post(batch):
+                    self._pending.popleft()
+                    self._book_sent(batch)
+                    sent += batch["spans"]
+                    self.dead = False
+                    self.failures = 0
+                    self._next_at = now
+                    if self._g_dead is not None:
+                        self._g_dead.set(0)
+                else:
+                    # fixed cooldown, never exponential: probe cadence
+                    # IS the recovery-detection latency
+                    self._next_at = now + self.probe_cooldown_s
+                return sent
+            while self._pending:
+                batch = self._pending[0]
+                if self._try_post(batch):
+                    self._pending.popleft()
+                    self._book_sent(batch)
+                    sent += batch["spans"]
+                    self.failures = 0
+                    continue
+                self.failures += 1
+                self.post_failures += 1
+                if self._c_failures is not None:
+                    self._c_failures.inc()
+                if self.failures >= self.max_failures:
+                    self.dead = True
+                    if self._g_dead is not None:
+                        self._g_dead.set(1)
+                    # keep the NEWEST batch as the probe payload
+                    while len(self._pending) > 1:
+                        self._drop_batch(self._pending.popleft())
+                    self._next_at = now + self.probe_cooldown_s
+                else:
+                    self._next_at = now + backoff_delay(
+                        self.failures - 1, base_s=self.base_s,
+                        max_s=self.max_s, seed=self.seed)
+                break
+        return sent
+
+    def pump(self, now: Optional[float] = None) -> int:
+        """One synchronous collect+flush round (tests run start=False);
+        returns spans delivered."""
+        self.collect()
+        return self.flush(now)
+
+    @property
+    def pending_batches(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    # ---------------------------------------------------------- thread
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._run, name="otlp-pusher", daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.pump()
+            except Exception:
+                # delivery machinery must never take the process down;
+                # the failure accounting happens inside flush
+                pass
+            self._stop.wait(self.interval_s)
+
+    def close(self) -> None:
+        """Stop the thread and make one final best-effort delivery
+        round (a live endpoint gets everything; a dead one keeps its
+        breaker state — close is not a license to hammer)."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        try:
+            self.pump()
+        except Exception:
+            pass
+
+    def __enter__(self) -> "OtlpPusher":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class StubOtlpCollector:
+    """Stdlib OTLP/HTTP collector for tests and the bench harness: a
+    ThreadingHTTPServer accepting ``POST /v1/traces``, deduping whole
+    batches by their ``ddp.push.batch_id`` resource attribute (keep
+    first — the at-least-once receiver's half of the pusher contract)
+    and optionally writing EVERY arriving payload (duplicates included)
+    as one JSON file per POST into `capture_dir`, the directory
+    tools/check_otlp.py validates in push-capture mode.
+
+    Fault injection for the retry/dedup tests:
+
+    - `fail_next(n)`: the next n POSTs answer 503 WITHOUT capturing —
+      a down collector; the pusher backs off and retries.
+    - `drop_response_next(n)`: the next n POSTs capture the batch but
+      answer 500 — delivered-but-response-lost, the case that makes
+      at-least-once produce duplicates the dedup must absorb.
+
+    The intake path is deliberately LAZY: a POST only banks the raw
+    body (and appends it to `capture_dir` verbatim); parsing, batch-id
+    dedup and span counting happen on first ACCESS of `batches`/`seen`/
+    `exports`/`spans`/`duplicates`. The stub shares a core (and a GIL)
+    with the serve loop it instruments in the bench — a real collector
+    is another machine, so any in-process json.loads during the timed
+    window would bill the push arm for work the real deployment never
+    pays.
+    """
+
+    def __init__(self, capture_dir: Optional[str] = None, *,
+                 host: str = "127.0.0.1", port: int = 0,
+                 start: bool = True) -> None:
+        import os
+
+        self.capture_dir = capture_dir
+        if capture_dir is not None:
+            os.makedirs(capture_dir, exist_ok=True)
+        self._lock = threading.Lock()
+        self._raw: list = []        # undigested POST bodies (bytes)
+        self._batches: list = []    # batch ids in arrival order, dupes kept
+        self._seen: set = set()     # deduped batch ids
+        self._exports: list = []    # (batch_id, export) after dedup
+        self._spans = 0             # span count after dedup
+        self._duplicates = 0
+        self.rejected = 0           # fail_next 503s served
+        self._fail = 0
+        self._drop_response = 0
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def do_POST(self):
+                if self.path.split("?", 1)[0] != "/v1/traces":
+                    status = 404
+                else:
+                    length = int(self.headers.get("Content-Length", 0))
+                    body = self.rfile.read(length)
+                    status = outer._on_post(body)
+                self.send_response(status)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._server.daemon_threads = True
+        self.host = host
+        self.port = self._server.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+        self._capture_idx = 0
+        if start:
+            self.start()
+
+    @property
+    def endpoint(self) -> str:
+        return f"http://{self.host}:{self.port}/v1/traces"
+
+    # --------------------------------------------------- fault injection
+    def fail_next(self, n: int) -> None:
+        with self._lock:
+            self._fail = n
+
+    def drop_response_next(self, n: int) -> None:
+        with self._lock:
+            self._drop_response = n
+
+    # ------------------------------------------------------- the intake
+    @staticmethod
+    def _batch_id(export: dict) -> Optional[str]:
+        for rs in export.get("resourceSpans", ()):
+            for kv in rs.get("resource", {}).get("attributes", ()):
+                if kv.get("key") == "ddp.push.batch_id":
+                    return kv.get("value", {}).get("stringValue")
+        return None
+
+    def _on_post(self, body: bytes) -> int:
+        with self._lock:
+            if self._fail > 0:
+                self._fail -= 1
+                self.rejected += 1
+                return 503
+            if not body.lstrip()[:1] == b"{":
+                # the one shape check cheap enough for the hot path;
+                # anything subtler surfaces at digest time
+                return 400
+            self._raw.append(body)
+            if self.capture_dir is not None:
+                import os
+
+                path = os.path.join(
+                    self.capture_dir,
+                    f"batch-{self._capture_idx:04d}.json")
+                self._capture_idx += 1
+                with open(path, "wb") as f:
+                    f.write(body)
+            if self._drop_response > 0:
+                # the batch IS captured — only the acknowledgement is
+                # lost, so the client retries and the dedup absorbs it
+                self._drop_response -= 1
+                return 500
+            return 200
+
+    def _digest(self) -> None:
+        """Parse and dedup every banked body (caller holds no lock)."""
+        with self._lock:
+            raw, self._raw = self._raw, []
+            for body in raw:
+                try:
+                    export = json.loads(body)
+                except ValueError:
+                    continue
+                bid = self._batch_id(export)
+                self._batches.append(bid)
+                if bid is not None and bid in self._seen:
+                    self._duplicates += 1
+                else:
+                    if bid is not None:
+                        self._seen.add(bid)
+                    self._exports.append((bid, export))
+                    self._spans += OtlpPusher._span_count(export)
+
+    @property
+    def batches(self) -> list:
+        self._digest()
+        return self._batches
+
+    @property
+    def seen(self) -> set:
+        self._digest()
+        return self._seen
+
+    @property
+    def exports(self) -> list:
+        self._digest()
+        return self._exports
+
+    @property
+    def spans(self) -> int:
+        self._digest()
+        return self._spans
+
+    @property
+    def duplicates(self) -> int:
+        self._digest()
+        return self._duplicates
+
+    def span_ids(self) -> set:
+        """Every spanId in the deduped capture (the completeness check
+        the kill/recover test asserts against the recorder's export)."""
+        out = set()
+        for _, export in self.exports:
+            for rs in export.get("resourceSpans", ()):
+                for ss in rs.get("scopeSpans", ()):
+                    for sp in ss.get("spans", ()):
+                        out.add(sp.get("spanId"))
+        return out
+
+    # --------------------------------------------------------- lifecycle
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            kwargs={"poll_interval": 0.1},
+            name="otlp-collector", daemon=True,
+        )
+        self._thread.start()
+
+    def close(self) -> None:
+        if self._thread is None:
+            return
+        self._server.shutdown()
+        self._thread.join(timeout=5.0)
+        self._server.server_close()
+        self._thread = None
+
+    def __enter__(self) -> "StubOtlpCollector":
         return self
 
     def __exit__(self, *exc) -> None:

@@ -138,22 +138,40 @@ class TraceSampler:
     request is tail-kept even when head-unsampled (SLO-derived: a
     straggler IS the interesting trace); `stage_limit` bounds the
     per-request staging area a head-unsampled request's spans wait in
-    until its tail verdict."""
+    until its tail verdict; `tenant_rates` maps tenant id -> head rate
+    override (a debugged tenant runs at 1.0 while the fleet default
+    stays at 1%), consulted per request via `rate_for`. Tail keep-rules
+    are deliberately tenant-blind: a fault-affected request keeps its
+    trace whatever its tenant's head rate."""
 
     def __init__(self, rate: float = 1.0, *,
                  keep_slow_s: Optional[float] = None,
-                 stage_limit: int = 256, decide=None) -> None:
+                 stage_limit: int = 256, decide=None,
+                 tenant_rates: Optional[Dict[str, float]] = None) -> None:
         if stage_limit < 1:
             raise ValueError("stage_limit must be positive")
         self.rate = float(rate)
         self.keep_slow_s = keep_slow_s
         self.stage_limit = stage_limit
         self._decide = decide
+        self.tenant_rates: Optional[Dict[str, float]] = (
+            {str(k): float(v) for k, v in tenant_rates.items()}
+            if tenant_rates else None)
 
-    def sampled(self, trace_id: str) -> bool:
+    def rate_for(self, tenant: Optional[str] = None) -> float:
+        """The head rate this request samples at: the tenant's override
+        when one is configured, the fleet default otherwise."""
+        if tenant is not None and self.tenant_rates:
+            r = self.tenant_rates.get(tenant)
+            if r is not None:
+                return r
+        return self.rate
+
+    def sampled(self, trace_id: str,
+                tenant: Optional[str] = None) -> bool:
         if self._decide is not None:
             return bool(self._decide(trace_id))
-        return head_keep(trace_id, self.rate)
+        return head_keep(trace_id, self.rate_for(tenant))
 
     def keep_reason(self, *, status: Optional[str] = None,
                     latency_s: Optional[float] = None,
@@ -275,6 +293,13 @@ class TraceRecorder:
         self.kept_reasons: Dict[str, int] = {}
         self._c_sampled = self._c_kept = self._c_suppressed = None
         self._keep_registry = None
+        # incremental OTLP drain state (drain_otlp / OtlpPusher): the
+        # high-water seq already exported plus each trace's remembered
+        # root spanId, so successive batches never re-emit a record
+        # (spanIds must stay unique across a merged push capture) and a
+        # span drained after its root shipped still parents onto it.
+        self._otlp_drained = -1
+        self._otlp_roots: Dict[str, str] = {}
         if sink is not None:
             self.set_sink(sink)
 
@@ -349,19 +374,22 @@ class TraceRecorder:
             self._keep_registry = registry
 
     def begin_trace(self, trace_id: Optional[str],
-                    sampled: Optional[bool] = None) -> bool:
+                    sampled: Optional[bool] = None, *,
+                    tenant: Optional[str] = None) -> bool:
         """Stamp the head decision for one request at admission.
         Idempotent per trace_id (the router and a scheduler sharing one
         recorder both call it); `sampled` carries an upstream decision
         across the RPC seam (Dapper coherence: decided once, honored
-        everywhere). Returns whether the request's spans flow."""
+        everywhere); `tenant` selects a per-tenant head-rate override
+        when the sampler has one. Returns whether the request's spans
+        flow."""
         if self.sampler is None or trace_id is None or not self.enabled:
             return True if sampled is None else bool(sampled)
         v = self._head.get(trace_id)
         if v is not None:
             return v != 0
         if sampled is None:
-            sampled = self.sampler.sampled(trace_id)
+            sampled = self.sampler.sampled(trace_id, tenant)
         if len(self._head) >= 16384:
             # runaway begin/finish imbalance must not leak: evict the
             # oldest in-flight trace, suppressing anything it staged
@@ -746,7 +774,7 @@ class TraceRecorder:
         """The export-header sampling block; None when sampling is off."""
         if self.sampler is None:
             return None
-        return {
+        out = {
             "head_rate": self.sampler.rate,
             "keep_slow_s": self.sampler.keep_slow_s,
             "traces_sampled": self.traces_sampled,
@@ -757,6 +785,9 @@ class TraceRecorder:
             "spans_suppressed": self.spans_suppressed,
             "kept_reasons": dict(self.kept_reasons),
         }
+        if self.sampler.tenant_rates:
+            out["tenant_rates"] = dict(self.sampler.tenant_rates)
+        return out
 
     def save(self, path: str) -> None:
         """Write the Chrome trace JSON (open in Perfetto / chrome://tracing)."""
@@ -790,38 +821,52 @@ class TraceRecorder:
         spans = []
         for tid_, recs in sorted(by_trace.items()):
             recs.sort(key=lambda r: (r.t0, r.seq))
-            trace_hex = _otlp_trace_id(tid_)
-            root = None
+            root_sid = None
             for r in recs:
                 if r.kind == _ASYNC and r.name == "request":
-                    root = r
+                    root_sid = _otlp_span_id(tid_, r.seq)
                     break
-            root_sid = _otlp_span_id(tid_, root.seq) if root else None
             for r in recs:
-                sid = _otlp_span_id(tid_, r.seq)
-                attrs = {"ddp.trace_id": tid_, "ddp.pid": r.pid,
-                         "ddp.kind": ("span", "async", "instant")[r.kind]}
-                if r.kind == _DUR:
-                    attrs["ddp.tid"] = r.tid
-                if r.attrs:
-                    attrs.update(r.attrs)
-                span = {
-                    "traceId": trace_hex,
-                    "spanId": sid,
-                    "name": str(r.name),
-                    "kind": 1,  # SPAN_KIND_INTERNAL
-                    "startTimeUnixNano": str(int(round(r.t0 * 1e9))),
-                    "endTimeUnixNano": str(int(round(r.t1 * 1e9))),
-                    "attributes": _otlp_attrs(attrs),
-                }
-                if root is not None and r is not root:
-                    span["parentSpanId"] = root_sid
-                status = (r.attrs or {}).get("status")
-                if r is root and status is not None:
-                    span["status"] = (
-                        {"code": 1} if status in _CLEAN_STATUSES
-                        else {"code": 2, "message": str(status)})
-                spans.append(span)
+                spans.append(_otlp_record_span(r, tid_, root_sid))
+        return self._otlp_request(service_name, spans)
+
+    def drain_otlp(self, service_name: str = "ddp-serve"
+                   ) -> Optional[dict]:
+        """Incremental OTLP export: the per-request records that entered
+        the ring since the previous drain, as one
+        ``ExportTraceServiceRequest`` (None when nothing is new). This is
+        the push-plane producer (utils/telemetry.py OtlpPusher): each
+        record is emitted in EXACTLY one batch — a seq high-water mark —
+        so a collector that dedups whole batches by batch id never sees
+        a duplicate spanId across the merged capture. The first
+        "request" async span seen for a trace becomes (and stays) its
+        root: spans in later batches parent onto it even though it
+        shipped batches ago, and spans drained BEFORE their root exists
+        go parentless — legal OTLP roots until the real root arrives."""
+        with self._lock:
+            records = [r for r in self._records
+                       if r.trace_id is not None
+                       and r.seq > self._otlp_drained]
+            if not records:
+                return None
+            self._otlp_drained = max(r.seq for r in records)
+        records.sort(key=lambda r: (str(r.trace_id), r.t0, r.seq))
+        spans = []
+        for r in records:
+            tid_ = str(r.trace_id)
+            root_sid = self._otlp_roots.get(tid_)
+            if (root_sid is None and r.kind == _ASYNC
+                    and r.name == "request"):
+                root_sid = _otlp_span_id(tid_, r.seq)
+                if len(self._otlp_roots) >= 16384:
+                    self._otlp_roots.pop(next(iter(self._otlp_roots)))
+                self._otlp_roots[tid_] = root_sid
+            spans.append(_otlp_record_span(r, tid_, root_sid))
+        return self._otlp_request(service_name, spans)
+
+    def _otlp_request(self, service_name: str, spans: list) -> dict:
+        """Wrap built spans in the export envelope (resource header =
+        service name + sampling accounting + drop count)."""
         resource_attrs = {"service.name": service_name}
         sm = self.sampling_meta()
         if sm is not None:
@@ -860,6 +905,39 @@ def _otlp_span_id(trace_id: str, seq: int) -> str:
         f"{trace_id}#{seq}".encode("utf-8")).hexdigest()[:16]
 
 
+def _otlp_record_span(r: "_Rec", tid_: str,
+                      root_sid: Optional[str]) -> dict:
+    """One record -> one OTLP span (shared by the exit-time to_otlp and
+    the incremental drain_otlp, so both exports speak the same shape).
+    `root_sid` is the trace root's spanId or None; the root itself
+    (sid == root_sid) carries the status instead of a parent link."""
+    sid = _otlp_span_id(tid_, r.seq)
+    attrs = {"ddp.trace_id": tid_, "ddp.pid": r.pid,
+             "ddp.kind": ("span", "async", "instant")[r.kind]}
+    if r.kind == _DUR:
+        attrs["ddp.tid"] = r.tid
+    if r.attrs:
+        attrs.update(r.attrs)
+    span = {
+        "traceId": _otlp_trace_id(tid_),
+        "spanId": sid,
+        "name": str(r.name),
+        "kind": 1,  # SPAN_KIND_INTERNAL
+        "startTimeUnixNano": str(int(round(r.t0 * 1e9))),
+        "endTimeUnixNano": str(int(round(r.t1 * 1e9))),
+        "attributes": _otlp_attrs(attrs),
+    }
+    if root_sid is not None and sid != root_sid:
+        span["parentSpanId"] = root_sid
+    elif sid == root_sid:
+        status = (r.attrs or {}).get("status")
+        if status is not None:
+            span["status"] = (
+                {"code": 1} if status in _CLEAN_STATUSES
+                else {"code": 2, "message": str(status)})
+    return span
+
+
 def _otlp_attrs(attrs: dict) -> list:
     """dict -> OTLP KeyValue list (string/bool/int/double values)."""
     out = []
@@ -890,6 +968,121 @@ def label_replica(recorder: TraceRecorder, replica: int,
 def label_router(recorder: TraceRecorder) -> None:
     recorder.set_process_name(ROUTER_PID, "router")
     recorder.set_thread_name(ROUTER_PID, 0, "dispatch")
+
+
+# -------------------------------------------------- adaptive head rate
+class AdaptiveHeadRateController:
+    """Feedback loop steering the head sample rate toward a kept-spans/
+    sec budget — Dapper's production lesson, applied: the right rate is
+    a function of observed traffic, not a hand-tuned constant baked
+    into the fleet spec.
+
+    Each `step(now)` past `interval_s` measures the kept-span flow from
+    the recorder's own accounting counters (spans_sampled + spans_kept,
+    the same totals trace_spans_*_total export) and applies one
+    multiplicative correction `rate *= budget / observed`, clamped to
+    [min_rate, max_rate] — kept flow is ~linear in the head rate, so a
+    single step lands near the budget and the loop converges without a
+    gain schedule. Two guards keep it from thrashing:
+
+    - **deadband**: observed flow within ±`deadband` (fraction) of the
+      budget is "on budget" — no correction, no churn.
+    - **hold window**: after a change the rate holds for `hold_s`
+      regardless of the error signal, so a correction's effect is
+      actually OBSERVED before the next one (and, trivially, the rate
+      never reverses inside its own hold window — the no-oscillation
+      contract the tests pin).
+
+    Every change is applied to the local sampler, pushed to the fleet
+    via `apply_fn(new_rate)` (each worker handle's live rpc ``trace``
+    op), and stamped into the timeline as a ``trace_rate`` instant —
+    a span captured at 2% says so, right in the trace. Per-tenant
+    overrides are left alone: the controller steers the fleet DEFAULT
+    rate only.
+    """
+
+    def __init__(self, recorder: TraceRecorder, budget_sps: float, *,
+                 clock=None, interval_s: float = 1.0,
+                 min_rate: float = 0.001, max_rate: float = 1.0,
+                 deadband: float = 0.1, hold_s: float = 5.0,
+                 apply_fn=None) -> None:
+        if budget_sps <= 0:
+            raise ValueError("budget_sps must be positive")
+        self.recorder = recorder
+        self.budget_sps = float(budget_sps)
+        self.interval_s = float(interval_s)
+        self.min_rate = float(min_rate)
+        self.max_rate = float(max_rate)
+        self.deadband = float(deadband)
+        self.hold_s = float(hold_s)
+        self.apply_fn = apply_fn
+        self._now = _resolve_clock(clock)
+        sampler = recorder.sampler
+        self.rate = sampler.rate if sampler is not None else 1.0
+        self.changes = 0
+        self.rate_log: list = []
+        self._last_eval: Optional[float] = None
+        self._last_count: Optional[int] = None
+        self._last_change_t: Optional[float] = None
+        self.last_observed_sps: Optional[float] = None
+
+    def _kept_count(self) -> int:
+        r = self.recorder
+        return r.spans_sampled + r.spans_kept
+
+    def step(self, now: Optional[float] = None) -> Optional[float]:
+        """Evaluate once; returns the new rate when a change was applied,
+        None otherwise. Call from the serve loop — cheap when the
+        interval has not elapsed."""
+        if now is None:
+            now = self._now()
+        if self._last_eval is None:
+            # first call establishes the measurement baseline
+            self._last_eval = now
+            self._last_count = self._kept_count()
+            return None
+        dt = now - self._last_eval
+        if dt < self.interval_s:
+            return None
+        count = self._kept_count()
+        observed = (count - self._last_count) / dt
+        self._last_eval = now
+        self._last_count = count
+        self.last_observed_sps = observed
+        if abs(observed - self.budget_sps) <= (
+                self.deadband * self.budget_sps):
+            return None
+        if (self._last_change_t is not None
+                and now - self._last_change_t < self.hold_s):
+            return None
+        cur = self.rate
+        if observed <= 0.0:
+            # nothing kept at all: probe upward instead of dividing by 0
+            new = cur * 2.0
+        else:
+            new = cur * (self.budget_sps / observed)
+        new = min(self.max_rate, max(self.min_rate, new))
+        if new == cur:
+            return None
+        self.rate = new
+        self.changes += 1
+        self._last_change_t = now
+        self.rate_log.append({"t": now, "prev": cur, "rate": new,
+                              "observed_sps": observed})
+        if self.recorder.sampler is not None:
+            self.recorder.sampler.rate = new
+        self.recorder.record_instant(
+            "trace_rate", now, pid=ROUTER_PID,
+            attrs={"rate": new, "prev": cur, "observed_sps": observed,
+                   "budget_sps": self.budget_sps})
+        if self.apply_fn is not None:
+            # fleet push (worker handles' live trace op) must never take
+            # the control loop down with it
+            try:
+                self.apply_fn(new)
+            except Exception:
+                pass
+        return new
 
 
 # ------------------------------------------------------- fleet trace plane

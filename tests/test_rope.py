@@ -110,6 +110,7 @@ def test_rope_cached_decode_matches_full_forward(devices):
         )
 
 
+@pytest.mark.slow    # 10.9s measured — over the tier-1 10s line
 def test_rope_greedy_generate_matches_naive(devices):
     model = _rope_lm()
     tokens = jnp.zeros((1, 8), jnp.int32)

@@ -258,6 +258,10 @@ def test_check_bench_exit_codes_both_ways(tmp_path):
     assert "REGRESSION" in r.stdout
     assert "latency_ratio_p50" in r.stdout
     assert "lost" in r.stdout
+    # the ISSUE-12 observability gates regress in the same ledger: a
+    # blown push overhead and a controller that missed its ±20% budget
+    assert "otlp_push_overhead_100rps.mean_ratio" in r.stdout
+    assert "adaptive_sampling_100rps.within_budget" in r.stdout
     # unreadable input is exit 2, not a fake verdict
     garbage = tmp_path / "garbage.json"
     garbage.write_text("{broken")
@@ -391,6 +395,68 @@ def test_check_otlp_sampling_metadata_in_artifact():
     assert chrome["metadata"]["sampling"]["head_rate"] == 0.01
     assert chrome["metadata"]["sampling"]["kept_reasons"] == {
         "failover": 1}
+
+
+# ---------------------------------- ISSUE 12: push-capture artifacts
+# what the stub OTLP collector wrote during a real at-least-once push
+# run: one payload file per POST. The OK capture holds 3 payloads but
+# only 2 batches — the middle batch was delivered, its 200 was dropped
+# (the SIGKILL-shaped failure), and the retry landed a byte-identical
+# duplicate that batch-id dedup must fold away. The BAD capture is the
+# other failure: the SAME spans re-delivered under a fresh batch id (a
+# drain that re-emits), which dedup cannot save — the merged export
+# fails on duplicate spanIds.
+OTLP_PUSH_OK = os.path.join(ROOT, "tests", "data",
+                            "otlp_push_capture_ok")
+OTLP_PUSH_BAD = os.path.join(ROOT, "tests", "data",
+                             "otlp_push_capture_bad")
+
+
+def test_check_otlp_push_capture_dir_both_ways(tmp_path):
+    r = _run("tools/check_otlp.py", OTLP_PUSH_OK)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "OK" in r.stdout
+    assert "1 duplicate(s)" in r.stdout          # the retried batch
+    assert "2 batch(es) from 3 payload(s)" in r.stdout
+    r = _run("tools/check_otlp.py", OTLP_PUSH_BAD)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "INVALID" in r.stdout
+    assert "duplicate spanId" in r.stdout
+    # an empty capture directory is unreadable input, not a clean pass
+    empty = tmp_path / "empty_capture"
+    empty.mkdir()
+    assert _run("tools/check_otlp.py", str(empty)).returncode == 2
+    # a payload that parses but isn't an export is named, and fails
+    mixed = tmp_path / "mixed_capture"
+    mixed.mkdir()
+    (mixed / "batch-0000.json").write_text('{"not": "otlp"}')
+    r = _run("tools/check_otlp.py", str(mixed))
+    assert r.returncode == 1
+    assert "not an OTLP export" in r.stdout
+    # --json carries the batch accounting
+    r = _run("tools/check_otlp.py", "--json", OTLP_PUSH_OK)
+    assert r.returncode == 0
+    rep = json.loads(r.stdout.split("\n", 1)[1])[0]
+    assert rep["unique_batches"] == 2 and rep["duplicate_batches"] == 1
+
+
+def test_check_otlp_push_capture_as_library():
+    from tools.check_otlp import (load_push_capture, push_batch_id,
+                                  validate_otlp)
+
+    export, info = load_push_capture(OTLP_PUSH_OK)
+    assert validate_otlp(export) == []
+    assert info["files"] == 3 and info["unique_batches"] == 2
+    assert info["duplicate_batches"] == 1 and info["errors"] == []
+    # every surviving batch id is unique and pusher-stamped
+    bids = set()
+    for name in sorted(os.listdir(OTLP_PUSH_OK)):
+        bids.add(push_batch_id(
+            json.load(open(os.path.join(OTLP_PUSH_OK, name)))))
+    assert len(bids) == 2  # 3 files, one duplicated id
+    export, info = load_push_capture(OTLP_PUSH_BAD)
+    errs = validate_otlp(export)
+    assert any("duplicate spanId" in e for e in errs)
 
 
 def test_check_durations_exit_codes(tmp_path):

@@ -49,3 +49,57 @@ def test_t1_suite_fits_the_timeout(request, t1_duration_ledger):
         f"silently, so shed load now: mark the slowest tests "
         f"@pytest.mark.slow (>10 s belongs there).\nslowest:\n{detail}"
     )
+
+
+# the in-run marker gate hard-fails only past this multiple of the 10 s
+# line: this 1-core box shows >2x run-to-run variance on individual
+# tests (7.8 s and 18.4 s for the SAME test in back-to-back clean
+# runs), so a test in the 1x-2x band is load noise, not a budget
+# threat — it surfaces as a pytest warning instead of flapping tier-1.
+# The PR-9-class offenders this gate exists for ran 12-188 s each,
+# far past any noise band. tools/check_durations.py --strict-slow
+# stays EXACT at 10 s for offline audits on quiet machines.
+NOISE_MARGIN = 2.0
+
+
+def test_t1_no_unmarked_slow_tests(request, t1_duration_ledger):
+    """The marker contract, enforced in-run: any test over 10 s inside
+    the tier-1 (``not slow``) population belongs behind
+    ``@pytest.mark.slow``. This is tools/check_durations.py
+    ``--strict-slow`` wired into the suite itself — the offline auditor
+    only runs when someone remembers to, and an unmarked 30 s test
+    erodes the 870 s budget three PRs before the projection sentinel
+    above starts failing. Same ``audit()`` code path, so the CLI and
+    the in-run gate cannot drift on what counts as an offender; the
+    in-run gate only adds the NOISE_MARGIN band above."""
+    import warnings as warnings_mod
+
+    from tools.check_durations import SLOW_MARK_S, audit
+
+    markexpr = getattr(request.config.option, "markexpr", "") or ""
+    if "not slow" not in markexpr.replace("(", "").replace(")", ""):
+        pytest.skip("marker-hygiene sentinel audits only the tier-1 "
+                    "(-m 'not slow') run")
+    if len(t1_duration_ledger) < MIN_REPORTS:
+        pytest.skip(f"partial run ({len(t1_duration_ledger)} reports "
+                    f"< {MIN_REPORTS}) — not the tier-1 population")
+    ledger = dict(t1_duration_ledger)
+    errors, warnings, _ = audit({
+        "markexpr": markexpr,
+        "tests": ledger,
+    })
+    assert not errors, "\n".join(errors)
+    hard_line = SLOW_MARK_S * NOISE_MARGIN
+    hard = [w for w in warnings
+            if ledger.get(w.split(" took", 1)[0], 0.0) > hard_line]
+    for w in warnings:
+        if w not in hard:
+            warnings_mod.warn(
+                f"near the tier-1 slow line (noise band "
+                f"{SLOW_MARK_S:.0f}-{hard_line:.0f}s): {w}")
+    assert not hard, (
+        f"{len(hard)} unmarked test(s) over {hard_line:.0f}s "
+        f"({NOISE_MARGIN:.0f}x the {SLOW_MARK_S:.0f}s line — past any "
+        "load-noise band) inside the tier-1 run — each line below is "
+        "a one-line @pytest.mark.slow diff:\n  " + "\n  ".join(hard)
+    )

@@ -11,8 +11,20 @@ validator makes that a checkable contract, used two ways:
 - as a CLI::
 
       python tools/check_otlp.py export.json [--chrome trace.json] [--json]
+      python tools/check_otlp.py capture_dir/ [--json]
 
   exit 0 clean, 1 invalid, 2 unreadable/unparseable input.
+
+A DIRECTORY argument is a push-capture: what the stub OTLP collector
+(utils/telemetry.py StubOtlpCollector) wrote — one JSON payload file
+per received POST, duplicates included (the pusher is at-least-once:
+a delivered-but-response-lost batch is retried and arrives twice).
+Batches are deduped by their ``ddp.push.batch_id`` resource attribute
+(keep FIRST, the receiver's half of the contract) and the surviving
+payloads merge into one export that must validate exactly like a
+single-file export — in particular, spanIds must be unique ACROSS the
+whole merged capture, which is what pins the pusher's
+each-span-in-exactly-one-batch drain invariant.
 
 Shape checks (each one a real way to lose data inside a collector):
 
@@ -39,6 +51,7 @@ sampling decisions applied to one export path but not the other).
 from __future__ import annotations
 
 import json
+import os
 import sys
 from typing import List
 
@@ -208,6 +221,73 @@ def crosscheck_chrome(export: dict, chrome: dict) -> List[str]:
     return errors
 
 
+def push_batch_id(export) -> str:
+    """The ``ddp.push.batch_id`` resource attribute, or None.
+
+    Stamped by the pusher (utils/telemetry.py OtlpPusher.collect) into
+    every batch's resource attributes; the at-least-once retry loop can
+    deliver the same batch twice, and this id is what lets a receiver
+    (or this tool's directory mode) keep exactly one copy."""
+    if not isinstance(export, dict):
+        return None
+    for rs in export.get("resourceSpans") or []:
+        if not isinstance(rs, dict):
+            continue
+        res = rs.get("resource")
+        if not isinstance(res, dict):
+            continue
+        for kv in res.get("attributes") or []:
+            if isinstance(kv, dict) and kv.get("key") == "ddp.push.batch_id":
+                val = kv.get("value")
+                if isinstance(val, dict) and "stringValue" in val:
+                    return str(val["stringValue"])
+    return None
+
+
+def load_push_capture(dirpath: str):
+    """Load a push-capture directory into one deduped, merged export.
+
+    Reads every ``*.json`` payload (sorted by filename — the stub
+    collector numbers them in arrival order), drops whole batches whose
+    ``ddp.push.batch_id`` was already seen (keep FIRST), and
+    concatenates the survivors' resourceSpans into a single export.
+    Returns ``(export, info)`` where info counts files / unique batches
+    / duplicates and carries shape errors for payloads that were valid
+    JSON but not OTLP-shaped. Raises OSError / json.JSONDecodeError for
+    unreadable input, same as the single-file path."""
+    files = sorted(n for n in os.listdir(dirpath) if n.endswith(".json"))
+    if not files:
+        raise OSError(f"no *.json batch payloads in {dirpath}")
+    merged = {"resourceSpans": []}
+    seen = set()
+    duplicates = 0
+    shape_errors: List[str] = []
+    for name in files:
+        with open(os.path.join(dirpath, name)) as f:
+            export = json.load(f)
+        bid = push_batch_id(export)
+        if bid is not None:
+            if bid in seen:
+                duplicates += 1
+                continue
+            seen.add(bid)
+        if not (isinstance(export, dict)
+                and isinstance(export.get("resourceSpans"), list)):
+            shape_errors.append(
+                f"{name}: payload is not an OTLP export "
+                "(no 'resourceSpans' list)")
+            continue
+        if bid is None:
+            shape_errors.append(
+                f"{name}: batch carries no ddp.push.batch_id resource "
+                "attribute — a retried delivery of it could never be "
+                "deduped")
+        merged["resourceSpans"].extend(export["resourceSpans"])
+    info = {"files": len(files), "unique_batches": len(seen),
+            "duplicate_batches": duplicates, "errors": shape_errors}
+    return merged, info
+
+
 def summarize(export: dict) -> dict:
     spans = list(iter_spans(export))
     traces = {s.get("traceId") for s in spans}
@@ -250,22 +330,35 @@ def main(argv=None) -> int:
     rc = 0
     report = []
     for path in paths:
+        cap = None
         try:
-            with open(path) as f:
-                export = json.load(f)
+            if os.path.isdir(path):
+                export, cap = load_push_capture(path)
+            else:
+                with open(path) as f:
+                    export = json.load(f)
         except (OSError, json.JSONDecodeError) as e:
             print(f"{path}: UNREADABLE — {e}")
             return 2
-        errors = validate_otlp(export)
+        errors = (list(cap["errors"]) if cap else []) + validate_otlp(export)
         if chrome is not None:
             errors += crosscheck_chrome(export, chrome)
         s = summarize(export)
-        report.append({"path": path, "ok": not errors,
-                       "errors": errors, **s})
+        entry = {"path": path, "ok": not errors, "errors": errors, **s}
+        if cap is not None:
+            entry.update(files=cap["files"],
+                         unique_batches=cap["unique_batches"],
+                         duplicate_batches=cap["duplicate_batches"])
+        report.append(entry)
+        batched = ""
+        if cap is not None:
+            batched = (f" [{cap['unique_batches']} batch(es) from "
+                       f"{cap['files']} payload(s), "
+                       f"{cap['duplicate_batches']} duplicate(s)]")
         if errors:
             rc = 1
             print(f"{path}: INVALID ({len(errors)} error(s); "
-                  f"{s['spans']} spans)")
+                  f"{s['spans']} spans){batched}")
             for e in errors[:20]:
                 print(f"  - {e}")
             if len(errors) > 20:
@@ -274,7 +367,8 @@ def main(argv=None) -> int:
             extra = " (round-trip vs chrome OK)" if chrome is not None \
                 else ""
             print(f"{path}: OK — {s['spans']} spans across "
-                  f"{s['traces']} trace(s), {s['roots']} root(s){extra}")
+                  f"{s['traces']} trace(s), {s['roots']} root(s)"
+                  f"{batched}{extra}")
     if as_json:
         print(json.dumps(report, indent=2))
     return rc
