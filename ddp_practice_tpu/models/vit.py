@@ -343,7 +343,12 @@ class SelfAttention(nn.Module):
         the cached prefix through the page table. Writes scatter per
         position; attention gathers the slot's span once and masks
         causally per query row (amortized over the whole admission, the
-        same trade the flat prefill makes).
+        same trade the flat prefill makes). The SAME s > 1 path serves
+        speculative-decoding verify windows (serve/spec.py): k drafted
+        tokens scored in one forward at positions kv_lengths + [0, k),
+        each attending the committed context plus the drafts before it —
+        no extra model surface, the verify window IS a short paged
+        prefill.
 
         kv_cache_dtype="int8" composes (PR 6): the pool carries
         per-block (num_blocks, h, block_size) fp32 scale pages

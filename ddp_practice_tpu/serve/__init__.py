@@ -13,6 +13,12 @@ Layers (each its own module, composable and separately testable):
   churns with zero recompiles; per-slot finite-logits flag contains a
   NaN to one request; one interface (admit_gate/admit/step_burst/
   release) over both memory layouts;
+- spec.py      — speculative decoding drafts WITHOUT a draft model:
+  DraftSource interface + the n-gram prompt-lookup drafter (host-side
+  suffix match over prompt+generated tokens); the paged engine verifies
+  k drafted tokens in ONE jitted forward (the s>1 paged-prefill path)
+  with exact greedy acceptance and block-aware KV rollback —
+  token-identical to plain decoding, fewer sequential steps;
 - scheduler.py — FIFO queue, admission control (bounded queue sheds),
   per-request deadlines, EOS/length release, injectable clock
   (FakeClock for deterministic CPU tests) and fault hook;
@@ -106,6 +112,10 @@ from ddp_practice_tpu.serve.rpc import (
     RpcServer,
     RpcTimeout,
 )
+from ddp_practice_tpu.serve.spec import (
+    DraftSource,
+    PromptLookupDraft,
+)
 from ddp_practice_tpu.serve.slo import (
     AlertSinks,
     AlertSinkSpec,
@@ -128,6 +138,7 @@ __all__ = [
     "BreakerConfig",
     "CircuitBreaker",
     "Completion",
+    "DraftSource",
     "FleetAlerts",
     "EngineConfig",
     "FakeClock",
@@ -137,6 +148,7 @@ __all__ = [
     "HealthState",
     "MonotonicClock",
     "PagedEngine",
+    "PromptLookupDraft",
     "RadixPrefixCache",
     "RemoteReplicaHandle",
     "ReplicaCrashed",

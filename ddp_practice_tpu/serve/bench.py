@@ -119,6 +119,44 @@ def build_shared_prefix_trace(
     return trace
 
 
+def build_lookup_trace(
+    *,
+    n_requests: int,
+    rate_hz: float,
+    vocab: int,
+    motif_range=(2, 4),
+    prompt_len_range=(6, 16),
+    max_new_range=(8, 24),
+    seed: int = 0,
+) -> list:
+    """Lookup-friendly prompts: each is a short random motif repeated to
+    length (summarization / code-edit / quoting traffic in miniature —
+    the text keeps citing its own earlier spans). This is the workload
+    prompt-lookup speculative decoding (serve/spec.py) targets: the
+    trailing n-gram recurs, so drafts fire and verify accepts runs.
+    Deterministic per seed, same trace replays through both arms."""
+    rng = np.random.default_rng(seed)
+    gaps = rng.exponential(1.0 / rate_hz, n_requests)
+    arrivals = np.cumsum(gaps)
+    trace = []
+    for i in range(n_requests):
+        motif = rng.integers(
+            0, vocab, int(rng.integers(motif_range[0], motif_range[1] + 1))
+        ).tolist()
+        plen = int(rng.integers(prompt_len_range[0],
+                                prompt_len_range[1] + 1))
+        reps = -(-plen // len(motif))
+        trace.append({
+            "rid": i,
+            "arrival": float(arrivals[i]),
+            "prompt": (motif * reps)[:plen],
+            "max_new_tokens": int(
+                rng.integers(max_new_range[0], max_new_range[1] + 1)
+            ),
+        })
+    return trace
+
+
 def _build_model(*, vocab, max_len, hidden, depth, heads, mlp,
                  kv_cache_dtype=None):
     import jax
@@ -222,7 +260,9 @@ class _Scraper:
 def _run_continuous(model, params, trace, *, max_slots, prompt_buckets,
                     max_len, decode_burst, eos_id, paged: bool = False,
                     block_size: int = 16, prefix_cache: bool = False,
-                    num_blocks: Optional[int] = None, tracer=None,
+                    num_blocks: Optional[int] = None,
+                    spec_decode: bool = False, spec_k: int = 4,
+                    collect_tokens: bool = False, tracer=None,
                     telemetry=None, health_slot=None) -> dict:
     from ddp_practice_tpu.serve.engine import (
         EngineConfig,
@@ -241,6 +281,11 @@ def _run_continuous(model, params, trace, *, max_slots, prompt_buckets,
         # — what sharing relieves — is actually on the table).
         worst_new = max(t["max_new_tokens"] for t in trace)
         worst_new = -(-worst_new // decode_burst) * decode_burst
+        if spec_decode:
+            # the verify program grows every slot spec_k + 1 positions
+            # before knowing the acceptance — the scheduler's admission
+            # slack (_needed_positions) must fit the per-slot capacity
+            worst_new += spec_k + 1
         cap_blocks = -(-(max(prompt_buckets) + worst_new) // block_size)
         engine = PagedEngine(
             model, params,
@@ -254,6 +299,7 @@ def _run_continuous(model, params, trace, *, max_slots, prompt_buckets,
                     else 1 + max_slots * (-(-max_len // block_size))
                 ),
                 prefix_cache=prefix_cache,
+                spec_decode=spec_decode, spec_k=spec_k,
             ),
         )
     else:
@@ -287,6 +333,20 @@ def _run_continuous(model, params, trace, *, max_slots, prompt_buckets,
                             max_positions=decode_burst)
         engine.step_burst()
         engine.release(slot)
+    if getattr(engine, "drafter", None) is not None:
+        # speculation on: compile the verify program outside the timed
+        # window too. An all-ones prompt makes the lookup drafter
+        # propose (every trailing n-gram recurs), then the warm
+        # dispatch's counters are zeroed so the report reconciles
+        # against workload-only numbers (same as engine.warm_engine).
+        slot = engine.admit([1] * min(engine.buckets),
+                            max_positions=spec_k + 1)
+        w_drafts, w_lens, _ = engine.propose_drafts()
+        engine.step_verify(w_drafts, w_lens)
+        engine.release(slot)
+        engine.spec_drafted_tokens = 0
+        engine.spec_accepted_tokens = 0
+        engine.spec_dispatches = 0
     if paged and prefix_cache:
         # warm the HIT path too: re-admitting a just-cached prompt
         # compiles the suffix-bucket prefix-prefill program. Then the
@@ -346,6 +406,20 @@ def _run_continuous(model, params, trace, *, max_slots, prompt_buckets,
             engine._cache, engine.blocks.num_blocks, block_size
         )
         extra["num_blocks"] = engine.blocks.num_blocks
+        if getattr(engine, "drafter", None) is not None:
+            # the accept-rate observables the spec gate reads: how much
+            # was drafted, how much the model agreed with, and how many
+            # sequential dispatches speculation actually saved
+            extra["spec"] = {
+                "spec_k": spec_k,
+                "drafted_tokens": engine.spec_drafted_tokens,
+                "accepted_tokens": engine.spec_accepted_tokens,
+                "accept_rate": (
+                    engine.spec_accepted_tokens
+                    / max(1, engine.spec_drafted_tokens)
+                ),
+                "verify_dispatches": engine.spec_dispatches,
+            }
         if prefix_cache:
             # the proof-of-reuse counters the acceptance gate reads
             extra["prefix_cache"] = {
@@ -358,8 +432,15 @@ def _run_continuous(model, params, trace, *, max_slots, prompt_buckets,
                 ),
                 "nodes": len(engine.radix),
             }
+    if collect_tokens:
+        # per-rid streams for cross-arm identity checks (the spec bench
+        # compares them, then drops them from the written report)
+        extra["tokens_by_rid"] = {
+            c.rid: list(c.tokens) for c in sched.completions
+        }
     return {
-        "mode": ("paged+prefix" if paged and prefix_cache
+        "mode": ("paged+spec" if paged and spec_decode
+                 else "paged+prefix" if paged and prefix_cache
                  else "paged" if paged else "continuous"),
         **extra,
         # largest total context one request can reach: the slot pool is
@@ -2106,6 +2187,88 @@ def shared_prefix_bench(
     return report
 
 
+def spec_decode_bench(
+    *,
+    n_requests: int = 32,
+    rate_hz: float = 8.0,
+    max_slots: int = 4,
+    vocab: int = 64,
+    hidden: int = 128,
+    depth: int = 2,
+    heads: int = 4,
+    mlp: int = 256,
+    max_len: int = 128,
+    prompt_buckets=(16,),
+    # the workload: repeated-motif prompts (build_lookup_trace) — the
+    # self-quoting traffic shape where prompt-lookup drafts actually hit
+    motif_range=(2, 4),
+    prompt_len_range=(6, 16),
+    max_new_range=(8, 24),
+    # burst=1 for BOTH arms: the honest comparison pins tokens-per-
+    # dispatch at 1 on the plain side, so the ratio isolates exactly
+    # what speculation changes — the number of sequential dispatches
+    # per emitted token. (At burst=B the plain arm lands B tokens per
+    # dispatch and the comparison conflates bursting with drafting.)
+    decode_burst: int = 1,
+    block_size: int = 16,
+    spec_k: int = 4,
+    seed: int = 0,
+) -> dict:
+    """Replay ONE lookup-friendly Poisson trace through the plain paged
+    engine and the spec-decoding paged engine at the same pool.
+
+    The report's `tpot_ratio` (spec p50 / plain p50, < 1.0 target) is
+    the ISSUE-13 acceptance number: a verified run lands k+1 tokens in
+    one dispatch, so inter-token pacing drops wherever drafts hit.
+    `token_identity` (fraction of requests with bit-identical streams,
+    target 1.0) is the exactness half of the claim — speculation is a
+    latency lever, never a quality knob. `accept_rate` explains WHY the
+    ratio moved (no accepts = no speedup, by construction)."""
+    model, params = _build_model(
+        vocab=vocab, max_len=max_len, hidden=hidden, depth=depth,
+        heads=heads, mlp=mlp,
+    )
+    trace = build_lookup_trace(
+        n_requests=n_requests, rate_hz=rate_hz, vocab=vocab,
+        motif_range=motif_range, prompt_len_range=prompt_len_range,
+        max_new_range=max_new_range, seed=seed,
+    )
+    common = dict(
+        max_slots=max_slots, prompt_buckets=tuple(prompt_buckets),
+        max_len=max_len, decode_burst=decode_burst, eos_id=None,
+        paged=True, block_size=block_size, collect_tokens=True,
+    )
+    plain = _run_continuous(model, params, trace, **common)
+    spec = _run_continuous(model, params, trace, spec_decode=True,
+                           spec_k=spec_k, **common)
+    plain_toks = plain.pop("tokens_by_rid")
+    spec_toks = spec.pop("tokens_by_rid")
+    identical = sum(
+        1 for rid in plain_toks if spec_toks.get(rid) == plain_toks[rid]
+    )
+    return {
+        "trace": {
+            "n_requests": n_requests, "rate_hz": rate_hz, "seed": seed,
+            "motif_range": list(motif_range),
+            "prompt_len_range": list(prompt_len_range),
+            "max_new_range": list(max_new_range),
+        },
+        "spec_k": spec_k,
+        "paged": plain,
+        "paged_spec": spec,
+        "token_identity": identical / max(1, len(plain_toks)),
+        "tpot_ratio": (
+            spec["tpot_s"]["p50"] / plain["tpot_s"]["p50"]
+            if plain["tpot_s"]["p50"] else float("inf")
+        ),
+        "latency_ratio_p50": (
+            spec["latency_s"]["p50"] / plain["latency_s"]["p50"]
+            if plain["latency_s"]["p50"] else float("inf")
+        ),
+        "accept_rate": spec["spec"]["accept_rate"],
+    }
+
+
 def serve_bench(
     *,
     n_requests: int = 32,
@@ -2385,6 +2548,18 @@ def build_parser() -> argparse.ArgumentParser:
                    help="with --shared-prefix: store the paged pool "
                         "int8 with per-block scale pages — halves KV "
                         "bytes/token (reported vs the fp32 pool)")
+    p.add_argument("--spec-decode", dest="spec_decode",
+                   action="store_true",
+                   help="bench: replay ONE lookup-friendly trace "
+                        "(repeated-motif prompts) through the plain "
+                        "paged engine AND the speculative-decoding "
+                        "engine (serve/spec.py prompt-lookup drafts + "
+                        "jitted k-token verify) — reports tpot_ratio, "
+                        "accept_rate, and token_identity (greedy "
+                        "streams must be bit-identical across arms)")
+    p.add_argument("--spec-k", dest="spec_k", type=int, default=4,
+                   help="with --spec-decode: drafted tokens per verify "
+                        "window")
     p.add_argument("--trace-out", "--trace_out", dest="trace_out",
                    default=None, metavar="PATH",
                    help="write a Chrome trace-event JSON of the request "
@@ -2625,6 +2800,30 @@ def main(argv=None) -> int:
                       f"{pf['kv_bytes_per_token']:.0f} vs f32 "
                       f"{report['kv_bytes_per_token_f32']:.0f} "
                       f"({report['kv_bytes_ratio']:.2f}x)")
+        return 0
+    if args.spec_decode:
+        report = spec_decode_bench(
+            n_requests=args.requests, rate_hz=args.rate,
+            max_slots=args.max_slots, block_size=args.block_size,
+            spec_k=args.spec_k, seed=args.seed,
+            **({"decode_burst": args.decode_burst}
+               if args.decode_burst is not None else {}),
+        )
+        if args.json:
+            print(json.dumps(report))
+        else:
+            pl, sp = report["paged"], report["paged_spec"]
+            print(f"[spec_decode_bench] {args.requests} requests @ "
+                  f"{args.rate}/s, spec_k {report['spec_k']}")
+            for r in (pl, sp):
+                print(f"  {r['mode']:>12}: {r['tokens_per_sec']:8.1f} "
+                      f"tok/s  tpot p50 {r['tpot_s']['p50'] * 1e3:6.2f} "
+                      f"ms  latency p50 "
+                      f"{r['latency_s']['p50'] * 1e3:7.1f} ms")
+            print(f"  spec/paged tpot: {report['tpot_ratio']:.2f}x  "
+                  f"latency p50: {report['latency_ratio_p50']:.2f}x  "
+                  f"accept rate {report['accept_rate']:.2f}  "
+                  f"token identity {report['token_identity']:.2f}")
         return 0
     if args.procs and args.otlp_push_overhead:
         report = fleet_otlp_push_bench(

@@ -57,8 +57,10 @@ from ddp_practice_tpu.serve.kv_pages import (
     RadixPrefixCache,
     copy_block,
     make_paged_cache,
+    rewind_block_tail,
     scatter_prompt_blocks,
 )
+from ddp_practice_tpu.serve.spec import DraftSource, PromptLookupDraft
 from ddp_practice_tpu.serve.kv_slots import (
     SlotAllocator,
     set_cursor,
@@ -120,6 +122,21 @@ class EngineConfig:
     # `_prefix_prefill`, not the scratch+scatter pair — greedy tokens
     # stay equivalent (RoPE; pinned in tests/test_serve_equivalence.py).
     prefix_cache: bool = False
+    # ---- speculative decoding (PagedEngine only, greedy only) ----
+    # draft-free speculation (serve/spec.py): a host-side prompt-lookup
+    # drafter proposes up to spec_k tokens per slot and ONE jitted
+    # verify dispatch (`step_verify`) scores the whole window — a short
+    # paged prefill — accepting the longest prefix that matches the
+    # model's own argmaxes plus one corrected token. Greedy-exact:
+    # emitted tokens are what plain decode would have produced, so this
+    # is purely a latency lever. Requires temperature == 0.0 (exact
+    # acceptance IS greedy string matching).
+    spec_decode: bool = False
+    # drafted window length per verify dispatch (tokens per proposal)
+    spec_k: int = 4
+    # prompt-lookup n-gram match lengths, tried longest-first
+    spec_ngram_max: int = 3
+    spec_ngram_min: int = 1
 
 
 def _sample_step(cfg: EngineConfig, last_logits, active, keys):
@@ -154,6 +171,33 @@ def _decode_donate(pool_argnum: int = 1) -> tuple:
     return (pool_argnum,) if jax.default_backend() == "tpu" else ()
 
 
+_CPU_DISPATCH_BARRIER = None
+
+
+def _await_dispatch(*state) -> None:
+    """Block until a dispatch's outputs are fully materialized — CPU
+    backend only.
+
+    XLA:CPU's thunk runtime can report a dispatch's small outputs
+    (tokens, logits) ready while writes into the big cache buffers are
+    still in flight; chaining the next dispatch off that state races
+    the tail of the previous one, and the corrupted reads flip near-tie
+    argmaxes run to run. One barrier per dispatch restores
+    bit-determinism — every token-identity pin and bench identity gate
+    in this repo relies on it. (Empirically: fresh engines replaying
+    the same trace diverged with logit deltas of O(0.1-1), far beyond
+    FP reassociation noise, and a block_until_ready on the dispatch
+    state makes the divergence vanish.) On TPU execution is
+    stream-ordered per core, so the barrier would only break dispatch
+    pipelining — skip it.
+    """
+    global _CPU_DISPATCH_BARRIER
+    if _CPU_DISPATCH_BARRIER is None:
+        _CPU_DISPATCH_BARRIER = jax.default_backend() == "cpu"
+    if _CPU_DISPATCH_BARRIER:
+        jax.block_until_ready(state)
+
+
 def warm_engine(engine, widths=None) -> None:
     """Compile an engine's programs outside any timed/traced window:
     one admit per bucket width in play + one decode burst, then release
@@ -169,6 +213,22 @@ def warm_engine(engine, widths=None) -> None:
                             max_positions=engine.config.decode_burst)
         engine.step_burst()
         engine.release(slot)
+    if getattr(engine, "drafter", None) is not None:
+        # speculation on: the verify program is a THIRD compile that
+        # must also land outside the timed/traced window. An all-ones
+        # prompt makes the lookup drafter propose a full window (every
+        # trailing n-gram recurs), so the real verify shape compiles.
+        slot = engine.admit([1] * engine.buckets[0],
+                            max_positions=engine.config.spec_k + 1)
+        drafts, draft_lens, _ = engine.propose_drafts()
+        engine.step_verify(drafts, draft_lens)
+        engine.release(slot)
+        # the warm dispatch must not pollute the metrics plane: flight
+        # records and the delta-exported counters both reconcile against
+        # these cumulative fields, and warmup tokens belong to no request
+        engine.spec_drafted_tokens = 0
+        engine.spec_accepted_tokens = 0
+        engine.spec_dispatches = 0
     engine.reset_epoch()
 
 
@@ -267,6 +327,12 @@ class SlotEngine(_EngineBase):
             )
         if not config.prompt_buckets:
             raise ValueError("prompt_buckets must be non-empty")
+        if config.spec_decode:
+            raise ValueError(
+                "spec_decode needs PagedEngine — the verify window is a "
+                "paged prefill through per-slot page tables, which the "
+                "shared-cursor slot pool cannot express"
+            )
         self.model = model
         self.params = params
         self.batch_stats = batch_stats
@@ -438,6 +504,8 @@ class SlotEngine(_EngineBase):
                 jnp.asarray(padded), jnp.int32(start),
                 jnp.int32(self.cursor - p), jnp.int32(slot),
             )
+            _await_dispatch(self._cache, self._last_logits,
+                            self._attn_starts)
         # keyed by the REQUEST's seed alone (not the slot), so a
         # request's sampled tokens are independent of where admission
         # happened to place it — batch composition stays invisible
@@ -481,6 +549,7 @@ class SlotEngine(_EngineBase):
                 self._attn_starts,
                 jnp.asarray(self._active), self._keys,
             )
+            _await_dispatch(self._cache, self._last_logits, self._keys)
             self.cursor += k
             toks, finite = jax.device_get((toks, finite))
         self.burst_seq += 1
@@ -574,7 +643,8 @@ class PagedEngine(_EngineBase):
     """
 
     def __init__(self, model, params, config: EngineConfig = EngineConfig(),
-                 *, batch_stats: Any = None) -> None:
+                 *, batch_stats: Any = None,
+                 draft_source: Optional[DraftSource] = None) -> None:
         if getattr(model, "pos_emb", None) != "rope":
             raise ValueError(
                 "PagedEngine needs pos_emb='rope' — slots decode at "
@@ -587,6 +657,15 @@ class PagedEngine(_EngineBase):
             raise ValueError("decode_burst must be >= 1")
         if config.block_size < 1:
             raise ValueError("block_size must be positive")
+        if config.spec_decode:
+            if config.temperature != 0.0:
+                raise ValueError(
+                    "spec_decode needs temperature=0.0 — exact "
+                    "acceptance is greedy string matching against the "
+                    "model's own argmaxes (serve/spec.py)"
+                )
+            if config.spec_k < 1:
+                raise ValueError("spec_k must be >= 1")
         self.model = model
         self.params = params
         self.batch_stats = batch_stats
@@ -631,9 +710,31 @@ class PagedEngine(_EngineBase):
         self.preemptions = 0         # cumulative (metrics export)
         self.last_finite = np.ones((1, s), bool)
         self._slot_trace: dict = {}  # slot -> trace_id (tracer attached)
+        # speculative decoding (serve/spec.py): the host-side drafter
+        # tracks every slot's context; its proposals feed step_verify.
+        # Cumulative counters are the metrics-plane observable
+        # (delta-exported by serve/metrics.py, same idiom as
+        # `preemptions`).
+        if config.spec_decode:
+            self.drafter: Optional[DraftSource] = (
+                draft_source if draft_source is not None
+                else PromptLookupDraft(config.spec_ngram_max,
+                                       config.spec_ngram_min)
+            )
+        else:
+            self.drafter = None
+        self.spec_drafted_tokens = 0
+        self.spec_accepted_tokens = 0
+        self.spec_dispatches = 0
         self._prefill_jit = jax.jit(self._prefill_admit)
         self._decode_jit = jax.jit(
             self._decode_burst, donate_argnums=_decode_donate()
+        )
+        # the verify program (speculative decoding): one compile for the
+        # (max_slots, spec_k) window shape, always in compile_stats so
+        # the churn pins cover it even before the first dispatch
+        self._verify_jit = jax.jit(
+            self._verify, donate_argnums=_decode_donate()
         )
         # prefix-mode suffix prefill (one compile per suffix bucket) and
         # the copy-on-write block split (one compile, ever) — both in
@@ -726,6 +827,83 @@ class PagedEngine(_EngineBase):
             length=self.config.decode_burst,
         )
         return pool, last_logits, toks, keys, finite
+
+    def _verify(self, params, pool, last_logits, attn_starts, active,
+                drafts, draft_lens, page_table, lengths):
+        """Speculative verify: score a k-token drafted window in ONE
+        forward, accept greedily, append one corrected token.
+
+        `drafts` (max_slots, k) are the drafter's proposals for each
+        slot's next positions, `draft_lens` how many are real. The
+        window forward is a paged PREFILL at positions
+        `lengths[b] + [0, k)` (models/vit.py s>1 paged path — the same
+        program shape as prefix-cache suffix admission), writing the
+        drafted tokens' K/V through the page table.
+
+        Acceptance is exact: stack the carried next-token logits in
+        front of the window logits — row i of the stack predicts the
+        token at position lengths+i — and take `g = argmax` (the very
+        op plain greedy decode runs, inference.sample_logits). Draft
+        token i is accepted iff it equals g[:, i] AND every earlier
+        draft matched (cumprod); with m accepted, the emitted run is
+        `g[:, :m+1]`: the m accepted drafts (which ARE the leading
+        argmaxes) plus the model's own token at the first divergence —
+        or the bonus token after a fully-accepted window. A final
+        fused s=1 decode step writes that correction token's K/V at
+        the per-slot position `lengths + m` — overwriting the rejected
+        draft's K/V row — and carries its logits as the next sampling
+        input.
+
+        Rollback is positional, not a copy: rejected window positions
+        `lengths+m+1 .. lengths+k-1` hold garbage K/V inside the
+        slot's own blocks, invisible to attention (masked to
+        kv_lengths) and overwritten by whatever decodes there next;
+        the host side rewinds `kv_lengths` to `lengths + m + 1` and
+        returns this dispatch's surplus grown blocks to the pool
+        (kv_pages.rewind_block_tail). Free slots ride along on the
+        garbage block as in `_decode_burst`.
+
+        Returns (pool, last_logits, g (s, k+1), accepted (s,),
+        finite (s, k+1)) — finite row i flags the logits token i was
+        argmaxed from, the scheduler's per-token "error" signal.
+        """
+        k = drafts.shape[1]
+        pool, win_logits = decode_apply(
+            self.model, params, pool, drafts,
+            attn_start=attn_starts, batch_stats=self.batch_stats,
+            page_table=page_table, kv_lengths=lengths,
+        )
+        all_logits = jnp.concatenate(
+            [last_logits[:, None], win_logits.astype(last_logits.dtype)],
+            axis=1,
+        )                                                   # (s, k+1, v)
+        g = sample_logits(all_logits, None, temperature=0.0)
+        g = g.astype(jnp.int32)                             # (s, k+1)
+        matches = (drafts == g[:, :k]) & (
+            jnp.arange(k, dtype=jnp.int32)[None, :] < draft_lens[:, None]
+        )
+        accepted = jnp.cumprod(
+            matches.astype(jnp.int32), axis=1
+        ).sum(axis=1)                                       # (s,) in [0, k]
+        accepted = jnp.where(active, accepted, 0)
+        finite = jnp.isfinite(all_logits).all(axis=-1)      # (s, k+1)
+        correction = jnp.take_along_axis(g, accepted[:, None], axis=1)
+        correction = jnp.where(
+            active[:, None], correction, jnp.int32(self.config.pad_id)
+        )
+        pool, nxt_logits = decode_apply(
+            self.model, params, pool, correction,
+            attn_start=attn_starts, batch_stats=self.batch_stats,
+            page_table=page_table, kv_lengths=lengths + accepted,
+        )
+        last_logits = jnp.where(
+            active[:, None],
+            nxt_logits[:, -1].astype(last_logits.dtype), last_logits,
+        )
+        toks = jnp.where(
+            active[:, None], g, jnp.int32(self.config.pad_id)
+        )
+        return pool, last_logits, toks, accepted, finite
 
     # ----------------------------------------------------------------- host
     def _blocks_for(self, positions: int) -> int:
@@ -1021,6 +1199,7 @@ class PagedEngine(_EngineBase):
                     jnp.asarray(padded), jnp.int32(w - p),
                     jnp.asarray(ids, jnp.int32), jnp.int32(slot),
                 )
+                _await_dispatch(self._cache, self._last_logits)
         else:
             # prefix path: canonical positions, RIGHT-padded suffix
             # appended at `matched` through the page table; the hit's
@@ -1038,6 +1217,7 @@ class PagedEngine(_EngineBase):
                     jnp.asarray(self._pt[slot:slot + 1]),
                     jnp.int32(slot),
                 )
+                _await_dispatch(self._cache, self._last_logits)
             # publish this prompt's own full blocks for future hits
             # (already-cached chunks keep their existing node)
             n_full = p // bs
@@ -1049,6 +1229,11 @@ class PagedEngine(_EngineBase):
         # must stay invisible to the sample stream
         self._keys = self._keys.at[slot].set(jax.random.PRNGKey(seed))
         self._active[slot] = True
+        if self.drafter is not None:
+            # readmission after preemption passes prompt + salvaged
+            # tokens here, so the drafter's context is always the
+            # slot's true prefix — it never needs to survive a preempt
+            self.drafter.begin(slot, [int(t) for t in prompt])
         return slot
 
     def fork(self, slot: int, *, seed: Optional[int] = None,
@@ -1082,7 +1267,10 @@ class PagedEngine(_EngineBase):
             self._last_logits, self._keys, jnp.int32(slot),
             jnp.int32(child), key,
         )
+        _await_dispatch(self._last_logits, self._keys)
         self._active[child] = True
+        if self.drafter is not None:
+            self.drafter.begin(child, self.drafter.snapshot(slot))
         if trace_id is not None:
             self._slot_trace[child] = trace_id
         return child
@@ -1150,6 +1338,7 @@ class PagedEngine(_EngineBase):
                 self._cache = self._cow_jit(
                     self._cache, jnp.int32(b), jnp.int32(new)
                 )
+                _await_dispatch(self._cache)
                 self.blocks.free([b])     # drop this slot's ref
                 self._pt[slot, idx] = new
                 splits += 1
@@ -1188,12 +1377,112 @@ class PagedEngine(_EngineBase):
                 jnp.asarray(self._attn), jnp.asarray(self._active),
                 self._keys, jnp.asarray(self._pt), jnp.asarray(self._len),
             )
+            _await_dispatch(self._cache, self._last_logits, self._keys)
             self._len[self._active] += k
             toks, finite = jax.device_get((toks, finite))
         self.burst_seq += 1
         self.last_burst_active = int(np.count_nonzero(self._active))
         self.last_finite = np.asarray(finite)
-        return np.asarray(toks)
+        toks = np.asarray(toks)
+        if self.drafter is not None:
+            # plain-burst tokens grow the drafter's context too — a tick
+            # without proposals must not blind the next one
+            for slot in np.flatnonzero(self._active):
+                self.drafter.extend(int(slot), toks[:, slot].tolist())
+        return toks
+
+    # ------------------------------------------------- speculative decoding
+    def propose_drafts(self):
+        """Ask the drafter for every active slot's next-token proposals
+        (host-pure, microseconds). Returns (drafts (max_slots, spec_k)
+        int32, draft_lens (max_slots,) int32, any_drafted bool) — the
+        scheduler dispatches `step_verify` when any slot drafted and
+        falls back to `step_burst` otherwise (both greedy-exact, so the
+        choice is invisible in the token stream)."""
+        if self.drafter is None:
+            raise RuntimeError("propose_drafts needs spec_decode=True")
+        k = self.config.spec_k
+        drafts = np.zeros((self.config.max_slots, k), np.int32)
+        lens = np.zeros((self.config.max_slots,), np.int32)
+        for slot in np.flatnonzero(self._active):
+            d = self.drafter.propose(int(slot), k)
+            if d:
+                drafts[slot, :len(d)] = d
+                lens[slot] = len(d)
+        return drafts, lens, bool(lens.any())
+
+    def step_verify(self, drafts: np.ndarray,
+                    draft_lens: np.ndarray) -> tuple:
+        """One verify dispatch over a drafted window (`_verify` for the
+        program; this is its host half). Returns (tokens, counts,
+        finite): tokens (spec_k+1, max_slots) row-major like a burst,
+        counts (max_slots,) how many leading rows are REAL for each
+        slot (accepted + 1 correction; 0 for inactive slots), finite
+        (spec_k+1, max_slots) per-token flags.
+
+        Per-slot lengths advance by counts — a slot whose whole draft
+        was rejected still nets one real token (the correction IS the
+        plain greedy token), so a verify dispatch never loses ground
+        to a burst. Growth covers the worst case (spec_k + 1
+        positions) up front and the rejected tail's surplus blocks are
+        returned to the pool after the dispatch — speculation holds
+        blocks only for tokens it actually kept."""
+        if self.drafter is None:
+            raise RuntimeError("step_verify needs spec_decode=True")
+        k = int(drafts.shape[1])
+        nblk_before = self._nblk.copy()
+        grown = self._grow_tables(k + 1)
+        splits = self._cow_split(k + 1)
+        tr = self.tracer
+        if tr is not None and tr.enabled:
+            ids = self._dispatch_ids()
+            span = tr.span("verify", pid=self.replica,
+                           tid=ENGINE_LANE, k=k, active=len(ids),
+                           drafted=int(draft_lens.sum()),
+                           blocks_grown=grown, cow_splits=splits,
+                           sampled_only=True)
+            ann = jax.profiler.TraceAnnotation(
+                "serve:verify[" + ",".join(ids) + "]"
+            )
+        else:
+            span = ann = _NULL
+        with span, ann:
+            (self._cache, self._last_logits, toks,
+             accepted, finite) = self._verify_jit(
+                self.params, self._cache, self._last_logits,
+                jnp.asarray(self._attn), jnp.asarray(self._active),
+                jnp.asarray(drafts), jnp.asarray(draft_lens),
+                jnp.asarray(self._pt), jnp.asarray(self._len),
+            )
+            _await_dispatch(self._cache, self._last_logits)
+            toks, accepted, finite = jax.device_get(
+                (toks, accepted, finite)
+            )
+        accepted = np.asarray(accepted)
+        counts = np.where(self._active, accepted + 1, 0).astype(np.int64)
+        self._len[self._active] += counts[self._active].astype(np.int32)
+        # rollback, block half: surplus blocks grown for the rejected
+        # tail (provably this dispatch's own fresh allocations — the
+        # floor never dips below the pre-grow table) go back to the pool
+        for slot in np.flatnonzero(self._active):
+            floor = max(self._blocks_for(int(self._len[slot])),
+                        int(nblk_before[slot]))
+            self._nblk[slot] = rewind_block_tail(
+                self.blocks, self._pt[slot], int(self._nblk[slot]), floor
+            )
+        self.spec_drafted_tokens += int(draft_lens[self._active].sum())
+        self.spec_accepted_tokens += int(accepted[self._active].sum())
+        self.spec_dispatches += 1
+        self.burst_seq += 1
+        self.last_burst_active = int(np.count_nonzero(self._active))
+        toks = np.asarray(toks).T          # (k+1, max_slots) row-major
+        finite = np.asarray(finite).T
+        self.last_finite = finite
+        if self.drafter is not None:
+            for slot in np.flatnonzero(self._active):
+                n = int(counts[slot])
+                self.drafter.extend(int(slot), toks[:n, slot].tolist())
+        return toks, counts, finite
 
     def context_len(self, slot: int) -> int:
         """The slot's current context length (prompt span + decoded
@@ -1206,20 +1495,24 @@ class PagedEngine(_EngineBase):
         self._last_logits = self._last_logits.at[slot].set(jnp.nan)
 
     def compile_stats(self) -> dict:
-        """The two PR-3 programs plus the PR-6 admission paths — all
-        four counters must stay flat under churn (prefix hits, CoW
-        splits, preempt/readmit included; conftest `compile_guard`)."""
+        """The two PR-3 programs plus the PR-6 admission paths plus the
+        speculative verify program — all five counters must stay flat
+        under churn (prefix hits, CoW splits, preempt/readmit, verify
+        dispatches included; conftest `compile_guard`)."""
         return {
             "prefill_compiles": self._prefill_jit._cache_size(),
             "decode_compiles": self._decode_jit._cache_size(),
             "prefix_prefill_compiles": self._prefix_jit._cache_size(),
             "cow_compiles": self._cow_jit._cache_size(),
+            "verify_compiles": self._verify_jit._cache_size(),
         }
 
     def _clear_slot(self, slot: int) -> None:
         n = int(self._nblk[slot])
         if n:
             self.blocks.free([int(b) for b in self._pt[slot, :n]])
+        if self.drafter is not None:
+            self.drafter.end(slot)
         self.allocator.free(slot)
         self._pt[slot, :] = 0
         self._nblk[slot] = 0

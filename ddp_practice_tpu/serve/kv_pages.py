@@ -487,6 +487,30 @@ def scatter_prompt_blocks(pool: Any, scratch: Any, block_ids,
     return jax.tree_util.tree_map_with_path(per_leaf, pool, scratch)
 
 
+def rewind_block_tail(blocks: BlockAllocator, table_row, nblk: int,
+                      floor: int) -> int:
+    """Return a page-table row's tail blocks [floor, nblk) to the pool —
+    the block half of a length rewind. Speculative verify
+    (serve/engine.py step_verify) grows every slot for the worst case
+    (`spec_k + 1` positions) before it knows how much of the draft the
+    model accepts; after acceptance the rejected tail's positions no
+    longer exist, so the blocks grown ONLY for them come straight back.
+    The caller picks `floor` so it never dips below the pre-grow table
+    (freed blocks are then provably this dispatch's own fresh
+    refcount-1 allocations — a shared prefix/fork block can never be in
+    the tail). Freed table entries are pointed back at the garbage
+    block, keeping the batched dispatch's static shapes safe. Returns
+    the new block count (== max(floor, min(nblk, floor)) — i.e. floor,
+    or nblk unchanged when there is no tail)."""
+    if nblk <= floor:
+        return nblk
+    tail = [int(b) for b in table_row[floor:nblk]]
+    assert GARBAGE_BLOCK not in tail, "garbage block in a live tail"
+    blocks.free(tail)
+    table_row[floor:nblk] = GARBAGE_BLOCK
+    return floor
+
+
 def copy_block(pool: Any, src, dst) -> Any:
     """Copy one pool block (every non-scalar leaf row `src` -> `dst`) —
     the copy-on-write primitive: a slot about to write into a SHARED

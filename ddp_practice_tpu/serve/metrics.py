@@ -54,6 +54,14 @@ class ServeMetrics:
         self.prefix_miss_tokens = r.counter("prefix_cache_miss_tokens_total")
         self.preemptions = r.counter("preemptions_total")
         self._last_hit = self._last_miss = self._last_preempt = 0
+        # speculative decoding observables (serve/spec.py): drafted vs
+        # accepted token counters, exported as deltas from the engine's
+        # cumulative fields each tick. The fleet-wide accept rate is
+        # accepted/drafted over any scrape window; per-request accept
+        # rates live in flight records, not here.
+        self.spec_drafted = r.counter("spec_drafted_tokens_total")
+        self.spec_accepted = r.counter("spec_accepted_tokens_total")
+        self._last_drafted = self._last_accepted = 0
         self.tokens_total = r.counter("serve_tokens_total")
         self.submitted = r.counter("serve_requests_submitted")
 
@@ -93,6 +101,12 @@ class ServeMetrics:
                 )
                 self._last_hit = radix.hit_tokens
                 self._last_miss = radix.miss_tokens
+        drafted = getattr(eng, "spec_drafted_tokens", 0)
+        accepted = getattr(eng, "spec_accepted_tokens", 0)
+        self.spec_drafted.inc(drafted - self._last_drafted)
+        self.spec_accepted.inc(accepted - self._last_accepted)
+        self._last_drafted = drafted
+        self._last_accepted = accepted
 
     def on_complete(self, completion, scheduler) -> None:
         self.registry.counter(f"serve_requests_{completion.status}").inc()

@@ -129,6 +129,11 @@ class _Tracked:
     queue_s: float = 0.0
     prefill_s: float = 0.0
     decode_s: float = 0.0
+    # speculative-decoding tallies summed the same way: a failover
+    # mid-request keeps the dead attempt's drafted/accepted counts, so
+    # the merged accept rate reflects the whole request
+    spec_drafted: int = 0
+    spec_accepted: int = 0
     # streaming splice point: len(prefix) at the CURRENT dispatch — a
     # chunk's attempt-local `start` plus this base is its absolute
     # offset in the client's output (the dedup key after failover)
@@ -748,6 +753,8 @@ class Router:
                 tr.queue_s += c.flight["queue_s"]
                 tr.prefill_s += c.flight["prefill_s"]
                 tr.decode_s += c.flight["decode_s"]
+                tr.spec_drafted += c.flight.get("spec_drafted", 0)
+                tr.spec_accepted += c.flight.get("spec_accepted", 0)
             if tr.first_token_time is None and c.ttft is not None:
                 tr.first_token_time = tr.req.arrival + c.ttft
             if c.status in ("eos", "length"):
@@ -897,6 +904,10 @@ class Router:
             ),
             "retries": tr.retries, "failovers": tr.failovers,
         }
+        if tr.spec_drafted:
+            flight["spec_drafted"] = tr.spec_drafted
+            flight["spec_accepted"] = tr.spec_accepted
+            flight["spec_accept_rate"] = tr.spec_accepted / tr.spec_drafted
         st = self.streams.get(req.rid)
         if st is not None and not st.closed:
             # flush the authoritative tail (tokens the completion holds

@@ -275,6 +275,20 @@ class Scheduler:
         self.chunks: List[TokenChunk] = []
         self._chunk_seq: Dict[int, int] = {}  # rid -> next chunk seq
         self._admit_counter = 0
+        # speculative decoding (serve/spec.py + engine.step_verify): a
+        # spec-enabled engine carries a drafter; ticks where any slot
+        # has a proposal dispatch the verify program instead of a
+        # plain burst (both greedy-exact — the choice never shows in
+        # the token stream). `_spec_k` also widens every admission's
+        # position budget: verify grows a slot for the worst case
+        # (spec_k + 1 positions) before acceptance is known.
+        self._spec_k = (engine.config.spec_k
+                        if getattr(engine, "drafter", None) is not None
+                        else 0)
+        # rid -> [drafted, accepted] cumulative across this request's
+        # verify dispatches (rid-keyed, so preemption/readmission keeps
+        # accumulating); popped into the completion's flight record
+        self._spec_stats: Dict[int, list] = {}
         # preempted-request resume state (PagedEngine block-aware
         # preemption): rid -> {"orig": the ORIGINAL request, "prefix":
         # tokens generated before the eviction, "ftt": their first-token
@@ -382,6 +396,14 @@ class Scheduler:
         total = now - req.arrival
         flight["stall_s"] = max(0.0, total - sum(flight.values()))
         flight["retries"] = flight["failovers"] = 0
+        spec = self._spec_stats.pop(req.rid, None)
+        if spec is not None:
+            # after the stall_s residual — these are token counts, not
+            # latency phases, and must not skew the phase sum
+            flight["spec_drafted"] = spec[0]
+            flight["spec_accepted"] = spec[1]
+            if spec[0] > 0:
+                flight["spec_accept_rate"] = spec[1] / spec[0]
         c = Completion(
             rid=req.rid, tokens=tokens, status=status,
             arrival=req.arrival, finish=now, ttft=ttft, tpot=tpot,
@@ -461,8 +483,7 @@ class Scheduler:
                else st.first_token_time)
         new_prompt = list(orig.prompt) + prefix
         remaining = orig.max_new_tokens - len(prefix)
-        burst = self.engine.config.decode_burst
-        needed = -(-max(remaining, 1) // burst) * burst
+        needed = self._needed_positions(remaining)
         if prefix and self.engine.admit_gate(
                 len(new_prompt), needed, prompt=new_prompt) == "never":
             prefix, ftt = [], None
@@ -537,15 +558,26 @@ class Scheduler:
         return eng.preempt_headroom(fair, len(req.prompt),
                                     prompt=req.prompt)
 
+    def _needed_positions(self, max_new: int) -> int:
+        """A request's decode-position budget: burst-granular (a request
+        finishing mid-burst still rides to the burst boundary), plus —
+        with speculation on — the verify program's worst-case slack:
+        `step_verify` grows a slot for spec_k + 1 positions before
+        knowing how much of the draft the model accepts, so the
+        admit-time block budget must cover the final dispatch's
+        overshoot (the rejected tail's blocks come straight back)."""
+        burst = self.engine.config.decode_burst
+        needed = -(-max(max_new, 1) // burst) * burst
+        if self._spec_k:
+            needed += self._spec_k + 1
+        return needed
+
     def _admit(self) -> None:
         eng = self.engine
-        burst = eng.config.decode_burst
         tr = self.tracer
         while self.queue and eng.num_free > 0:
             req = self.queue[0]
-            # positions consumed are burst-granular: a request finishing
-            # mid-burst still rides to the burst boundary
-            needed = -(-req.max_new_tokens // burst) * burst
+            needed = self._needed_positions(req.max_new_tokens)
             # memory policy is the ENGINE's: the slot engine gates on
             # global cursor headroom (make_room = drain + epoch rewind),
             # the paged engine on free + prefix-cache-evictable blocks
@@ -656,17 +688,50 @@ class Scheduler:
         self._expire_queue()
         self._admit()
         if self.running:
-            burst = self.engine.step_burst()  # (K, max_slots)
-            finite = self.engine.last_finite  # (K, max_slots)
+            eng = self.engine
+            counts = None
+            drafted = None
+            if self._spec_k:
+                drafts, draft_lens, any_drafted = eng.propose_drafts()
+                if any_drafted:
+                    drafted = (drafts, draft_lens)
+            if drafted is None:
+                # no slot has a proposal this tick (or speculation is
+                # off): plain burst — greedy-identical to a verify of
+                # empty drafts, minus the wasted window forward
+                burst = eng.step_burst()      # (K, max_slots)
+                finite = eng.last_finite      # (K, max_slots)
+            else:
+                # verify dispatch: rows are the accepted run + one
+                # correction token; row r of a slot is real iff
+                # r < counts[slot]
+                burst, counts, finite = eng.step_verify(*drafted)
             # block-aware preemption: slots the engine evicted BEFORE
             # this dispatch produced no tokens this burst — requeue
             # their requests (front) before mapping token rows
             self._drain_preempted()
+            if counts is not None:
+                # accept accounting BEFORE the row loop, so a request
+                # finishing mid-run still books its last dispatch.
+                # Every slot still running was active at dispatch, so
+                # counts >= 1 (accepted = counts - 1).
+                for slot, st in self.running.items():
+                    stats = self._spec_stats.setdefault(
+                        st.req.rid, [0, 0])
+                    stats[0] += int(drafted[1][slot])
+                    stats[1] += int(counts[slot]) - 1
             eos = self.engine.config.eos_id
             for k, row in enumerate(burst):
+                if not self.running:
+                    break  # the rest of the burst is free-slot padding
+                if counts is not None and all(
+                        k >= int(counts[s]) for s in self.running):
+                    break  # every remaining run ended before this row
                 self.clock.tick()
                 now = self.clock.now()
                 for slot, st in list(self.running.items()):
+                    if counts is not None and k >= int(counts[slot]):
+                        continue  # this slot's verified run was shorter
                     if not finite[k, slot]:
                         # this row's token was sampled from non-finite
                         # logits: poison ONE request, not the batch — the
@@ -705,8 +770,6 @@ class Scheduler:
                             admitted=(st.admit_t0, st.admit_t1),
                             chunked=st.chunk_base + st.emitted,
                         )
-                if not self.running:
-                    break  # the rest of the burst is free-slot padding
             if self.stream:
                 # one TokenChunk per still-running request per burst:
                 # the tokens this tick produced, stamped with their
@@ -793,11 +856,14 @@ class Scheduler:
         # every live rid is in queue/running, so their _resume entries
         # (already folded into the snapshot) go with them — and their
         # chunk seq counters: evacuated attempts never reach a final
-        # chunk, and the router re-dispatches under a fresh attempt
+        # chunk, and the router re-dispatches under a fresh attempt.
+        # Accept stats die with the attempt too: the surviving
+        # replica's verify dispatches start the rid's count fresh.
         self._resume.clear()
         self.running.clear()
         self.queue.clear()
         self._chunk_seq.clear()
+        self._spec_stats.clear()
         return out
 
     @property

@@ -92,6 +92,12 @@ class WorkerSpec:
     # together, so the router's resume cursor never outruns delivery).
     # False = end-of-request delivery (the overhead bench's control).
     stream: bool = True
+    # speculative decoding (serve/spec.py): first-class spec fields so
+    # fleet launchers can flip the feature without knowing EngineConfig
+    # internals; folded into the engine kwargs at build time. Only
+    # meaningful for paged workers (the SlotEngine refuses it).
+    spec_decode: bool = False
+    spec_k: int = 4
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self))
@@ -183,6 +189,9 @@ class WorkerServer:
         paged = bool(eng_kw.pop("paged", False))
         if "prompt_buckets" in eng_kw:
             eng_kw["prompt_buckets"] = tuple(eng_kw["prompt_buckets"])
+        if spec.spec_decode:
+            eng_kw.setdefault("spec_decode", True)
+            eng_kw.setdefault("spec_k", spec.spec_k)
         cfg = EngineConfig(**eng_kw)
         engine_cls = PagedEngine if paged else SlotEngine
         self.engine = engine_cls(model, params, cfg)

@@ -71,6 +71,12 @@ class FlightStats:
     """
 
     PHASES = ("queue_s", "prefill_s", "decode_s", "stall_s")
+    # non-phase flight fields summarized the same way; kept separate
+    # from PHASES so phase-sum invariants elsewhere stay honest.
+    # spec_accept_rate only appears on flights that actually drafted
+    # (speculative decoding, serve/spec.py) — the `key in f` guards
+    # below make mixed windows work.
+    EXTRAS = ("spec_accept_rate",)
     # raw samples shipped per report, newest last, for fleet rollup
     # (ScrapeFederator.flight pools every worker's samples and
     # recomputes TRUE fleet percentiles — percentiles of percentiles
@@ -114,7 +120,7 @@ class FlightStats:
             ttft = list(self._ttft)
             tpot = list(self._tpot)
         out: dict = {"window": len(flights)}
-        for key in self.PHASES:
+        for key in self.PHASES + self.EXTRAS:
             out[key] = percentile_summary(
                 [f[key] for f in flights if key in f]
             )
@@ -134,7 +140,7 @@ class FlightStats:
         cap = self.SAMPLES_PER_REPORT
         samples = {"ttft_s": [v for v, _ in ttft[-cap:]],
                    "tpot_s": [v for v, _ in tpot[-cap:]]}
-        for key in self.PHASES:
+        for key in self.PHASES + self.EXTRAS:
             samples[key] = [f[key] for f in flights[-cap:] if key in f]
         out["samples"] = samples
         return out

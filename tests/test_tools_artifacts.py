@@ -262,6 +262,12 @@ def test_check_bench_exit_codes_both_ways(tmp_path):
     # blown push overhead and a controller that missed its ±20% budget
     assert "otlp_push_overhead_100rps.mean_ratio" in r.stdout
     assert "adaptive_sampling_100rps.within_budget" in r.stdout
+    # the ISSUE-13 spec-decode gates regress in the same ledger: an
+    # evaporated TPOT win and one divergent stream — token identity
+    # is an absolute contract (baseline 1.0, tol 0), so the planted
+    # 31/32 identity must fail, not drift
+    assert "spec_decode_8rps.tpot_ratio" in r.stdout
+    assert "spec_decode_8rps.token_identity" in r.stdout
     # unreadable input is exit 2, not a fake verdict
     garbage = tmp_path / "garbage.json"
     garbage.write_text("{broken")
