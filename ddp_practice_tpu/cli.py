@@ -56,6 +56,15 @@ Examples:
                                        # keep their own sampling floor;
                                        # tail keeps (faults, failovers)
                                        # stay tenant-blind
+  python -m ddp_practice_tpu.cli serve --procs 3 --autoscale --rate 25
+                                       # ELASTIC fleet vs the peak-
+                                       # provisioned fixed arm through a
+                                       # 4x arrival step: trip-fast scale
+                                       # up from a pre-warmed standby
+                                       # (ms, not ~15 s), resolve-slow
+                                       # drain back down; gates goodput/
+                                       # worker-second, reaction time,
+                                       # zero lost, oscillation bound
 """
 
 from __future__ import annotations

@@ -1,0 +1,544 @@
+"""Elastic fleet: SLO-driven autoscaling with pre-warmed standbys.
+
+ROADMAP item 4 said it outright: all the sensors and actuators exist —
+close the loop. The sensors are the federated fleet view (queue depth,
+occupancy, heartbeat ages) and serve/slo.py's multi-window burn rates;
+the actuators are the supervisor's spawn pipeline and the PR-9 SIGTERM
+drain. This module is the loop:
+
+- **AutoscalerPolicy** — the host-pure controller. Trip FAST on SLO
+  burn or queue pressure to scale UP; resolve SLOW (a continuous calm
+  window on top of the slow burn window) to scale DOWN; a deadband
+  between the two thresholds where nothing moves. The no-oscillation
+  contract is the same one utils/trace.py's AdaptiveHeadRateController
+  pins: after any scale event, an event in the OPPOSITE direction is
+  forbidden for `hold_s` — so direction reversals are at least `hold_s`
+  apart BY CONSTRUCTION (at most elapsed/hold_s reversals, ever), and
+  per-direction cooldowns pace same-direction steps on top. Every
+  input is an argument and time is a parameter, so FakeClock pins can
+  replay every transition.
+
+- **StandbyPool** — workers spawned AHEAD of demand. The measured
+  ~15 s jax-import+warm spawn cost makes reactive cold scaling useless
+  (the burst is over before the replica exists); the pool keeps
+  `standby_target` workers warm-before-READY, so promotion is a probe
+  plus a dispatch join — milliseconds. One background thread spawns
+  serially (a spawn is expensive; two at once would starve the fleet),
+  replenishing after each take; every child rides the module-level
+  atexit registry in serve/supervisor.py, so a pooled standby can no
+  more leak than a fleet worker can.
+
+- **Autoscaler** — the orchestrator the Router ticks (router.step ->
+  autoscaler.step, right after the SLO pass so burn rates are fresh).
+  `grow` promotes a standby via `Supervisor.grow(spec, worker=...)`
+  (falling back to the cold spawn pipeline when the pool is empty) and
+  joins the new RemoteReplicaHandle into the router with the same
+  breaker arming __init__ applies. `shrink` drains the newest RUNNING
+  slot via the PR-9 SIGTERM path — refuse new submits, finish
+  in-flight streams — and retires the handle once the process exits;
+  if chaos SIGKILLs the draining worker mid-scale-down, the handle's
+  normal death path salvages and fails over, so the exactly-once
+  stream contract (check_stream: 0 lost / 0 dup) holds either way.
+
+Scale events ride the observability plane whole: tracer instants with
+trigger attrs, `fleet_size` / `standby_ready` gauges and the
+`scale_events_total{direction,trigger}` ledger (serve/metrics.py),
+and alert-sink edges with scope "autoscale".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Callable, Dict, List, Optional
+
+from ddp_practice_tpu.serve.router import ROUTER_PID
+from ddp_practice_tpu.serve.scheduler import MonotonicClock
+from ddp_practice_tpu.serve.supervisor import (
+    RUNNING,
+    STOPPED,
+    RemoteReplicaHandle,
+    Supervisor,
+    spawn_worker,
+)
+from ddp_practice_tpu.serve.worker import WorkerSpec
+
+
+# ------------------------------------------------------------------ policy
+@dataclasses.dataclass(frozen=True)
+class AutoscalerConfig:
+    min_size: int = 1
+    max_size: int = 4
+    # policy evaluation spacing — the "one evaluation window" the
+    # acceptance pin measures reaction time against
+    eval_interval_s: float = 1.0
+    # fleet pressure = demand / decode slots. Above `up_pressure` the
+    # queue is outrunning the fleet (brownout_on territory — grow
+    # instead of shedding); below `down_pressure` a replica is idle
+    # weight. Between them is the DEADBAND: nothing moves.
+    up_pressure: float = 1.5
+    down_pressure: float = 0.5
+    # no-reversal window: after ANY scale event, no event in the
+    # OPPOSITE direction for this long (the anti-oscillation contract)
+    hold_s: float = 10.0
+    # per-direction pacing for CONSECUTIVE same-direction events:
+    # trip fast (short up cooldown), resolve slow (long down cooldown)
+    cooldown_up_s: float = 2.0
+    cooldown_down_s: float = 15.0
+    # scale-down additionally requires the calm signal (low pressure,
+    # slow burn resolved) to have held CONTINUOUSLY this long — one
+    # quiet sample between bursts is noise, not calm
+    down_stable_s: float = 5.0
+    # warm standbys the pool keeps ahead of demand
+    standby_target: int = 1
+
+    def __post_init__(self):
+        if self.min_size < 1:
+            raise ValueError("min_size must be >= 1")
+        if self.max_size < self.min_size:
+            raise ValueError("max_size must be >= min_size")
+        if self.down_pressure >= self.up_pressure:
+            raise ValueError(
+                "down_pressure must be < up_pressure (the deadband)"
+            )
+
+
+class AutoscalerPolicy:
+    """The host-pure control law: inputs in, at most one decision out.
+
+    `step()` returns None (throttled / deadband / held / clamped) or a
+    decision dict {"direction", "trigger", ...}. State is four
+    timestamps and a calm-window anchor — every transition FakeClock
+    pins replay exactly:
+
+    - throttle: at most one evaluation per `eval_interval_s`;
+    - trip fast: SLO burn alerting (`slo_active`) or pressure at/above
+      `up_pressure` wants UP, this evaluation;
+    - resolve slow: DOWN wants no alert, the slow burn window resolved
+      AND pressure at/below `down_pressure`, continuously for
+      `down_stable_s`;
+    - deadband: neither condition -> None;
+    - no-reversal-inside-hold: a decision opposite to the last one is
+      refused until `hold_s` has passed since it — so the loop cannot
+      flap up/down faster than hold_s per reversal, provably;
+    - per-direction cooldown, then min/max clamp.
+    """
+
+    def __init__(self, config: AutoscalerConfig = AutoscalerConfig()
+                 ) -> None:
+        self.config = config
+        self._last_eval = -1e18
+        self._last_change_t: Optional[float] = None
+        self._last_direction: Optional[str] = None
+        self._last_up_t: Optional[float] = None
+        self._last_down_t: Optional[float] = None
+        self._calm_since: Optional[float] = None
+        self.events: List[dict] = []   # every committed decision
+
+    def _blocked(self, now: float, direction: str) -> bool:
+        cfg = self.config
+        if (self._last_change_t is not None
+                and self._last_direction is not None
+                and self._last_direction != direction
+                and now - self._last_change_t < cfg.hold_s):
+            return True   # reversal inside the hold window: refused
+        last_same = (self._last_up_t if direction == "up"
+                     else self._last_down_t)
+        cooldown = (cfg.cooldown_up_s if direction == "up"
+                    else cfg.cooldown_down_s)
+        return last_same is not None and now - last_same < cooldown
+
+    def _commit(self, now: float, direction: str, trigger: str,
+                size: int, pressure: float) -> dict:
+        self._last_change_t = now
+        self._last_direction = direction
+        if direction == "up":
+            self._last_up_t = now
+            # the grow is about to relieve pressure: the calm window
+            # must re-anchor from scratch, not inherit burst samples
+            self._calm_since = None
+        else:
+            self._last_down_t = now
+        decision = {
+            "t": now, "direction": direction, "trigger": trigger,
+            "size": size, "pressure": round(pressure, 4),
+        }
+        self.events.append(decision)
+        return decision
+
+    def step(self, now: float, *, size: int, pressure: float,
+             slo_active: bool = False, slo_resolved: bool = True,
+             standby_ready: int = 0) -> Optional[dict]:
+        cfg = self.config
+        if now - self._last_eval < cfg.eval_interval_s:
+            return None
+        self._last_eval = now
+        up_want = slo_active or pressure >= cfg.up_pressure
+        calm_now = (not slo_active and slo_resolved
+                    and pressure <= cfg.down_pressure)
+        if calm_now and not up_want:
+            if self._calm_since is None:
+                self._calm_since = now
+        else:
+            self._calm_since = None
+        if up_want:
+            if size >= cfg.max_size or self._blocked(now, "up"):
+                return None
+            trigger = "slo_burn" if slo_active else "queue_pressure"
+            return self._commit(now, "up", trigger, size, pressure)
+        if (self._calm_since is not None
+                and now - self._calm_since >= cfg.down_stable_s):
+            if size <= cfg.min_size or self._blocked(now, "down"):
+                return None
+            return self._commit(now, "down", "slo_resolved",
+                                size, pressure)
+        return None   # deadband (or calm still proving itself)
+
+
+# ------------------------------------------------------------ standby pool
+class StandbyPool:
+    """Warm workers spawned ahead of demand, one background thread.
+
+    `provision(rid)` queues one standby build (spec_fn(rid) names it);
+    the thread spawns serially — through serve/supervisor.py's
+    `spawn_worker`, so every child lands in the atexit-reaped registry
+    — and finished workers wait warm in FIFO order. `take()` pops the
+    oldest (rid, spec, worker) for promotion; `close()` reaps whatever
+    is left. `spawn_in_thread=False` makes provision() synchronous for
+    host-pure tests."""
+
+    def __init__(self, spec_fn: Callable[[int], WorkerSpec], *,
+                 spawn_fn: Optional[Callable] = None,
+                 spawn_in_thread: bool = True) -> None:
+        self.spec_fn = spec_fn
+        self.spawn_fn = spawn_fn or spawn_worker
+        self.spawn_in_thread = spawn_in_thread
+        self._lock = threading.Lock()
+        self._queue: List[int] = []        # rids awaiting a spawn
+        self._ready: List[tuple] = []      # (rid, spec, worker), FIFO
+        self._thread: Optional[threading.Thread] = None
+        self._closed = False
+        self.spawned_total = 0
+        self.spawn_errors: List[tuple] = []   # (rid, repr(exc))
+
+    # ------------------------------------------------------------ intake
+    def provision(self, rid: int) -> None:
+        """Queue one standby build for a pre-assigned replica id."""
+        with self._lock:
+            if self._closed:
+                return
+            self._queue.append(rid)
+            if not self.spawn_in_thread:
+                pass   # drained synchronously below
+            elif self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._run, name="standby-pool", daemon=True
+                )
+                self._thread.start()
+        if not self.spawn_in_thread:
+            self._drain_queue()
+
+    def _drain_queue(self) -> None:
+        while True:
+            with self._lock:
+                if self._closed or not self._queue:
+                    return
+                rid = self._queue.pop(0)
+            spec = self.spec_fn(rid)
+            try:
+                worker = self.spawn_fn(spec)
+            except BaseException as e:   # noqa: BLE001 — ledger, not mask
+                with self._lock:
+                    self.spawn_errors.append((rid, repr(e)))
+                continue
+            with self._lock:
+                if self._closed:
+                    worker.reap()
+                    return
+                self._ready.append((rid, spec, worker))
+                self.spawned_total += 1
+
+    def _run(self) -> None:
+        self._drain_queue()
+
+    # ----------------------------------------------------------- consume
+    @property
+    def ready_count(self) -> int:
+        with self._lock:
+            return len(self._ready)
+
+    @property
+    def in_flight(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    def take(self) -> Optional[tuple]:
+        """Pop the oldest warm standby, (rid, spec, worker) — None when
+        the pool has nothing ready (the caller falls back cold)."""
+        with self._lock:
+            if not self._ready:
+                return None
+            return self._ready.pop(0)
+
+    def wait_ready(self, timeout_s: float = 300.0,
+                   n: int = 1) -> bool:
+        """Block until >= n standbys are warm (bench pre-warm barrier);
+        False on timeout or a closed pool."""
+        import time as _time
+
+        deadline = _time.monotonic() + timeout_s
+        while _time.monotonic() < deadline:
+            with self._lock:
+                if self._closed:
+                    return False
+                if len(self._ready) >= n:
+                    return True
+                if not self._queue and (
+                        self._thread is None
+                        or not self._thread.is_alive()):
+                    return len(self._ready) >= n
+            _time.sleep(0.02)
+        return False
+
+    def close(self) -> None:
+        """Reap every pooled standby and refuse further provisioning
+        (the atexit registry would catch leaks anyway — this is the
+        polite path)."""
+        with self._lock:
+            self._closed = True
+            self._queue.clear()
+            ready, self._ready = self._ready, []
+        t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout=5.0)
+        for _rid, _spec, worker in ready:
+            worker.reap()
+
+
+# ------------------------------------------------------------ orchestrator
+class Autoscaler:
+    """Close the loop: policy decisions -> supervisor/router actuation.
+
+    Ticked by Router.step (set `router.autoscaler = this`) right after
+    the SLO pass. Owns the replica-id counter — slot ids are stable and
+    monotonically increasing across scale events, pool pre-assignments
+    included — and the drain ledger that retires a shrunk handle from
+    the router once its process is gone."""
+
+    def __init__(self, router, supervisor: Supervisor,
+                 base_spec: WorkerSpec, *,
+                 config: AutoscalerConfig = AutoscalerConfig(),
+                 clock=None,
+                 pool: Optional[StandbyPool] = None,
+                 tracer=None, sinks=None,
+                 handle_factory: Optional[Callable] = None,
+                 heartbeat_timeout_s: float = 2.0,
+                 spawn_fn: Optional[Callable] = None,
+                 spawn_in_thread: bool = True) -> None:
+        self.router = router
+        self.supervisor = supervisor
+        self.base_spec = base_spec
+        self.config = config
+        self.clock = clock or getattr(router, "clock", None) \
+            or MonotonicClock()
+        self.policy = AutoscalerPolicy(config)
+        # scale events belong on the same timeline as the dispatches
+        # they reshape: default to the router's recorder
+        self.tracer = tracer if tracer is not None \
+            else getattr(router, "tracer", None)
+        self.sinks = sinks
+        self.heartbeat_timeout_s = heartbeat_timeout_s
+        self._handle_factory = handle_factory or self._default_handle
+        self._next_replica = len(supervisor.specs)
+        self.pool = pool or StandbyPool(
+            self._spec_for, spawn_fn=spawn_fn,
+            spawn_in_thread=spawn_in_thread,
+        )
+        self.events: List[dict] = []     # actuated scale events
+        self._draining: Dict[int, object] = {}   # slot -> handle
+        self.drain_log: List[dict] = []
+        # one row per POLICY EVALUATION (post-throttle): the signal the
+        # control law actually saw, so a bench or an operator can place
+        # every scale event against the pressure trace that caused it
+        self.pressure_log: List[dict] = []
+        self.last_join_s: Optional[float] = None
+        for _ in range(config.standby_target):
+            self.pool.provision(self._alloc_rid())
+
+    # ------------------------------------------------------------ plumbing
+    def _alloc_rid(self) -> int:
+        rid = self._next_replica
+        self._next_replica += 1
+        return rid
+
+    def _spec_for(self, rid: int) -> WorkerSpec:
+        return dataclasses.replace(self.base_spec, replica=rid)
+
+    def _default_handle(self, slot: int, spec: WorkerSpec):
+        return RemoteReplicaHandle(
+            slot, self.supervisor, spec, clock=self.clock,
+            heartbeat_timeout_s=self.heartbeat_timeout_s,
+            trace_collector=getattr(self.router, "trace_collector",
+                                    None),
+        )
+
+    def _pressure(self) -> float:
+        """Demand per decode slot over the dispatchable fleet — the
+        same signal Router._update_brownout reads, except `load` (which
+        counts submits still in flight to a replica synchronously)
+        stands in for the heartbeat-lagged queue+active pair, and
+        draining replicas count for neither work nor capacity."""
+        slots = 0
+        work = 0.0
+        for h in self.router.handles:
+            if not h.health.alive:
+                continue
+            if getattr(h, "_drain_requested", False):
+                continue
+            slots += h.max_slots
+            work += h.load
+        return (work / slots) if slots else float("inf")
+
+    def _emit(self, now: float, event: dict) -> None:
+        self.events.append(event)
+        metrics = getattr(self.router, "metrics", None)
+        if metrics is not None:
+            metrics.on_scale_event(event["direction"], event["trigger"])
+        if self.tracer is not None and self.tracer.enabled:
+            attrs = {k: v for k, v in event.items() if k != "t"}
+            self.tracer.instant(
+                f"scale_{event['direction']}", pid=ROUTER_PID, **attrs
+            )
+        if self.sinks is not None:
+            self.sinks.send(dict(event, kind="alert", t=now,
+                                 scope="autoscale",
+                                 event=f"scale_{event['direction']}"))
+
+    # ---------------------------------------------------------------- tick
+    def step(self, now: Optional[float] = None) -> Optional[dict]:
+        now = self.clock.now() if now is None else now
+        self._retire_drained(now)
+        size = self.supervisor.active_slots()
+        slo = getattr(self.router, "slo", None)
+        if slo is not None:
+            sig = slo.burn_signal()
+            slo_active = bool(sig["active"])
+            slo_resolved = bool(sig["resolved"])
+        else:
+            slo_active, slo_resolved = False, True
+        pressure = self._pressure()
+        decision = self.policy.step(
+            now, size=size, pressure=pressure,
+            slo_active=slo_active, slo_resolved=slo_resolved,
+            standby_ready=self.pool.ready_count,
+        )
+        if self.policy._last_eval == now:
+            # the policy evaluated (not throttled) this tick
+            self.pressure_log.append(
+                {"t": now, "size": size, "pressure": pressure}
+            )
+            if len(self.pressure_log) > 4096:
+                del self.pressure_log[:2048]
+        event = None
+        if decision is not None:
+            if decision["direction"] == "up":
+                event = self._grow(now, decision)
+            else:
+                event = self._scale_down(now, decision)
+        metrics = getattr(self.router, "metrics", None)
+        if metrics is not None:
+            metrics.fleet_size.set(self.supervisor.active_slots())
+            metrics.standby_ready.set(self.pool.ready_count)
+        return event
+
+    # ------------------------------------------------------------ actuate
+    def _grow(self, now: float, decision: dict) -> dict:
+        t0 = self.clock.now()
+        item = self.pool.take()
+        if item is not None:
+            rid, spec, worker = item
+            slot = self.supervisor.grow(spec, worker=worker)
+            warm = True
+        else:
+            # pool empty (burst outran replenishment): fall back to the
+            # cold spawn pipeline — the slot joins BACKOFF due now and
+            # the supervisor's spawn thread brings it up (~15 s); the
+            # handle joins dead and the router's probe path admits it
+            # when the process answers. spec.replica may differ from
+            # the slot index here (pool pre-assignments are already
+            # minted); that is label cosmetics — slot ids stay stable.
+            spec = self._spec_for(self._alloc_rid())
+            slot = self.supervisor.grow(spec)
+            warm = False
+        handle = self._handle_factory(slot, spec)
+        collector = getattr(self.router, "trace_collector", None)
+        if collector is not None:
+            collector.label_worker(slot, spec.engine.get("max_slots", 4))
+        self.router.add_handle(handle)
+        if warm:
+            # promotion = probe + dispatch join, milliseconds: the ~15 s
+            # import+warm already happened in the pool's background
+            handle.probe_ok(now)
+            if collector is not None:
+                handle.measure_clock()
+        join_s = max(0.0, self.clock.now() - t0)
+        self.last_join_s = join_s
+        # replenish BEHIND the promotion, never in front of it
+        self.pool.provision(self._alloc_rid())
+        event = dict(decision, slot=slot, warm=warm,
+                     join_s=round(join_s, 6),
+                     size=self.supervisor.active_slots())
+        self._emit(now, event)
+        return event
+
+    def _scale_down(self, now: float, decision: dict) -> Optional[dict]:
+        candidates = [
+            h for h in self.router.handles
+            if h.id < len(self.supervisor.specs)
+            and self.supervisor.state(h.id) == RUNNING
+            and not getattr(h, "_drain_requested", False)
+        ]
+        if not candidates:
+            return None
+        victim = max(candidates, key=lambda h: h.id)   # newest leaves
+        victim.begin_drain()          # dispatch stops offering it NOW
+        self.supervisor.shrink(victim.id)   # rpc drain + SIGTERM
+        self._draining[victim.id] = victim
+        event = dict(decision, slot=victim.id,
+                     size=self.supervisor.active_slots())
+        self._emit(now, event)
+        return event
+
+    def _retire_drained(self, now: float) -> None:
+        """Reap the ledger: once a draining slot's process is gone
+        (clean exit or chaos SIGKILL — the supervisor retires both to
+        STOPPED without a budget charge), pull its handle out of the
+        router. remove_handle flushes + salvages anything left, so a
+        drain cut short mid-stream still fails over exactly-once."""
+        for slot, handle in list(self._draining.items()):
+            if self.supervisor.state(slot) != STOPPED:
+                continue
+            self.router.remove_handle(handle)
+            del self._draining[slot]
+            self.drain_log.append({"t": now, "slot": slot})
+            if self.tracer is not None and self.tracer.enabled:
+                self.tracer.instant("scale_down_done", pid=ROUTER_PID,
+                                    slot=slot)
+
+    # ----------------------------------------------------------- introspect
+    def snapshot(self) -> dict:
+        """The /healthz + tools/check_fleet.py state block."""
+        return {
+            "size": self.supervisor.active_slots(),
+            "min": self.config.min_size,
+            "max": self.config.max_size,
+            "standby_ready": self.pool.ready_count,
+            "standby_target": self.config.standby_target,
+            "draining": sorted(self._draining),
+            "events_total": len(self.events),
+            "last_event": dict(self.events[-1]) if self.events else None,
+            "last_join_s": self.last_join_s,
+        }
+
+    def close(self) -> None:
+        self.pool.close()

@@ -1652,6 +1652,299 @@ def fleet_adaptive_sampling_bench(
         sup.stop()
 
 
+def fleet_autoscale_bench(
+    *,
+    rate_hz: float = 25.0,
+    step_factor: float = 4.0,
+    chunk_s: float = 1.0,
+    chunks_base: int = 2,
+    chunks_step: int = 3,
+    chunks_post: int = 7,
+    procs: int = 3,
+    autoscale_min: int = 1,
+    autoscale_max: int = 3,
+    standby: int = 1,
+    max_slots: int = 8,
+    vocab: int = 64,
+    hidden: int = 128,
+    depth: int = 2,
+    heads: int = 4,
+    mlp: int = 256,
+    max_len: int = 128,
+    prompt_buckets=(8, 16),
+    prompt_len_range=(2, 16),
+    max_new_range=(32, 96),
+    decode_burst: int = 8,
+    eos_id: Optional[int] = 46,
+    seed: int = 0,
+) -> dict:
+    """Elastic fleet vs fixed fleet under a 4x arrival step, at equal
+    SLO: the same chunked trace (base rate -> `step_factor`x burst ->
+    base again) is replayed through TWO separately-built fleets — a
+    fixed fleet PROVISIONED FOR THE PEAK (`procs`, the fair fight:
+    matching the elastic ceiling `autoscale_max` is what an operator
+    without an autoscaler must deploy to survive the burst), then an
+    autoscaled fleet that starts at `autoscale_min` with `standby`
+    pre-warmed standbys. Both arms carry an identical SLOWatchdog, so
+    brown-out shedding judges them by the same rules; the elastic arm's
+    claim is GOODPUT PER WORKER-SECOND, not raw goodput.
+
+    The check_bench-gated keys:
+
+    - ``goodput_per_worker_ratio``: elastic useful-tokens per
+      worker-second over fixed (worker-seconds integrate the active
+      fleet size over the scale-event timeline; the fixed arm pays
+      `procs` the whole run);
+    - ``lost``: submitted-but-never-completed across BOTH arms (shed at
+      the door is a status, lost is a bug) — gated 0;
+    - ``reaction_within_window``: 1.0 iff the first scale-up after the
+      step landed within ``reaction_window_s`` (one policy evaluation
+      interval + eval-phase slack) of the first policy evaluation that
+      SAW trigger pressure — the loop's own latency, separated from
+      the queue-build physics reported as ``signal_build_s``;
+    - ``oscillation_ok``: 1.0 iff scale-direction changes <= the
+      hold-window bound floor(elapsed/hold_s) + 1 — the no-thrash
+      contract, same shape as the adaptive head-rate gate;
+    - ``promote_join_s``: warm-standby promotion latency (pool take ->
+      dispatch join), the number that must sit well under the ~15s
+      cold spawn also reported here as ``cold_spawn_s``.
+    """
+    from ddp_practice_tpu.serve.autoscaler import (
+        Autoscaler,
+        AutoscalerConfig,
+    )
+    from ddp_practice_tpu.serve.scheduler import MonotonicClock
+    from ddp_practice_tpu.serve.slo import SLOConfig, SLOWatchdog
+    from ddp_practice_tpu.serve.supervisor import (
+        SupervisorConfig,
+        make_fleet_router,
+    )
+    from ddp_practice_tpu.serve.worker import WorkerSpec
+
+    model_kw = {
+        "vocab_size": vocab, "max_len": max_len, "hidden_dim": hidden,
+        "depth": depth, "num_heads": heads, "mlp_dim": mlp,
+        "pos_emb": "rope",
+    }
+
+    def chunk(rate: float, k: int):
+        return build_trace(
+            n_requests=max(8, int(rate * chunk_s)), rate_hz=rate,
+            vocab=vocab, prompt_len_range=prompt_len_range,
+            max_new_range=max_new_range, seed=seed + 7 * k + 1,
+        )
+
+    step_rate = rate_hz * step_factor
+    total_chunks = chunks_base + chunks_step + chunks_post
+    spec = WorkerSpec(
+        model=model_kw,
+        engine={
+            "max_slots": max_slots, "max_len": max_len,
+            "prompt_buckets": list(prompt_buckets),
+            "temperature": 0.0, "decode_burst": decode_burst,
+            "eos_id": eos_id,
+        },
+        max_queue=int(step_rate * chunk_s) * (total_chunks + 2),
+    )
+    # the elastic policy: trip fast (one sub-second evaluation interval,
+    # up_pressure just under the router's brown-out threshold so growth
+    # fires before shedding clamps the signal), resolve slow (calm must
+    # hold down_stable_s, reversals blocked inside hold_s)
+    acfg = AutoscalerConfig(
+        min_size=autoscale_min, max_size=autoscale_max,
+        eval_interval_s=0.4, up_pressure=1.3, down_pressure=0.45,
+        hold_s=4.0, cooldown_up_s=1.0, cooldown_down_s=3.0,
+        down_stable_s=1.5, standby_target=standby,
+    )
+    # "within one evaluation window" of the signal: the commit may land
+    # an eval after the crossing eval, plus scheduling slack on a
+    # loaded box
+    reaction_window_s = acfg.eval_interval_s + 0.25
+
+    def slo_watchdog(clock):
+        # equal-SLO contract: both arms get this exact config
+        return SLOWatchdog(SLOConfig(
+            ttft_p99_s=1.5, fast_window_s=1.5, slow_window_s=5.0,
+            trip_burn=2.0, resolve_burn=1.0, min_events=8,
+        ), clock=clock)
+
+    def drain_frames(router) -> None:
+        deadline = time.monotonic() + 0.3
+        while time.monotonic() < deadline:
+            router.step()
+            _fleet_wait(router, 0.01)
+
+    def integrate_size(events, t0, t1, size0) -> float:
+        """Worker-seconds from the scale-event ledger: piecewise-
+        constant active size over [t0, t1] (event "t"/"size" share the
+        bench's time.monotonic basis via MonotonicClock)."""
+        pts = [(t0, size0)]
+        for e in events:
+            if t0 <= e["t"] <= t1:
+                pts.append((e["t"], e["size"]))
+        ws = 0.0
+        last_t, last_s = pts[0]
+        for t, s in pts[1:]:
+            ws += (t - last_t) * last_s
+            last_t, last_s = t, s
+        ws += (t1 - last_t) * last_s
+        return ws
+
+    def run_arm(auto: bool) -> dict:
+        clock = MonotonicClock()
+        n0 = autoscale_min if auto else procs
+        router, sup, handles = make_fleet_router(
+            spec, n0, clock=clock,
+            sup_config=SupervisorConfig(restart_base_s=0.25,
+                                        shrink_kill_after_s=10.0),
+            slo=slo_watchdog(clock),
+        )
+        asc = None
+        cold_spawn_s = None
+        try:
+            if auto:
+                asc = Autoscaler(router, sup, spec, config=acfg,
+                                 clock=clock)
+                router.autoscaler = asc
+                t0 = time.monotonic()
+                if not asc.pool.wait_ready(timeout_s=300.0, n=standby):
+                    raise RuntimeError("standby pool never warmed")
+                # the pool fill IS a cold spawn — the latency a warm
+                # promotion buys its way out of
+                cold_spawn_s = time.monotonic() - t0
+            # untimed shakeout: compile warmup through the seam
+            _replay_through_router(router, chunk(rate_hz, 0),
+                                   rid_offset=90_000_000, fleet=True)
+            drain_frames(router)
+
+            rows = []
+
+            def run_chunk(rate: float, k: int) -> None:
+                rows.append(_replay_through_router(
+                    router, chunk(rate, k),
+                    rid_offset=(k + 1) * 1_000_000, fleet=True))
+                drain_frames(router)
+
+            t_start = time.monotonic()
+            size0 = sup.active_slots()
+            k = 1
+            for _ in range(chunks_base):
+                run_chunk(rate_hz, k)
+                k += 1
+            t_burst = time.monotonic()
+            for _ in range(chunks_step):
+                run_chunk(step_rate, k)
+                k += 1
+            for _ in range(chunks_post):
+                run_chunk(rate_hz, k)
+                k += 1
+            if auto:
+                # let in-flight drains retire so the worker-seconds
+                # ledger charges the elastic arm for its drain tail
+                deadline = time.monotonic() + 15.0
+                while time.monotonic() < deadline and asc._draining:
+                    router.step()
+                    _fleet_wait(router, 0.02)
+            t_end = time.monotonic()
+
+            events = list(asc.events) if auto else []
+            ws = (integrate_size(events, t_start, t_end, size0)
+                  if auto else procs * (t_end - t_start))
+            useful = sum(r["useful_tokens"] for r in rows)
+            statuses: dict = {}
+            for r in rows:
+                for s, n in r["statuses"].items():
+                    statuses[s] = statuses.get(s, 0) + n
+            arm = {
+                "mode": "autoscaled" if auto else "fixed",
+                "workers_start": n0,
+                "elapsed_s": t_end - t_start,
+                "worker_seconds": ws,
+                "useful_tokens": useful,
+                "goodput_per_worker": useful / ws if ws > 0 else 0.0,
+                "lost": sum(r["lost"] for r in rows),
+                "statuses": statuses,
+                "slo": router.slo.burn_signal(),
+            }
+            if auto:
+                ups = [e for e in events if e["direction"] == "up"]
+                post = [e for e in ups if e["t"] >= t_burst]
+                warm = [e for e in ups if e.get("warm")]
+                dirs = [e["direction"] for e in events]
+                changes = sum(1 for a, b in zip(dirs, dirs[1:])
+                              if a != b)
+                bound = int((t_end - t_start) / acfg.hold_s) + 1
+                reaction_s = signal_build_s = None
+                if post:
+                    # the first policy evaluation that SAW trigger
+                    # pressure after the step: reaction is the loop's
+                    # own latency from that signal; the queue-build
+                    # time before it is physics, reported separately
+                    t_up = post[0]["t"]
+                    xs = [r["t"] for r in asc.pressure_log
+                          if t_burst <= r["t"] <= t_up
+                          and r["pressure"] >= acfg.up_pressure]
+                    signal_t = xs[0] if xs else t_up
+                    reaction_s = t_up - signal_t
+                    signal_build_s = signal_t - t_burst
+                arm.update({
+                    "final_size": sup.active_slots(),
+                    "cold_spawn_s": cold_spawn_s,
+                    "reaction_s": reaction_s,
+                    "signal_build_s": signal_build_s,
+                    "promote_join_s": (warm[0]["join_s"]
+                                       if warm else None),
+                    "direction_changes": changes,
+                    "oscillation_bound": bound,
+                    "scale_events": events,
+                    "autoscaler": asc.snapshot(),
+                })
+            return arm
+        finally:
+            if asc is not None:
+                asc.close()
+            sup.stop()
+
+    fixed = run_arm(auto=False)
+    auto = run_arm(auto=True)
+    reaction_s = auto.get("reaction_s")
+    gpw_ratio = (auto["goodput_per_worker"]
+                 / max(fixed["goodput_per_worker"], 1e-9))
+    return {
+        "rate_hz": rate_hz,
+        "step_rate_hz": step_rate,
+        "step_factor": step_factor,
+        "chunk_s": chunk_s,
+        "chunks": {"base": chunks_base, "step": chunks_step,
+                   "post": chunks_post},
+        "procs_fixed": procs,
+        "autoscale": {"min": autoscale_min, "max": autoscale_max,
+                      "standby": standby,
+                      "eval_interval_s": acfg.eval_interval_s,
+                      "hold_s": acfg.hold_s,
+                      "up_pressure": acfg.up_pressure,
+                      "down_pressure": acfg.down_pressure},
+        "gate": ("goodput/worker >= fixed at equal SLO, react within "
+                 "one eval window, no thrash, zero lost, warm "
+                 "promotion << cold spawn"),
+        "fixed": fixed,
+        "autoscaled": auto,
+        "goodput_per_worker_ratio": gpw_ratio,
+        "lost": fixed["lost"] + auto["lost"],
+        "reaction_s": reaction_s,
+        "signal_build_s": auto.get("signal_build_s"),
+        "reaction_window_s": reaction_window_s,
+        "reaction_within_window": (
+            1.0 if reaction_s is not None
+            and reaction_s <= reaction_window_s else 0.0),
+        "oscillation_ok": (
+            1.0 if auto["direction_changes"]
+            <= auto["oscillation_bound"] else 0.0),
+        "promote_join_s": auto.get("promote_join_s"),
+        "cold_spawn_s": auto.get("cold_spawn_s"),
+    }
+
+
 def _score_streams(router, comps) -> dict:
     """Score and CLEAR the router's TokenStreams from the consumer's
     seat (the bench IS the consumer). Everything here is re-derived
@@ -2696,6 +2989,22 @@ def build_parser() -> argparse.ArgumentParser:
                         "rate; tail keep-rules stay tenant-blind, so "
                         "fault-affected requests are kept for EVERY "
                         "tenant")
+    p.add_argument("--autoscale", action="store_true",
+                   help="with --procs: A/B an ELASTIC fleet against the "
+                        "fixed --procs fleet under a 4x arrival step "
+                        "(serve/autoscaler.py: SLO-burn/queue-pressure "
+                        "policy, pre-warmed standby promotion, graceful "
+                        "drain scale-down) — gates goodput per "
+                        "worker-second at equal SLO, reaction within "
+                        "one evaluation window, zero lost, no thrash")
+    p.add_argument("--autoscale-max", dest="autoscale_max", type=int,
+                   default=3,
+                   help="with --autoscale: elastic fleet size ceiling "
+                        "(floor is 1)")
+    p.add_argument("--standby", type=int, default=1,
+                   help="with --autoscale: pre-warmed standby workers "
+                        "kept ready to promote (pool replenishes in "
+                        "the background after each promotion)")
     p.add_argument("--max-len", dest="max_len", type=int, default=None,
                    help="bench: slot-pool span / paged pool sizing "
                         "(default 128); the slot engine's decode cost "
@@ -2854,6 +3163,45 @@ def main(argv=None) -> int:
                       f"after dedup of {pu['duplicate_batches']} "
                       f"duplicate batch(es) — complete="
                       f"{pu['complete']}")
+        return 0
+    if args.procs and args.autoscale:
+        report = fleet_autoscale_bench(
+            rate_hz=args.rate, procs=args.procs,
+            autoscale_max=args.autoscale_max, standby=args.standby,
+            max_slots=args.max_slots, seed=args.seed,
+            **({"decode_burst": args.decode_burst}
+               if args.decode_burst is not None else {}),
+        )
+        if args.json:
+            print(json.dumps(report))
+        else:
+            au, fx = report["autoscaled"], report["fixed"]
+            print(f"[fleet_autoscale] {args.rate}/s -> "
+                  f"{report['step_rate_hz']}/s step; fixed "
+                  f"{report['procs_fixed']} workers vs elastic "
+                  f"{report['autoscale']['min']}.."
+                  f"{report['autoscale']['max']} "
+                  f"(+{report['autoscale']['standby']} standby)")
+            for r in (fx, au):
+                print(f"  {r['mode']:>10}: "
+                      f"{r['goodput_per_worker']:7.1f} tok/s/worker  "
+                      f"({r['useful_tokens']} tok over "
+                      f"{r['worker_seconds']:.1f} worker-s)  lost "
+                      f"{r['lost']}")
+            rs, pj = report["reaction_s"], report["promote_join_s"]
+            print(f"  goodput/worker ratio "
+                  f"{report['goodput_per_worker_ratio']:.2f}x  "
+                  + (f"reaction {rs:.2f}s (window "
+                     f"{report['reaction_window_s']:.2f}s, within="
+                     f"{report['reaction_within_window']:.0f})"
+                     if rs is not None
+                     else "no scale-up observed after the step"))
+            print("  warm promotion "
+                  + (f"{pj:.3f}s" if pj is not None else "n/a")
+                  + f" vs cold spawn {report['cold_spawn_s']:.1f}s  "
+                  f"direction changes {au['direction_changes']} "
+                  f"(bound {au['oscillation_bound']}, "
+                  f"ok={report['oscillation_ok']:.0f})")
         return 0
     if args.procs and args.adaptive_sampling:
         report = fleet_adaptive_sampling_bench(

@@ -150,8 +150,11 @@ class ServeMetrics:
 
 
 # health-state gauge encoding (serve_replica_state{replica=i}): a gauge
-# is a float, so the three states get stable small ints
-STATE_CODES = {"healthy": 0.0, "degraded": 1.0, "dead": 2.0}
+# is a float, so the states get stable small ints. "removed" is the
+# elastic-fleet terminal: a drained slot's gauge parks there instead of
+# masquerading as a crash ("dead" pages someone; a scale-down must not)
+STATE_CODES = {"healthy": 0.0, "degraded": 1.0, "dead": 2.0,
+               "removed": 3.0}
 
 
 class RouterMetrics:
@@ -180,6 +183,17 @@ class RouterMetrics:
         # the fleet /metrics p99 bucket names an offending trace_id
         self.ttft = r.histogram("serve_router_ttft_s")
         self.tpot = r.histogram("serve_router_tpot_s")
+        # elastic-fleet observables (serve/autoscaler.py): current
+        # active size, warm standbys ready to promote, and the scale
+        # ledger by direction x trigger (slo_burn vs queue_pressure up,
+        # slo_resolved down — the labels an operator pivots on)
+        self.fleet_size = r.gauge("fleet_size")
+        self.standby_ready = r.gauge("standby_ready")
+
+    def on_scale_event(self, direction: str, trigger: str) -> None:
+        self.registry.counter(labelled(
+            "scale_events_total", direction=direction, trigger=trigger
+        )).inc()
 
     def on_shed(self, reason: str) -> None:
         self.registry.counter(

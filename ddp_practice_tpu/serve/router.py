@@ -404,8 +404,56 @@ class Router:
         self._pending = 0
         self._retry_q: List[tuple] = []  # (ready_at, seq, rid) heap
         self._retry_seq = 0
+        # optional serve/autoscaler.py Autoscaler: evaluated once per
+        # tick right after the SLO watchdog (its trip/resolve signals
+        # are the autoscaler's inputs, so they must be fresh)
+        self.autoscaler = None
         for h in self.handles:
             self.metrics.on_replica_state(h.id, h.health.state.value)
+
+    # ------------------------------------------------- elastic membership
+    def add_handle(self, h) -> None:
+        """Join a NEW replica handle mid-run (autoscaler grow): armed
+        with the same breaker policy __init__ applies, seeded by its
+        stable slot id so probe jitter stays deterministic per slot."""
+        bcfg = BreakerConfig(
+            trip_after=self.config.trip_after,
+            probe_base_s=self.config.probe_base_s,
+            probe_factor=self.config.probe_factor,
+            probe_max_s=self.config.probe_max_s,
+            probe_jitter=self.config.probe_jitter,
+            seed=self.config.seed + h.id,
+        )
+        h.health = ReplicaHealth(bcfg)
+        self.handles.append(h)
+        self.metrics.on_replica_state(h.id, h.health.state.value)
+
+    def remove_handle(self, h) -> None:
+        """Retire a replica handle mid-run (autoscaler shrink, after
+        the drain). Anything it still holds is flushed and salvaged —
+        chunks first so the delivery cursor is current, then leftovers
+        re-dispatch on survivors — so removal can never strand a
+        stream, even when the drain was cut short."""
+        if h not in self.handles:
+            return
+        self._ingest_chunks(h)
+        self._consume(h)
+        for req, tokens, ftt, phases in h.evacuate():
+            tr = self.tracked.get(req.rid)
+            if tr is None or tr.done:
+                continue
+            tr.queue_s += phases["queue_s"]
+            tr.prefill_s += phases["prefill_s"]
+            tr.decode_s += phases["decode_s"]
+            tr.prefix.extend(tokens)
+            if tr.first_token_time is None:
+                tr.first_token_time = ftt
+            tr.failovers += 1
+            self.metrics.failovers.inc()
+            if not self._dispatch(tr):
+                self._park_or_shed(tr)
+        self.handles.remove(h)
+        self.metrics.on_replica_state(h.id, "removed")
 
     # ------------------------------------------------------------ intake
     def submit(self, req: Request) -> bool:
@@ -673,6 +721,11 @@ class Router:
         self._drain_retries()
         if self.slo is not None:
             self.slo.evaluate(self.clock.now())
+        if self.autoscaler is not None:
+            # after the SLO pass (burn rates fresh), before brown-out
+            # (a grow this tick relieves the very pressure brown-out
+            # would otherwise respond to)
+            self.autoscaler.step(self.clock.now())
         self._update_brownout()
         if self.clock.now() == t_start:
             # nothing decoded this tick (fleet idle/dead): advance

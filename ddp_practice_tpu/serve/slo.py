@@ -326,6 +326,26 @@ class SLOWatchdog:
         trigger."""
         return any(self.alerts.values())
 
+    def burn_signal(self) -> dict:
+        """The autoscaler's compressed view of the last evaluation:
+        worst fast/slow burn across objectives, whether anything is
+        alerting, and whether the SLOW window has settled under the
+        resolve threshold everywhere. Trip fast rides `active`;
+        `resolved` is the scale-DOWN precondition — burn that merely
+        dipped out of the fast window is not calm, it is noise."""
+        rep = self._last_report or {}
+        fast = max((o.get("burn_fast", 0.0) for o in rep.values()),
+                   default=0.0)
+        slow = max((o.get("burn_slow", 0.0) for o in rep.values()),
+                   default=0.0)
+        return {
+            "burn_fast": fast,
+            "burn_slow": slow,
+            "active": self.active,
+            "resolved": (not self.active
+                         and slow <= self.config.resolve_burn),
+        }
+
 
 # ------------------------------------------------------------- push alerts
 @dataclasses.dataclass(frozen=True)

@@ -258,6 +258,10 @@ class SupervisorConfig:
     # stop(): how long to wait after a graceful rpc shutdown before
     # escalating to SIGTERM, then SIGKILL
     drain_timeout_s: float = 5.0
+    # shrink(): how long a DRAINING slot may take to finish its
+    # in-flight streams and exit before poll() escalates to SIGKILL
+    # (a drain that never converges is a hang, not a graceful exit)
+    shrink_kill_after_s: float = 60.0
 
 
 # slot states
@@ -266,6 +270,9 @@ BACKOFF = "backoff"      # dead, respawn scheduled at _next_at
 SPAWNING = "spawning"    # respawn in flight on the spawn thread
 FAILED = "failed"        # restart budget exhausted — breaker open
 STOPPED = "stopped"
+DRAINING = "draining"    # scale-down in flight: refusing submits,
+#                          finishing streams, exiting on its own — a
+#                          death here is RETIREMENT, never a respawn
 
 
 class Supervisor:
@@ -299,6 +306,11 @@ class Supervisor:
         self._next_at: List[float] = [0.0] * n
         self._spawn_threads: List[Optional[threading.Thread]] = [None] * n
         self._spawn_results: List[Optional[tuple]] = [None] * n
+        # scale-down bookkeeping: SIGKILL deadline per DRAINING slot,
+        # and a cancel flag a shrink() of a SPAWNING slot leaves for
+        # _collect_spawn (the fresh worker is reaped, never joined)
+        self._drain_deadline: List[Optional[float]] = [None] * n
+        self._cancel_spawn: List[bool] = [False] * n
         self._lock = threading.Lock()
 
     def _default_spawn(self, spec: WorkerSpec):
@@ -320,14 +332,30 @@ class Supervisor:
 
     def worker(self, slot: int):
         """The slot's CURRENT process (None while down) — callers must
-        re-resolve per use; a restarted slot has a new pid/client."""
-        return self.workers[slot] if self.states[slot] == RUNNING else None
+        re-resolve per use; a restarted slot has a new pid/client. A
+        DRAINING worker is still a live process (its handle keeps
+        pumping completions out of it) — only dispatch eligibility is
+        gone, and that is `alive()`'s job, not this one's."""
+        if self.states[slot] in (RUNNING, DRAINING):
+            return self.workers[slot]
+        return None
 
     def alive(self, slot: int) -> bool:
         return self.states[slot] == RUNNING
 
+    def draining(self, slot: int) -> bool:
+        return self.states[slot] == DRAINING
+
     def state(self, slot: int) -> str:
         return self.states[slot]
+
+    def active_slots(self) -> int:
+        """Slots that serve or will serve again (RUNNING + the restart
+        pipeline) — the autoscaler's notion of fleet size. DRAINING
+        slots are already leaving; STOPPED/FAILED are gone."""
+        return sum(
+            1 for s in self.states if s in (RUNNING, BACKOFF, SPAWNING)
+        )
 
     def kill(self, slot: int, sig: str = "SIGKILL") -> None:
         """Deliver a REAL signal to the slot's current process (the
@@ -343,6 +371,80 @@ class Supervisor:
         if w is not None and w.poll() is None:
             w.kill_signal(sig)
 
+    # ----------------------------------------------- elastic actuators
+    def grow(self, spec: WorkerSpec, worker=None) -> int:
+        """Append a NEW slot and return its id. Slot ids are stable and
+        monotonically increasing: a shrunk slot becomes a STOPPED
+        tombstone, never a hole, so every federated label minted for a
+        slot stays true across scale events. With `worker` (a warm
+        standby) the slot joins RUNNING immediately — promotion is a
+        list append, not a ~15 s spawn; without one the slot enters
+        BACKOFF due NOW and the next poll() spawns it cold through the
+        normal (budget-free first) pipeline."""
+        with self._lock:
+            slot = len(self.specs)
+            self.specs.append(spec)
+            self.workers.append(worker)
+            self.states.append(RUNNING if worker is not None else BACKOFF)
+            self.restarts.append(0)
+            self._budget_used.append(0)
+            self._restart_times.append([])
+            self._next_at.append(self.clock.now())
+            self._spawn_threads.append(None)
+            self._spawn_results.append(None)
+            self._drain_deadline.append(None)
+            self._cancel_spawn.append(False)
+            return slot
+
+    def shrink(self, slot: int) -> str:
+        """Scale one slot away, gracefully; returns the slot's state
+        after the call. A RUNNING slot drains via the PR-9 SIGTERM path
+        (rpc `drain` first so refusals start even if signal delivery
+        lags): it refuses new submits, finishes its in-flight streams,
+        and exits on its own — poll() then retires it to STOPPED with
+        NO restart-budget charge and NO respawn. A BACKOFF slot's
+        pending respawn is cancelled outright; a SPAWNING slot's
+        in-flight attempt is flagged for _collect_spawn to reap.
+        Intentional scale-down is not a crash: none of these touch
+        `restarts`, `_budget_used`, or the rolling window."""
+        if not 0 <= slot < len(self.specs):
+            raise ValueError(
+                f"shrink targets slot {slot}; this fleet has "
+                f"{len(self.specs)}"
+            )
+        now = self.clock.now()
+        with self._lock:
+            st = self.states[slot]
+            if st == RUNNING:
+                w = self.workers[slot]
+                if w is not None and w.poll() is None:
+                    try:
+                        w.client.call("drain", timeout_s=1.0, retries=0)
+                    except (RpcError, RpcRemoteError):
+                        pass  # SIGTERM below carries the same intent
+                    try:
+                        w.kill_signal("SIGTERM")
+                    except OSError:
+                        pass
+                    self.states[slot] = DRAINING
+                    self._drain_deadline[slot] = (
+                        now + self.config.shrink_kill_after_s
+                    )
+                else:
+                    # already a corpse: collect it without the budget
+                    # charge a poll()-observed death would levy
+                    if w is not None:
+                        w.reap()
+                    self.workers[slot] = None
+                    self.states[slot] = STOPPED
+            elif st == BACKOFF:
+                self.states[slot] = STOPPED
+            elif st == SPAWNING:
+                self._cancel_spawn[slot] = True
+            elif st == FAILED:
+                self.states[slot] = STOPPED
+            return self.states[slot]
+
     # ------------------------------------------------------ the state loop
     def poll(self, now: Optional[float] = None) -> None:
         """One liveness pass: waitpid every RUNNING slot (dead ->
@@ -356,6 +458,27 @@ class Supervisor:
                     w = self.workers[slot]
                     if w is None or w.poll() is not None:
                         self._on_death(slot, now)
+                elif st == DRAINING:
+                    w = self.workers[slot]
+                    if w is None or w.poll() is not None:
+                        # drained clean (exit 0) or chaos-killed
+                        # mid-drain: either way the slot RETIRES —
+                        # an intentional scale-down is not a crash,
+                        # so no budget charge and no respawn
+                        if w is not None:
+                            w.reap()
+                        self.workers[slot] = None
+                        self.states[slot] = STOPPED
+                        self._drain_deadline[slot] = None
+                    elif (self._drain_deadline[slot] is not None
+                          and now >= self._drain_deadline[slot]):
+                        # the drain never converged: put it down for
+                        # real (the handle already salvaged its work)
+                        try:
+                            w.kill_signal("SIGKILL")
+                        except OSError:
+                            pass
+                        self._drain_deadline[slot] = None
                 elif st == BACKOFF and now >= self._next_at[slot]:
                     self._begin_spawn(slot, now)
                 elif st == SPAWNING:
@@ -444,6 +567,17 @@ class Supervisor:
         self._spawn_results[slot] = None
         self._spawn_threads[slot] = None
         kind, val = res
+        if self._cancel_spawn[slot]:
+            # shrink() landed while the spawn was in flight: the slot
+            # is being scaled away, so the fresh worker (if the spawn
+            # even succeeded) is reaped, and a spawn FAILURE costs no
+            # budget — cancellation is intent, not a crash
+            self._cancel_spawn[slot] = False
+            if kind == "ok":
+                val.reap()
+            self.workers[slot] = None
+            self.states[slot] = STOPPED
+            return
         if kind == "ok":
             self.workers[slot] = val
             self.states[slot] = RUNNING
@@ -555,6 +689,13 @@ class RemoteReplicaHandle:
         # stats say otherwise (or the drained process exits)
         self.last_submit_refused = False
         self._remote_draining = False
+        # scale-down lifecycle: begin_drain() is stamped by the
+        # autoscaler when it shrinks this slot; once the drained
+        # process exits with nothing left to salvage, step() sets
+        # `drained` and goes quiet instead of raising ReplicaCrashed —
+        # a retirement, not a failover
+        self._drain_requested = False
+        self.drained = False
         # rids shed via shed_queued(): their worker-side sub-completions
         # are already finalized by the router from the op's reply, so
         # when they replay through the push stream / poll they must be
@@ -745,13 +886,26 @@ class RemoteReplicaHandle:
         waitpid sees a real corpse and schedules the restart)."""
         now = self.clock.now()
         self.supervisor.poll(now)
-        if not self.supervisor.alive(self.id):
+        if (not self.supervisor.alive(self.id)
+                and not self.supervisor.draining(self.id)):
             # one FINAL stream drain before the failover: frames the
             # kernel buffered before the death survive the process, and
             # the salvage point + chunk slice they carry are fresher
             # than our last applied snapshot — minutes of resume gap
             # become the one burst the frame missed
             self._final_drain()
+            if self._drain_requested \
+                    and self.supervisor.state(self.id) == STOPPED:
+                done = {c.rid for c in self._pending}
+                if all(rid in done for rid in self.outstanding):
+                    # clean scale-down retirement: every stream this
+                    # worker owed is finalized (or pending finalize),
+                    # the process exited on its own, nothing to fail
+                    # over — the autoscaler reaps the handle
+                    self.drained = True
+                    return
+                # chaos killed the draining worker mid-stream: this IS
+                # a failover — the salvage below re-admits the leftovers
             raise ReplicaCrashed(f"worker {self.id}: process down")
         if self._broken:
             self._broken = False
@@ -905,7 +1059,25 @@ class RemoteReplicaHandle:
             self._shed_skip.add(rid)
         return list(r["rids"])
 
+    def begin_drain(self) -> None:
+        """Handle-side half of a scale-down: stop offering this replica
+        to dispatch NOW (before the worker's first refusal can round
+        trip) and remember that a coming death is a retirement. The
+        process-side half — rpc drain + SIGTERM — is
+        `Supervisor.shrink()`."""
+        self._drain_requested = True
+        self._remote_draining = True
+
     # ------------------------------------------------------- observables
+    @property
+    def kv_summary(self) -> Optional[dict]:
+        """The worker's last-heartbeat KV/radix-cache summary (blocks
+        in use, prefix hit rate, evictable count) — None until a stats
+        frame carried one. Federated into per-worker gauges by
+        fleet_targets/ScrapeFederator; the groundwork for cache-aware
+        routing."""
+        return self._stats.get("kv")
+
     @property
     def load(self) -> float:
         # `outstanding` is this handle's live work SYNCHRONOUSLY (the
@@ -1097,23 +1269,36 @@ def make_fleet_router(
 
 
 def make_federated_server(supervisor: Supervisor,
-                          handles: List["RemoteReplicaHandle"], *,
-                          port: int = 0, stale_after_s: float = 5.0):
+                          handles, *,
+                          port: int = 0, stale_after_s: float = 5.0,
+                          autoscaler=None):
     """One fleet-level TelemetryServer over every worker's endpoints:
     /metrics re-labels each worker's exposition with worker="N" plus
     fleet_worker_up / heartbeat-age / restart series, /healthz renders
     the verdict tools/check_fleet.py judges, /flight rolls the workers'
     latency windows into true fleet percentiles (pooled samples, shared
     percentile_summary). Returns (federator, server); caller owns
-    server.close()."""
+    server.close().
+
+    `handles` may be a list OR a zero-arg callable returning the
+    CURRENT handle list. The callable form is what an elastic fleet
+    needs: the federator re-resolves targets on every scrape, so a
+    slot promoted or drained mid-run appears/disappears from the
+    federated views instead of going stale (slot ids are stable, so
+    every label minted for worker="N" stays true). With `autoscaler`
+    set, /healthz carries its state block (size/min/max, standby
+    depth, last scale event) for tools/check_fleet.py."""
     from ddp_practice_tpu.utils.telemetry import (
         ScrapeFederator,
         TelemetryServer,
     )
 
+    handles_fn = handles if callable(handles) else (lambda: handles)
     fed = ScrapeFederator(
-        lambda: fleet_targets(supervisor, handles),
+        lambda: fleet_targets(supervisor, handles_fn()),
         stale_after_s=stale_after_s,
+        autoscaler_fn=(autoscaler.snapshot
+                       if autoscaler is not None else None),
     )
     server = TelemetryServer(registry=fed, healthz_fn=fed.healthz,
                              flight_fn=fed.flight, port=port)
@@ -1124,7 +1309,9 @@ def fleet_targets(supervisor: Supervisor,
                   handles: List[RemoteReplicaHandle]) -> Dict[int, dict]:
     """The scrape federator's view of the fleet: per slot, where the
     worker's telemetry endpoints live and how fresh its heartbeat is
-    (utils/telemetry.py ScrapeFederator consumes this)."""
+    (utils/telemetry.py ScrapeFederator consumes this). Keyed by the
+    handle's STABLE slot id — an elastic fleet appends slots and
+    tombstones shrunk ones, so ids never alias across scale events."""
     out: Dict[int, dict] = {}
     for h in handles:
         w = supervisor.worker(h.id)
@@ -1134,7 +1321,9 @@ def fleet_targets(supervisor: Supervisor,
             "pid": w.pid if w is not None else None,
             "up": w is not None,
             "state": supervisor.state(h.id),
+            "draining": supervisor.draining(h.id),
             "restarts": supervisor.restarts[h.id],
             "heartbeat_age_s": h.heartbeat_age(),
+            "kv": h.kv_summary,
         }
     return out

@@ -301,8 +301,32 @@ class WorkerServer:
         warm_engine(self.engine)
 
     # ------------------------------------------------------------- ops
+    def _kv_summary(self) -> dict:
+        """KV/radix-cache occupancy riding every heartbeat frame: blocks
+        in use / shared, prefix-cache hit rate, evictable count. Zeros
+        for the slot engine (no paged pool) — the getattr guards mirror
+        ServeMetrics.on_tick. Federated into per-worker gauges by the
+        fleet view; the groundwork for cache-aware routing."""
+        eng = self.engine
+        blocks = getattr(eng, "blocks", None)  # PagedEngine only
+        radix = getattr(eng, "radix", None)
+        hit = getattr(radix, "hit_tokens", 0) if radix is not None else 0
+        miss = getattr(radix, "miss_tokens", 0) if radix is not None else 0
+        return {
+            "blocks_used": blocks.num_used if blocks is not None else 0,
+            "blocks_shared": blocks.num_shared if blocks is not None else 0,
+            # minus the garbage block, same accounting as the gauges
+            "blocks_total": (blocks.num_blocks - 1
+                             if blocks is not None else 0),
+            "evictable": radix.evictable() if radix is not None else 0,
+            "hit_tokens": hit,
+            "miss_tokens": miss,
+            "prefix_hit_rate": hit / (hit + miss) if hit + miss else 0.0,
+        }
+
     def _stats(self) -> dict:
         return {
+            "kv": self._kv_summary(),
             "replica": self.spec.replica,
             "pid": os.getpid(),
             "t": time.monotonic(),

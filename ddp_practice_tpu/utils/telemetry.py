@@ -947,10 +947,15 @@ class ScrapeFederator:
 
     def __init__(self, targets_fn: Callable[[], Dict], *,
                  timeout_s: float = 1.0,
-                 stale_after_s: float = 5.0) -> None:
+                 stale_after_s: float = 5.0,
+                 autoscaler_fn: Optional[Callable[[], dict]] = None) -> None:
         self.targets_fn = targets_fn
         self.timeout_s = timeout_s
         self.stale_after_s = stale_after_s
+        # optional serve/autoscaler.py Autoscaler.snapshot: when set,
+        # /healthz carries the controller's state block (size/min/max,
+        # standby depth, last scale event) for tools/check_fleet.py
+        self.autoscaler_fn = autoscaler_fn
 
     def _get(self, host: str, port: int, path: str) -> Optional[str]:
         import http.client
@@ -1009,6 +1014,16 @@ class ScrapeFederator:
                 f"fleet_worker_restarts_total{{{extra}}} "
                 f"{t.get('restarts', 0)}"
             )
+            kv = t.get("kv")
+            if kv:
+                # heartbeat-carried KV/radix summary -> per-worker
+                # gauges (no extra scrape: these rode the stats frames)
+                out.append(f"fleet_kv_blocks_used{{{extra}}} "
+                           f"{kv.get('blocks_used', 0)}")
+                out.append(f"fleet_kv_evictable{{{extra}}} "
+                           f"{kv.get('evictable', 0)}")
+                out.append(f"fleet_prefix_hit_rate{{{extra}}} "
+                           f"{kv.get('prefix_hit_rate', 0.0)}")
             if not up:
                 continue
             text = scraped.get(wid)
@@ -1089,22 +1104,43 @@ class ScrapeFederator:
                 status = str(inner.get("status", "dead")).lower()
                 status = {"healthy": "healthy",
                           "degraded": "degraded"}.get(status, "dead")
-            workers[str(wid)] = {
+            entry = {
                 "status": status,
                 "pid": t.get("pid"),
                 "state": t.get("state"),
+                # the flag tools/check_fleet.py skips on: a draining
+                # worker going quiet is the drain working, not a page
+                "draining": bool(t.get("draining"))
+                or t.get("state") == "draining",
                 "restarts": t.get("restarts", 0),
                 "heartbeat_age_s": hb,
                 "replicas": (inner or {}).get("replicas", {}),
             }
-        vals = [w["status"] for w in workers.values()]
+            if t.get("kv") is not None:
+                entry["kv"] = t["kv"]
+            workers[str(wid)] = entry
+        # a DRAINING worker is leaving on purpose: its refusals must
+        # not read as fleet degradation, so it is excluded from the
+        # overall verdict (but stays listed, status annotated)
+        voting = [
+            w["status"] for w in workers.values()
+            if not w.get("draining")
+        ]
+        vals = voting if voting else [w["status"]
+                                      for w in workers.values()]
         if vals and all(v == "dead" for v in vals):
             overall = "DEAD"
         elif not vals or any(v != "healthy" for v in vals):
             overall = "DEGRADED" if vals else "DEAD"
         else:
             overall = "HEALTHY"
-        return {"status": overall, "fleet": True, "workers": workers}
+        out = {"status": overall, "fleet": True, "workers": workers}
+        if self.autoscaler_fn is not None:
+            try:
+                out["autoscaler"] = self.autoscaler_fn()
+            except Exception:
+                out["autoscaler"] = None
+        return out
 
 
 # ------------------------------------------------------- train-side rolling

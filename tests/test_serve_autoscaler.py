@@ -1,0 +1,511 @@
+"""serve/autoscaler.py — the elastic control loop, host-pure.
+
+Three layers, no processes:
+
+- **AutoscalerPolicy** with explicit timestamps: every transition the
+  control law can make — eval throttle, trip-fast (pressure and SLO
+  burn), deadband, resolve-slow calm window (anchor + reset), the
+  no-reversal-inside-hold contract in BOTH directions, per-direction
+  cooldowns, min/max clamps — replays on pinned FakeClock-style time.
+  The anti-oscillation claim is pinned as a PROPERTY: an adversarial
+  burst/calm square wave cannot extract more than elapsed/hold_s
+  reversals, and consecutive reversals are >= hold_s apart.
+
+- **StandbyPool** with `spawn_in_thread=False`: provision/take FIFO,
+  replenish ordering, the spawn-error ledger, and close-reaps-all.
+
+- **Autoscaler** over a FakeWorker supervisor and a REAL Router: warm
+  promotion from the pool, cold fallback through the backoff pipeline
+  (budget-free), scale-down via the drain path with retire-on-exit —
+  including chaos SIGKILL mid-drain — plus snapshot/pressure_log/gauge
+  plumbing. The real-process truth of the same loop is the slow+chaos
+  test in tests/test_worker_fleet.py and the autoscale_burst_100rps
+  bench.
+"""
+
+import pytest
+
+from ddp_practice_tpu.serve.autoscaler import (
+    Autoscaler,
+    AutoscalerConfig,
+    AutoscalerPolicy,
+    StandbyPool,
+)
+from ddp_practice_tpu.serve.router import Router
+from ddp_practice_tpu.serve.scheduler import FakeClock, Request
+from ddp_practice_tpu.serve.supervisor import (
+    BACKOFF,
+    DRAINING,
+    RUNNING,
+    STOPPED,
+    RemoteReplicaHandle,
+    Supervisor,
+    SupervisorConfig,
+)
+from ddp_practice_tpu.serve.worker import WorkerSpec
+
+
+# --------------------------------------------------------------- policy
+CFG = AutoscalerConfig(
+    min_size=1, max_size=4, eval_interval_s=1.0,
+    up_pressure=1.5, down_pressure=0.5,
+    hold_s=10.0, cooldown_up_s=2.0, cooldown_down_s=15.0,
+    down_stable_s=5.0, standby_target=1,
+)
+
+
+def test_config_validation():
+    with pytest.raises(ValueError, match="min_size"):
+        AutoscalerConfig(min_size=0)
+    with pytest.raises(ValueError, match="max_size"):
+        AutoscalerConfig(min_size=3, max_size=2)
+    with pytest.raises(ValueError, match="deadband"):
+        AutoscalerConfig(up_pressure=1.0, down_pressure=1.0)
+
+
+def test_eval_throttle_one_evaluation_per_interval():
+    pol = AutoscalerPolicy(CFG)
+    assert pol.step(0.0, size=1, pressure=9.0) is not None
+    # a raging burst 0.5s later is NOT evaluated — the throttle is on
+    # evaluations, not just commits (cooldown_up_s alone would pass it)
+    assert pol.step(0.5, size=1, pressure=9.0) is None
+    assert pol._last_eval == 0.0
+
+
+def test_trip_fast_on_queue_pressure_same_evaluation():
+    pol = AutoscalerPolicy(CFG)
+    d = pol.step(0.0, size=2, pressure=1.5)   # at threshold: inclusive
+    assert d is not None
+    assert d["direction"] == "up" and d["trigger"] == "queue_pressure"
+    assert d["size"] == 2 and d["pressure"] == 1.5
+
+
+def test_trip_fast_on_slo_burn_even_at_zero_pressure():
+    # the burn alert means users are ALREADY hurting; pressure may lag
+    pol = AutoscalerPolicy(CFG)
+    d = pol.step(0.0, size=2, pressure=0.0, slo_active=True,
+                 slo_resolved=False)
+    assert d is not None and d["trigger"] == "slo_burn"
+
+
+def test_deadband_moves_nothing():
+    pol = AutoscalerPolicy(CFG)
+    for k in range(20):
+        assert pol.step(float(k), size=2, pressure=1.0) is None
+    assert pol.events == [] and pol._calm_since is None
+
+
+def test_resolve_slow_requires_continuous_calm():
+    pol = AutoscalerPolicy(CFG)
+    assert pol.step(0.0, size=2, pressure=0.1) is None   # calm anchors
+    assert pol._calm_since == 0.0
+    # one noisy sample inside the window resets the anchor entirely
+    assert pol.step(2.0, size=2, pressure=1.0) is None
+    assert pol._calm_since is None
+    assert pol.step(3.0, size=2, pressure=0.1) is None   # re-anchor
+    assert pol.step(7.0, size=2, pressure=0.1) is None   # 4s < 5s
+    d = pol.step(8.0, size=2, pressure=0.1)              # 5s: resolve
+    assert d is not None
+    assert d["direction"] == "down" and d["trigger"] == "slo_resolved"
+
+
+def test_calm_needs_slo_resolved_not_just_low_pressure():
+    # a drained queue while the slow burn window still smolders is not
+    # calm — scale-down waits for the watchdog's resolve
+    pol = AutoscalerPolicy(CFG)
+    for k in range(8):
+        assert pol.step(float(k), size=2, pressure=0.0,
+                        slo_resolved=False) is None
+    assert pol._calm_since is None
+
+
+def test_no_reversal_inside_hold_up_then_down():
+    pol = AutoscalerPolicy(CFG)
+    assert pol.step(0.0, size=1, pressure=3.0)["direction"] == "up"
+    # burst ends instantly; calm holds its full 5s by t=6 — but the
+    # up at t=0 forbids a down until t=10, however calm the fleet is
+    for t in (1.0, 2.0, 6.0, 9.0):
+        assert pol.step(t, size=2, pressure=0.0) is None
+    d = pol.step(10.0, size=2, pressure=0.0)
+    assert d is not None and d["direction"] == "down"
+
+
+def test_no_reversal_inside_hold_down_then_up():
+    pol = AutoscalerPolicy(CFG)
+    for t in (0.0, 5.0):
+        pol.step(t, size=3, pressure=0.0)
+    assert pol.events[-1]["direction"] == "down"         # at t=5
+    # the burst returns immediately: up is refused until t=15
+    for t in (6.0, 10.0, 14.0):
+        assert pol.step(t, size=2, pressure=9.0) is None
+    d = pol.step(15.0, size=2, pressure=9.0)
+    assert d is not None and d["direction"] == "up"
+
+
+def test_per_direction_cooldowns_pace_same_direction_steps():
+    pol = AutoscalerPolicy(CFG)
+    assert pol.step(0.0, size=1, pressure=3.0) is not None
+    assert pol.step(1.0, size=2, pressure=3.0) is None    # < 2s cooldown
+    assert pol.step(2.0, size=2, pressure=3.0) is not None
+    # downs pace on the LONG cooldown (resolve slow): first down at
+    # t=20 (hold from the t=2 up expires at 12, calm anchored at 13)
+    for t in (13.0, 20.0):
+        pol.step(t, size=3, pressure=0.0)
+    assert pol.events[-1] == dict(pol.events[-1], direction="down")
+    down_t = pol.events[-1]["t"]
+    assert down_t == 20.0
+    # calm persists, but the next down waits out cooldown_down_s=15
+    for t in (25.0, 30.0, 34.0):
+        assert pol.step(t, size=2, pressure=0.0) is None
+    assert pol.step(35.0, size=2, pressure=0.0) is not None
+
+
+def test_min_max_clamp():
+    pol = AutoscalerPolicy(CFG)
+    assert pol.step(0.0, size=4, pressure=9.0) is None    # at max
+    pol2 = AutoscalerPolicy(CFG)
+    for t in (0.0, 6.0):
+        assert pol2.step(t, size=1, pressure=0.0) is None  # at min
+    assert pol2.events == []
+
+
+def test_up_commit_reanchors_the_calm_window():
+    # a grow is about to relieve pressure: inheriting pre-burst calm
+    # samples would let a down fire moments after the up
+    pol = AutoscalerPolicy(CFG)
+    pol.step(0.0, size=2, pressure=0.1)
+    assert pol._calm_since == 0.0
+    pol.step(1.0, size=2, pressure=9.0)   # burst resets it anyway...
+    pol.step(3.0, size=2, pressure=9.0)   # ...and the commit re-clears
+    assert pol.events[-1]["direction"] == "up"
+    assert pol._calm_since is None
+
+
+def test_reversals_bounded_by_hold_window_property():
+    """The anti-oscillation contract as a property: an adversarial
+    burst/calm square wave (3.5s phases, shorter than hold_s) cannot
+    extract reversals closer than hold_s apart, and no more than
+    elapsed/hold_s + 1 of them, EVER."""
+    cfg = AutoscalerConfig(
+        min_size=1, max_size=4, eval_interval_s=0.5,
+        up_pressure=1.5, down_pressure=0.5,
+        hold_s=5.0, cooldown_up_s=0.5, cooldown_down_s=0.5,
+        down_stable_s=0.5, standby_target=0,
+    )
+    pol = AutoscalerPolicy(cfg)
+    size, t = 2, 0.0
+    for k in range(400):
+        burst = (k // 7) % 2 == 0          # 7 evals per phase = 3.5s
+        d = pol.step(t, size=size,
+                     pressure=(9.0 if burst else 0.0))
+        if d is not None:
+            size += 1 if d["direction"] == "up" else -1
+            assert cfg.min_size <= size <= cfg.max_size
+        t += 0.5
+    evs = pol.events
+    assert evs, "the adversary must provoke at least one event"
+    reversals = [
+        (a, b) for a, b in zip(evs, evs[1:])
+        if a["direction"] != b["direction"]
+    ]
+    for a, b in reversals:
+        assert b["t"] - a["t"] >= cfg.hold_s
+    assert len(reversals) <= t / cfg.hold_s + 1
+
+
+# ----------------------------------------------------------------- pool
+class PoolWorker:
+    def __init__(self, spec):
+        self.spec = spec
+        self.reaped = False
+
+    def reap(self, timeout_s=5.0):
+        self.reaped = True
+
+
+def make_pool(fail_rids=()):
+    spawned = []
+
+    def spawn(spec):
+        if spec.replica in fail_rids:
+            raise RuntimeError(f"boom rid {spec.replica}")
+        w = PoolWorker(spec)
+        spawned.append(w)
+        return w
+
+    spec_fn = lambda rid: WorkerSpec(replica=rid)   # noqa: E731
+    pool = StandbyPool(spec_fn, spawn_fn=spawn, spawn_in_thread=False)
+    return pool, spawned
+
+
+def test_pool_provision_take_fifo_and_ledgers():
+    pool, spawned = make_pool()
+    pool.provision(5)
+    pool.provision(6)
+    assert pool.ready_count == 2 and pool.in_flight == 0
+    assert pool.spawned_total == 2 and len(spawned) == 2
+    rid, spec, worker = pool.take()          # oldest first
+    assert rid == 5 and spec.replica == 5 and worker is spawned[0]
+    assert pool.take()[0] == 6
+    assert pool.take() is None               # empty -> cold fallback
+    assert pool.wait_ready(timeout_s=0.05) is False
+
+
+def test_pool_spawn_error_ledger_does_not_wedge():
+    pool, spawned = make_pool(fail_rids={7})
+    pool.provision(7)
+    pool.provision(8)
+    assert pool.ready_count == 1
+    assert pool.spawn_errors == [(7, "RuntimeError('boom rid 7')")]
+    assert pool.take()[0] == 8               # the failure didn't block
+
+
+def test_pool_close_reaps_and_refuses():
+    pool, spawned = make_pool()
+    pool.provision(1)
+    pool.close()
+    assert spawned[0].reaped
+    pool.provision(2)                        # refused, not queued
+    assert pool.ready_count == 0 and pool.in_flight == 0
+    assert pool.take() is None
+    assert pool.wait_ready(timeout_s=0.05) is False
+
+
+# --------------------------------------------------------- orchestrator
+class FakeClient:
+    def __init__(self, handler):
+        self.handler = handler
+        self.calls = []
+        self.closed = False
+
+    def call(self, op, **fields):
+        self.calls.append((op, fields))
+        return {"ok": True, **self.handler(op, fields)}
+
+    def close(self):
+        self.closed = True
+
+
+class ElasticWorker:
+    """FakeWorker whose SIGTERM does NOT kill it — a draining worker
+    survives until the test decides how it dies (clean, chaos SIGKILL,
+    or the supervisor's deadline escalation)."""
+
+    _next_pid = [6000]
+
+    def __init__(self, spec, handler):
+        ElasticWorker._next_pid[0] += 1
+        self.pid = ElasticWorker._next_pid[0]
+        self.spec = spec
+        self.rc = None
+        self.signals = []
+        self.reaped = False
+        self.telemetry_port = 9500 + self.pid % 100
+        self.client = FakeClient(handler)
+
+    def poll(self):
+        return self.rc
+
+    def kill_signal(self, sig):
+        self.signals.append(sig)
+        if sig == "SIGKILL":
+            self.rc = -9
+
+    def die(self, rc=1):
+        self.rc = rc
+
+    def reap(self, timeout_s=5.0):
+        self.reaped = True
+        self.client.close()
+
+
+SPEC = WorkerSpec(engine={"max_slots": 2, "prompt_buckets": [8, 16]},
+                  max_queue=8)
+SUPCFG = SupervisorConfig(restart_base_s=0.2, restart_factor=2.0,
+                          restart_max_s=10.0, restart_jitter=0.0,
+                          restart_budget=3)
+
+
+class FakeSLO:
+    """Scriptable burn signal (the watchdog's own law is pinned in
+    tests/test_slo.py — here it is an autoscaler INPUT)."""
+
+    def __init__(self):
+        self.active = False
+        self.resolved = True
+
+    def evaluate(self, now):
+        pass
+
+    def on_completion(self, c):
+        pass
+
+    def burn_signal(self):
+        return {"burn_fast": 0.0, "burn_slow": 0.0,
+                "active": self.active, "resolved": self.resolved}
+
+
+def make_elastic(n=1, *, acfg=None, handler=None, slo=None):
+    spawned = []
+
+    def default_handler(op, fields):
+        if op == "poll":
+            return {"completions": [], "inflight": [], "watermark": 0,
+                    "stats": {"queue": 0, "active": 0, "max_slots": 2}}
+        return {"accepted": True}
+
+    def spawn(spec):
+        w = ElasticWorker(spec, handler or default_handler)
+        spawned.append(w)
+        return w
+
+    clock = FakeClock(step_s=0.01)
+    sup = Supervisor([SPEC] * n, SUPCFG, spawn_fn=spawn,
+                     spawn_in_thread=False, clock=clock)
+    sup.start()
+    handles = [RemoteReplicaHandle(i, sup, SPEC, clock=clock)
+               for i in range(n)]
+    router = Router(handles, clock=clock, slo=slo)
+    asc = Autoscaler(router, sup, SPEC,
+                     config=acfg or AutoscalerConfig(
+                         min_size=1, max_size=3, eval_interval_s=1.0,
+                         up_pressure=1.5, down_pressure=0.5,
+                         hold_s=10.0, cooldown_up_s=2.0,
+                         cooldown_down_s=15.0, down_stable_s=5.0,
+                         standby_target=1),
+                     clock=clock, spawn_fn=spawn, spawn_in_thread=False)
+    router.autoscaler = asc
+    return router, sup, asc, clock, spawned
+
+
+def _burst(router, clock, n=4, rid0=100):
+    for i in range(n):
+        assert router.submit(Request(rid=rid0 + i, prompt=[1, 2, 3],
+                                     max_new_tokens=4,
+                                     arrival=clock.now()))
+
+
+def test_grow_promotes_warm_standby_and_joins_router():
+    router, sup, asc, clock, spawned = make_elastic()
+    assert asc.pool.ready_count == 1          # pre-provisioned, sync
+    assert len(spawned) == 2                  # slot 0 + the standby
+    _burst(router, clock)                     # load 4 / slots 2 = 2.0
+    ev = asc.step(clock.now())
+    assert ev is not None and ev["direction"] == "up"
+    assert ev["trigger"] == "queue_pressure" and ev["warm"] is True
+    assert ev["slot"] == 1 and ev["size"] == 2
+    assert ev["join_s"] >= 0.0
+    assert sup.state(1) == RUNNING            # promotion, not backoff
+    assert sup.restarts[1] == 0 and sup._budget_used[1] == 0
+    assert len(router.handles) == 2
+    assert router.handles[-1].id == 1
+    # the promoted worker was PROBED (ping) before dispatch trusts it
+    assert ("ping" in [op for op, _ in spawned[1].client.calls])
+    # pool replenished BEHIND the promotion, with a fresh rid
+    assert asc.pool.ready_count == 1
+    assert spawned[-1].spec.replica == 2
+    # gauges track the event
+    assert router.metrics.fleet_size.value == 2
+    assert router.metrics.standby_ready.value == 1
+    assert asc.snapshot()["events_total"] == 1
+
+
+def test_grow_cold_fallback_when_pool_is_empty():
+    acfg = AutoscalerConfig(min_size=1, max_size=3, eval_interval_s=1.0,
+                            up_pressure=1.5, down_pressure=0.5,
+                            hold_s=10.0, cooldown_up_s=2.0,
+                            cooldown_down_s=15.0, down_stable_s=5.0,
+                            standby_target=0)
+    router, sup, asc, clock, spawned = make_elastic(acfg=acfg)
+    assert asc.pool.ready_count == 0
+    _burst(router, clock)
+    ev = asc.step(clock.now())
+    assert ev is not None and ev["warm"] is False
+    # the cold slot rides the BACKOFF pipeline, due now, budget-free
+    assert sup.state(1) == BACKOFF
+    sup.poll()
+    assert sup.state(1) == RUNNING
+    assert sup.restarts[1] == 0 and sup._budget_used[1] == 0
+
+
+def test_slo_burn_trips_scale_up_without_pressure():
+    slo = FakeSLO()
+    router, sup, asc, clock, spawned = make_elastic(slo=slo)
+    slo.active, slo.resolved = True, False
+    ev = asc.step(clock.now())
+    assert ev is not None and ev["trigger"] == "slo_burn"
+    assert sup.active_slots() == 2
+
+
+def test_scale_down_drains_newest_and_retires_on_exit():
+    router, sup, asc, clock, spawned = make_elastic(n=2)
+    t0 = clock.now()
+    assert asc.step(t0) is None               # calm anchors
+    ev = asc.step(t0 + 6.0)                   # 6s calm > down_stable 5s
+    assert ev is not None and ev["direction"] == "down"
+    assert ev["slot"] == 1                    # newest leaves first
+    # drain in flight: rpc drain + SIGTERM sent, handle stops offering
+    w = spawned[1]
+    assert ("drain", {"timeout_s": 1.0, "retries": 0}) in w.client.calls
+    assert w.signals == ["SIGTERM"]
+    assert sup.state(1) == DRAINING
+    h1 = router.handles[-1]
+    assert h1._drain_requested and not h1.has_queue_space
+    assert len(router.handles) == 2           # still listed while alive
+    assert asc.snapshot()["draining"] == [1]
+    # the worker finishes its streams and exits CLEANLY
+    w.die(rc=0)
+    sup.poll()
+    assert sup.state(1) == STOPPED
+    asc.step(t0 + 7.0)                        # retire pass
+    assert len(router.handles) == 1
+    assert asc.drain_log[-1]["slot"] == 1
+    assert asc.snapshot()["draining"] == []
+    assert sup.restarts[1] == 0 and sup._budget_used[1] == 0
+    assert router.metrics.fleet_size.value == 1
+
+
+def test_chaos_sigkill_mid_drain_still_retires_without_budget():
+    router, sup, asc, clock, spawned = make_elastic(n=2)
+    t0 = clock.now()
+    asc.step(t0)
+    ev = asc.step(t0 + 6.0)
+    assert ev is not None and ev["direction"] == "down"
+    # chaos: SIGKILL the DRAINING worker mid-scale-down
+    spawned[1].die(rc=-9)
+    sup.poll()
+    assert sup.state(1) == STOPPED            # retirement, not a crash
+    asc.step(t0 + 7.0)
+    assert len(router.handles) == 1
+    assert sup.restarts[1] == 0 and sup._budget_used[1] == 0
+    # and no respawn ever comes for the shrunk slot
+    clock.advance(3600.0)
+    sup.poll()
+    assert sup.state(1) == STOPPED and len(spawned) == 3
+
+
+def test_pressure_log_rows_once_per_evaluation():
+    router, sup, asc, clock, spawned = make_elastic()
+    t0 = clock.now()
+    asc.step(t0)
+    asc.step(t0 + 0.5)                        # throttled: no row
+    asc.step(t0 + 1.0)
+    assert [r["t"] for r in asc.pressure_log] == [t0, t0 + 1.0]
+    assert all(r["size"] == 1 and r["pressure"] == 0.0
+               for r in asc.pressure_log)
+
+
+def test_router_step_ticks_the_loop_and_snapshot_shape():
+    router, sup, asc, clock, spawned = make_elastic()
+    _burst(router, clock)
+    router.step()                             # router drives the tick
+    assert asc.snapshot()["size"] == 2        # scaled up inside step()
+    snap = asc.snapshot()
+    assert set(snap) == {"size", "min", "max", "standby_ready",
+                         "standby_target", "draining", "events_total",
+                         "last_event", "last_join_s"}
+    assert snap["min"] == 1 and snap["max"] == 3
+    assert snap["last_event"]["direction"] == "up"
+    assert snap["last_join_s"] is not None
+    asc.close()
+    assert asc.pool.ready_count == 0

@@ -29,6 +29,13 @@ TELEMETRY = os.path.join(ROOT, "tests", "data", "bench_telemetry.jsonl")
 # heartbeat-stale worker — the two verdicts check_fleet exists to catch
 FLEET_OK = os.path.join(ROOT, "tests", "data", "fleet_healthz_ok.json")
 FLEET_BAD = os.path.join(ROOT, "tests", "data", "fleet_healthz_bad.json")
+# the elastic pair (ISSUE 14): a fleet mid-scale-down whose draining
+# worker has gone quiet ON PURPOSE, and the same snapshot with the
+# drain flag unset + an autoscaler size outside [min, max]
+ELASTIC_OK = os.path.join(ROOT, "tests", "data",
+                          "fleet_healthz_autoscale_ok.json")
+ELASTIC_BAD = os.path.join(ROOT, "tests", "data",
+                           "fleet_healthz_autoscale_bad.json")
 # streaming exactly-once audit artifacts: a deterministic FakeClock
 # 2-replica run with a scripted mid-stream crash (so the PASSING
 # artifact contains resumed markers — failover is part of the
@@ -160,6 +167,55 @@ def test_check_fleet_verdict_as_library_too():
     assert 'serve_tokens_total{worker="1"}' in snap["metrics"]
 
 
+def test_check_fleet_autoscale_exit_codes_both_ways(tmp_path):
+    """ISSUE-14 satellite: the elastic verdict pinned both ways over
+    checked-in artifacts. A draining worker's dead probe and stale
+    heartbeat are the drain WORKING (exit 0, worker skipped); the same
+    silence without the drain flag pages, and an autoscaler size
+    outside [min, max] — the control loop and the supervisor
+    disagreeing about the world — is a problem in its own right."""
+    r = _run("tools/check_fleet.py", ELASTIC_OK)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert ": OK" in r.stdout
+    assert "[draining]" in r.stdout          # listed, annotated, skipped
+    assert "autoscaler: size 2 (min 1, max 3)" in r.stdout
+    assert "last event: down (slo_resolved)" in r.stdout
+    r = _run("tools/check_fleet.py", ELASTIC_BAD)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "FLEET UNHEALTHY" in r.stdout
+    assert "worker 2: status dead" in r.stdout
+    assert "fleet size 4 above max 3" in r.stdout
+    # --json carries the autoscaler block for machine consumers
+    r = _run("tools/check_fleet.py", "--json", ELASTIC_OK)
+    assert r.returncode == 0
+    rep = json.loads(r.stdout)[ELASTIC_OK]
+    assert rep["ok"] is True
+    assert rep["autoscaler"]["size"] == 2
+    assert rep["autoscaler"]["draining"] == [2]
+
+
+def test_check_fleet_autoscale_verdict_as_library():
+    from tools.check_fleet import fleet_verdict, load_snapshot
+
+    ok, problems = fleet_verdict(load_snapshot(ELASTIC_OK))
+    assert ok and problems == []
+    ok, problems = fleet_verdict(load_snapshot(ELASTIC_BAD))
+    assert not ok
+    assert any("above max" in p for p in problems)
+    assert any("worker 2" in p for p in problems)
+    # a size below min pages the other way too
+    snap = load_snapshot(ELASTIC_OK)
+    snap["autoscaler"]["size"] = 0
+    ok, problems = fleet_verdict(snap)
+    assert not ok and any("below min" in p for p in problems)
+    # the OK artifact carries the scale ledger in its /metrics text:
+    # the labelled counter and both gauges are pinned against drift
+    doc = json.load(open(ELASTIC_OK))
+    assert 'serve_scale_events_total{direction="up"' in doc["metrics"]
+    assert "serve_fleet_size 2" in doc["metrics"]
+    assert "serve_standby_ready 1" in doc["metrics"]
+
+
 def test_artifacts_validate_as_library_too():
     """Belt to the CLI suspenders: the library entry points the tests
     and the serve bench use agree with the CLIs."""
@@ -268,6 +324,16 @@ def test_check_bench_exit_codes_both_ways(tmp_path):
     # 31/32 identity must fail, not drift
     assert "spec_decode_8rps.tpot_ratio" in r.stdout
     assert "spec_decode_8rps.token_identity" in r.stdout
+    # the ISSUE-14 elastic gates regress in the same ledger: the
+    # goodput-per-worker edge evaporated, two requests lost across a
+    # scale event, a reaction outside the evaluation window, a thrash
+    # past the hold bound, and a 16s "warm" promotion — the absolute
+    # seconds bound (baseline 0 -> limit = tol) must catch it
+    assert "autoscale_burst_100rps.goodput_per_worker_ratio" in r.stdout
+    assert "autoscale_burst_100rps.lost" in r.stdout
+    assert "autoscale_burst_100rps.reaction_within_window" in r.stdout
+    assert "autoscale_burst_100rps.oscillation_ok" in r.stdout
+    assert "autoscale_burst_100rps.promote_join_s" in r.stdout
     # unreadable input is exit 2, not a fake verdict
     garbage = tmp_path / "garbage.json"
     garbage.write_text("{broken")

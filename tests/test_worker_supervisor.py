@@ -20,6 +20,7 @@ from ddp_practice_tpu.serve.rpc import RpcRemoteError, RpcTimeout
 from ddp_practice_tpu.serve.scheduler import FakeClock, Request
 from ddp_practice_tpu.serve.supervisor import (
     BACKOFF,
+    DRAINING,
     FAILED,
     RUNNING,
     SPAWNING,
@@ -437,3 +438,201 @@ def test_fleet_targets_shape():
     t = fleet_targets(sup, [h])
     assert not t[0]["up"] and t[0]["pid"] is None
     assert t[0]["state"] in (BACKOFF, SPAWNING)
+
+
+# ------------------------------------------------- elastic actuators
+class DrainingWorker(FakeWorker):
+    """A FakeWorker that honors SIGTERM as a REQUEST, not a death:
+    only SIGKILL fells it, so the DRAINING window is observable (the
+    harness FakeWorker drops dead on SIGTERM, which pins the fast path
+    but hides the deadline machinery)."""
+
+    def kill_signal(self, sig):
+        self.signals.append(sig)
+        if sig == "SIGKILL":
+            self.rc = -9
+
+
+def make_sup_draining(n=1, handler=None, cfg=None):
+    spawned = []
+
+    def spawn(spec):
+        w = DrainingWorker(spec, handler)
+        spawned.append(w)
+        return w
+
+    clock = FakeClock(step_s=0.01)
+    sup = Supervisor([SPEC] * n, cfg or CFG, spawn_fn=spawn,
+                     spawn_in_thread=False, clock=clock)
+    sup.start()
+    return sup, clock, spawned
+
+
+def test_shrink_running_drains_rpc_then_sigterm_no_budget():
+    """shrink() of a RUNNING slot: drain rpc first (refusals start even
+    if signal delivery lags), then SIGTERM -> DRAINING; the exit is
+    retired to STOPPED with zero budget charge and zero respawn."""
+    sup, clock, spawned = make_sup(n=2)
+    assert sup.active_slots() == 2
+    assert sup.shrink(1) == DRAINING
+    w = spawned[1]
+    assert ("drain", {"timeout_s": 1.0, "retries": 0}) in w.client.calls
+    assert w.signals == ["SIGTERM"]
+    # a DRAINING worker is still a live process to the handle's eyes
+    assert sup.worker(1) is w and not sup.alive(1)
+    assert sup.draining(1) and sup.active_slots() == 1
+    # the harness FakeWorker exits on SIGTERM: next poll retires it
+    sup.poll()
+    assert sup.state(1) == STOPPED and w.reaped
+    assert sup.worker(1) is None
+    # an intentional goodbye is not a crash: no budget, no respawn
+    assert sup.restarts[1] == 0 and sup._budget_used[1] == 0
+    clock.advance(3600.0)
+    sup.poll()
+    assert sup.state(1) == STOPPED and len(spawned) == 2
+    # slot 0 untouched throughout
+    assert sup.state(0) == RUNNING
+
+
+def test_shrink_draining_deadline_escalates_to_sigkill():
+    """A drain that never converges is put down at shrink_kill_after_s
+    — and the SIGKILLed corpse still retires to STOPPED, not BACKOFF."""
+    cfg = SupervisorConfig(restart_base_s=0.2, restart_jitter=0.0,
+                           restart_budget=3, shrink_kill_after_s=5.0)
+    sup, clock, spawned = make_sup_draining(cfg=cfg)
+    sup.shrink(0)
+    w = spawned[0]
+    assert sup.state(0) == DRAINING and w.signals == ["SIGTERM"]
+    clock.advance(4.9)
+    sup.poll()                      # inside the grace window: no kill
+    assert sup.state(0) == DRAINING and w.signals == ["SIGTERM"]
+    clock.advance(0.2)
+    sup.poll()                      # past the deadline: SIGKILL
+    assert w.signals == ["SIGTERM", "SIGKILL"]
+    sup.poll()                      # corpse collected
+    assert sup.state(0) == STOPPED and w.reaped
+    assert sup.restarts[0] == 0 and sup._budget_used[0] == 0
+
+
+def test_shrink_chaos_sigkill_mid_drain_is_not_a_crash():
+    """Chaos SIGKILLs the worker WHILE it drains: the slot must retire
+    to STOPPED — a draining slot that respawned would undo the
+    scale-down, and a budget charge would punish an intentional act."""
+    sup, clock, spawned = make_sup_draining()
+    sup.shrink(0)
+    assert sup.state(0) == DRAINING
+    spawned[0].die(rc=-9)           # external SIGKILL, not ours
+    sup.poll()
+    assert sup.state(0) == STOPPED and spawned[0].reaped
+    assert sup.restarts[0] == 0 and sup._budget_used[0] == 0
+    clock.advance(3600.0)
+    sup.poll()
+    assert sup.state(0) == STOPPED and len(spawned) == 1
+
+
+def test_shrink_backoff_cancels_pending_respawn_without_budget():
+    """Satellite pin: shrink() of a slot sitting in BACKOFF cancels the
+    scheduled respawn outright — the slot goes STOPPED, the backoff
+    timer never fires, and the budget ledger is exactly what the crash
+    alone made it."""
+    sup, clock, spawned = make_sup()
+    spawned[-1].die()
+    sup.poll()
+    assert sup.state(0) == BACKOFF
+    used_before = sup._budget_used[0]
+    restarts_before = sup.restarts[0]
+    assert sup.shrink(0) == STOPPED
+    clock.advance(3600.0)           # way past every backoff delay
+    sup.poll()
+    assert sup.state(0) == STOPPED and len(spawned) == 1
+    assert sup._budget_used[0] == used_before
+    assert sup.restarts[0] == restarts_before
+
+
+def test_shrink_spawning_cancels_inflight_attempt():
+    """Satellite pin: shrink() of a slot whose RESPAWN is in flight on
+    a spawn thread flags the attempt; _collect_spawn reaps the fresh
+    worker instead of seating it, and the cancellation itself charges
+    no budget beyond what the original crash already did."""
+    import threading
+    import time as _time
+
+    release = threading.Event()
+    spawned = []
+
+    def spawn(spec):
+        if spawned:                     # first boot is synchronous
+            release.wait(5.0)
+        w = FakeWorker(spec)
+        spawned.append(w)
+        return w
+
+    clock = FakeClock(step_s=0.01)
+    sup = Supervisor([SPEC], CFG, spawn_fn=spawn,
+                     spawn_in_thread=True, clock=clock)
+    sup.start()
+    assert sup.state(0) == RUNNING
+    spawned[0].die()
+    sup.poll()                          # death -> BACKOFF (1 budget)
+    clock.advance(60.0)
+    sup.poll()                          # due -> SPAWNING, blocked
+    assert sup.state(0) == SPAWNING
+    used = sup._budget_used[0]
+    rest = sup.restarts[0]
+    assert sup.shrink(0) == SPAWNING    # stays until the attempt lands
+    assert sup.active_slots() == 1      # still in the pipeline... just
+    release.set()
+    deadline = _time.monotonic() + 5.0
+    while sup.state(0) == SPAWNING and _time.monotonic() < deadline:
+        sup.poll()
+        _time.sleep(0.005)
+    assert sup.state(0) == STOPPED
+    assert len(spawned) == 2
+    assert spawned[1].reaped            # born cancelled, reaped
+    assert sup.worker(0) is None
+    assert sup._budget_used[0] == used and sup.restarts[0] == rest
+    assert sup.active_slots() == 0
+
+
+def test_grow_appends_warm_and_cold_slots():
+    """grow() is append-only: a warm standby seats RUNNING immediately
+    (promotion is a list append, not a spawn); a cold grow rides the
+    normal BACKOFF->spawn pipeline due NOW, with zero budget charge."""
+    sup, clock, spawned = make_sup()
+    warm = FakeWorker(SPEC)
+    slot = sup.grow(SPEC, worker=warm)
+    assert slot == 1
+    assert sup.state(1) == RUNNING and sup.worker(1) is warm
+    assert sup.active_slots() == 2 and len(spawned) == 1  # no spawn
+    cold = sup.grow(SPEC)
+    assert cold == 2 and sup.state(2) == BACKOFF
+    sup.poll()                          # due immediately
+    assert sup.state(2) == RUNNING and len(spawned) == 2
+    assert sup.restarts[2] == 0 and sup._budget_used[2] == 0
+    # slot ids are stable: shrink leaves a tombstone, never a hole
+    sup.shrink(1)
+    sup.poll()
+    assert sup.state(1) in (DRAINING, STOPPED)
+    assert sup.grow(SPEC, worker=FakeWorker(SPEC)) == 3
+
+
+def test_shrink_out_of_range_raises():
+    sup, clock, spawned = make_sup()
+    with pytest.raises(ValueError, match="shrink targets slot 5"):
+        sup.shrink(5)
+
+
+def test_fleet_targets_reports_draining_and_kv():
+    """Federated labels survive a scale-down: a DRAINING slot is still
+    a target (its last heartbeats matter) but flagged so the verdict
+    and tools/check_fleet.py can skip it; kv summaries ride along."""
+    h, sup, clock, spawned = make_handle(
+        lambda op, fields: _poll_reply() if op == "poll" else {}
+    )
+    h.step()
+    t = fleet_targets(sup, [h])
+    assert t[0]["draining"] is False
+    assert "kv" in t[0]
+    sup.shrink(0)
+    t = fleet_targets(sup, [h])
+    assert t[0]["draining"] is True

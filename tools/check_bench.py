@@ -118,6 +118,23 @@ DEFAULT_GATES: Dict[str, dict] = {
         {"direction": "higher", "tol": 0.25},
     "spec_decode_8rps.token_identity":
         {"direction": "higher", "tol": 0.0},
+    # elastic fleet (ISSUE 14): the autoscaled arm must keep earning
+    # its goodput-per-worker-second edge over the fixed fleet at equal
+    # SLO (drift-tolerant), while the control-loop CONTRACTS are 0/1
+    # absolutes — react within one evaluation window of the 4x step,
+    # never thrash past the hold-window bound, lose nothing across
+    # either arm — and a warm standby promotion must stay a fraction
+    # of the ~15s cold spawn (absolute seconds bound, baseline-free)
+    "autoscale_burst_100rps.goodput_per_worker_ratio":
+        {"direction": "higher", "tol": 0.30},
+    "autoscale_burst_100rps.lost":
+        {"direction": "lower", "tol": 0.0},
+    "autoscale_burst_100rps.reaction_within_window":
+        {"direction": "higher", "tol": 0.0},
+    "autoscale_burst_100rps.oscillation_ok":
+        {"direction": "higher", "tol": 0.0},
+    "autoscale_burst_100rps.promote_join_s":
+        {"direction": "lower", "tol": 4.0},
 }
 
 

@@ -26,6 +26,13 @@ CLI and the tests, judging exactly the fields the federator publishes:
 per-worker ``status`` (healthy / degraded / stale / dead), supervisor
 ``state`` (a ``failed`` slot is an operator page even while its peers
 serve), and ``heartbeat_age_s`` against the staleness budget.
+
+Elastic fleets (serve/autoscaler.py) add two wrinkles this tool
+understands: workers marked ``draining`` are an INTENTIONAL goodbye —
+their stale heartbeats and dead probes are skipped, not paged — and an
+``autoscaler`` block (current/min/max size, standby depth, last scale
+event) is rendered and judged (a size outside [min, max] means the
+control loop and the supervisor disagree about the world).
 """
 
 from __future__ import annotations
@@ -110,6 +117,10 @@ def fleet_verdict(healthz: dict,
         problems.append("overall verdict DEAD (no worker can serve)")
     for wid in sorted(workers):
         w = workers[wid]
+        if w.get("draining"):
+            # scale-down in progress: a draining worker going quiet is
+            # the drain WORKING, not an incident
+            continue
         status = str(w.get("status", "dead")).lower()
         if status != "healthy":
             problems.append(f"worker {wid}: status {status}")
@@ -124,6 +135,18 @@ def fleet_verdict(healthz: dict,
             problems.append(
                 f"worker {wid}: heartbeat stale "
                 f"({hb:.2f}s > {max_heartbeat_age_s}s)"
+            )
+    asc = healthz.get("autoscaler")
+    if isinstance(asc, dict):
+        size = asc.get("size")
+        lo, hi = asc.get("min"), asc.get("max")
+        if size is not None and lo is not None and size < lo:
+            problems.append(
+                f"autoscaler: fleet size {size} below min {lo}"
+            )
+        if size is not None and hi is not None and size > hi:
+            problems.append(
+                f"autoscaler: fleet size {size} above max {hi}"
             )
     return (not problems, problems)
 
@@ -168,7 +191,27 @@ def render(source: str, healthz: dict, ok: bool,
             f"  restarts {w.get('restarts', 0)}"
             f"  heartbeat "
             + (f"{hb:.2f}s" if hb is not None else "-")
+            + ("  [draining]" if w.get("draining") else "")
         )
+    asc = healthz.get("autoscaler")
+    if isinstance(asc, dict):
+        lines.append(
+            f"  autoscaler: size {asc.get('size', '?')} "
+            f"(min {asc.get('min', '?')}, max {asc.get('max', '?')})"
+            f"  standbys {asc.get('standby_ready', 0)}/"
+            f"{asc.get('standby_target', 0)}"
+            f"  draining {asc.get('draining') or []}"
+            f"  events {asc.get('events_total', 0)}"
+        )
+        last = asc.get("last_event")
+        if isinstance(last, dict):
+            join = last.get("join_s")
+            lines.append(
+                f"    last event: {last.get('direction', '?')} "
+                f"({last.get('trigger', '?')}) -> size "
+                f"{last.get('size', '?')}"
+                + (f", join {join:.3f}s" if join is not None else "")
+            )
     lines.extend(_flight_lines(flight))
     if ok:
         lines.append(f"{source}: OK")
@@ -221,6 +264,8 @@ def main(argv=None) -> int:
                 for wid, w in healthz.get("workers", {}).items()
             },
         }
+        if isinstance(healthz.get("autoscaler"), dict):
+            reports[target]["autoscaler"] = healthz["autoscaler"]
         if flight is not None:
             reports[target]["flight"] = flight.get("fleet", flight)
         if not args.json:
