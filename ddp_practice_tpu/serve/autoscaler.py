@@ -514,7 +514,12 @@ class Autoscaler:
         (clean exit or chaos SIGKILL — the supervisor retires both to
         STOPPED without a budget charge), pull its handle out of the
         router. remove_handle flushes + salvages anything left, so a
-        drain cut short mid-stream still fails over exactly-once."""
+        drain cut short mid-stream still fails over exactly-once.
+        Retirement also re-homes the slot's sticky prefix families:
+        remove_handle drops its digest view, and the router's
+        rendezvous placement over the SURVIVING ids deterministically
+        re-assigns each family — no ledger of families is kept, the
+        hash ring IS the ledger."""
         for slot, handle in list(self._draining.items()):
             if self.supervisor.state(slot) != STOPPED:
                 continue

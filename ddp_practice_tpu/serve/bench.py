@@ -958,6 +958,210 @@ def fleet_bench(
         sup.stop()
 
 
+def _fleet_kv_counters(router) -> tuple:
+    """Summed (hit_tokens, miss_tokens) over every worker's
+    heartbeat-carried kv summary — the fleet's prefix-cache ledger."""
+    hit = miss = 0
+    for h in router.handles:
+        kv = getattr(h, "kv_summary", None)
+        if isinstance(kv, dict):
+            hit += kv.get("hit_tokens", 0)
+            miss += kv.get("miss_tokens", 0)
+    return hit, miss
+
+
+def cache_routing_bench(
+    *,
+    n_requests: int = 48,
+    rate_hz: float = 100.0,
+    procs: int = 2,
+    max_slots: int = 4,
+    block_size: int = 16,
+    # undersized on purpose: 24 usable blocks can hold TWO families'
+    # prefix blocks (12) plus the transient working set, but not all
+    # FOUR (24) — so spraying every family across the fleet (least-
+    # loaded) keeps evicting and re-paying cold prefill in steady
+    # state, while affinity's partition stays warm. 32+ blocks fit
+    # everything resident and flatten the contrast to the one-time
+    # warmup; tighter starves decode on both arms.
+    num_blocks: int = 25,
+    k_prefixes: int = 4,
+    prefix_len: int = 96,
+    vocab: int = 64,
+    hidden: int = 128,
+    depth: int = 2,
+    heads: int = 4,
+    mlp: int = 256,
+    max_len: int = 128,
+    decode_burst: int = 8,
+    seed: int = 0,
+    reps: int = 6,
+) -> dict:
+    """The cache-aware routing A/B: ONE shared-prefix trace (K system
+    prompts x unique tails) replayed through TWO identical 2-worker
+    fleets at the same paged pool — one routing by prefix affinity
+    (RouterConfig.cache_aware, serve/affinity.py), one by the classic
+    least-loaded order. Affinity partitions the K families across the
+    fleet so each warms ONCE; least-loaded sprays them, so every family
+    pays its cold prefill on every worker (and re-pays it whenever
+    churn evicts a copy). Headlines: the fleet prefix-hit-token rate
+    (from the workers' own radix hit/miss counters — ground truth, not
+    the router's estimate) and the goodput ratio, plus the zero-lost
+    and greedy token-identity invariants (routing must change WHERE
+    requests run, never WHAT they produce). Order-balanced alternating
+    reps, medians of per-rep ratios, same methodology as fleet_bench."""
+    from ddp_practice_tpu.serve.router import RouterConfig
+    from ddp_practice_tpu.serve.supervisor import (
+        SupervisorConfig,
+        make_fleet_router,
+    )
+    from ddp_practice_tpu.serve.worker import WorkerSpec
+
+    trace = build_shared_prefix_trace(
+        n_requests=n_requests, rate_hz=rate_hz, vocab=vocab,
+        k_prefixes=k_prefixes, prefix_len=prefix_len,
+        tail_range=(1, 8), max_new_range=(8, 24), seed=seed,
+    )
+    max_prompt = max(len(t["prompt"]) for t in trace)
+    bucket = block_size
+    while bucket < max_prompt:
+        bucket += block_size
+    # small buckets matter: a warm admit prefills only the UNCACHED
+    # remainder, and its span is matched + bucket_for(remainder) — with
+    # only the full-prompt bucket, every warm request would blow the
+    # per-slot capacity and be rejected instead of hitting the cache
+    buckets = sorted({16, 32, 64, bucket})
+    spec = WorkerSpec(
+        model={
+            "vocab_size": vocab, "max_len": max_len,
+            "hidden_dim": hidden, "depth": depth, "num_heads": heads,
+            "mlp_dim": mlp, "pos_emb": "rope",
+        },
+        engine={
+            "paged": True, "prefix_cache": True,
+            "num_blocks": num_blocks, "block_size": block_size,
+            "max_slots": max_slots, "max_len": max_len,
+            "prompt_buckets": buckets,
+            # greedy: the token-identity invariant needs bit-equal
+            # streams across arms
+            "temperature": 0.0, "decode_burst": decode_burst,
+            "eos_id": None,
+        },
+        max_queue=len(trace) * max(1, reps),
+    )
+    arms = {}
+    sups = []
+    try:
+        for name, aware in (("affinity", True), ("least_loaded", False)):
+            router, sup, _handles = make_fleet_router(
+                spec, procs,
+                config=RouterConfig(cache_aware=aware),
+                sup_config=SupervisorConfig(restart_base_s=0.25),
+            )
+            arms[name] = router
+            sups.append(sup)
+        rep_rows = {"affinity": [], "least_loaded": []}
+        tokens_by_rid = {"affinity": {}, "least_loaded": {}}
+        for rep in range(reps):
+            order = ["affinity", "least_loaded"]
+            if rep % 2:
+                order.reverse()
+            for side in order:
+                router = arms[side]
+                before_kv = _fleet_kv_counters(router)
+                n_before = len(router.completions)
+                row = _replay_through_router(
+                    router, trace, rid_offset=rep * 1_000_000,
+                    fleet=True,
+                )
+                # one settle tick so the final heartbeat's kv counters
+                # (which rode the last poll) are current before the delta
+                router.step()
+                hit0, miss0 = before_kv
+                hit1, miss1 = _fleet_kv_counters(router)
+                dh, dm = hit1 - hit0, miss1 - miss0
+                row["hit_tokens"] = dh
+                row["miss_tokens"] = dm
+                row["hit_rate"] = dh / (dh + dm) if dh + dm else 0.0
+                rep_rows[side].append(row)
+                for c in router.completions[n_before:]:
+                    if c.status in ("eos", "length"):
+                        tokens_by_rid[side][c.rid] = list(c.tokens)
+
+        def med(xs):
+            s = sorted(xs)
+            n = len(s)
+            return (s[n // 2] if n % 2
+                    else 0.5 * (s[n // 2 - 1] + s[n // 2]))
+
+        # greedy token identity: same rid (rep-offset included) must
+        # yield the same tokens on both arms — routing is placement,
+        # never content
+        shared = set(tokens_by_rid["affinity"]) & set(
+            tokens_by_rid["least_loaded"])
+        same = sum(
+            1 for r in shared
+            if tokens_by_rid["affinity"][r]
+            == tokens_by_rid["least_loaded"][r]
+        )
+        identity = same / len(shared) if shared else 0.0
+        routes: dict = {}
+        for c in arms["affinity"].completions:
+            fl = c.flight or {}
+            r = fl.get("route")
+            if r is not None:
+                routes[r] = routes.get(r, 0) + 1
+
+        def arm_row(side):
+            rows = rep_rows[side]
+            return {
+                "mode": f"{side} x{procs}",
+                "goodput_tokens_per_sec": med(
+                    [r["goodput_tokens_per_sec"] for r in rows]),
+                "hit_rate": med([r["hit_rate"] for r in rows]),
+                "hit_tokens": sum(r["hit_tokens"] for r in rows),
+                "miss_tokens": sum(r["miss_tokens"] for r in rows),
+                "latency_s": {p: med([r["latency_s"][p] for r in rows])
+                              for p in ("p50", "p90", "p99")},
+                "lost": sum(r["lost"] for r in rows),
+            }
+
+        aff, ll = arm_row("affinity"), arm_row("least_loaded")
+        aff["route_decisions"] = routes
+        return {
+            "trace": {
+                "n_requests": n_requests, "rate_hz": rate_hz,
+                "seed": seed, "k_prefixes": k_prefixes,
+                "prefix_len": prefix_len,
+            },
+            "pool": {"num_blocks": num_blocks,
+                     "block_size": block_size},
+            "procs": procs,
+            "reps": reps,
+            "affinity": aff,
+            "least_loaded": ll,
+            # medians of per-rep ratios (order-balanced): the fleet
+            # prefix memory's bill, robust to machine drift
+            "hit_rate_ratio": med([
+                (a["hit_rate"] / b["hit_rate"]) if b["hit_rate"]
+                else float(a["hit_rate"] > 0)
+                for a, b in zip(rep_rows["affinity"],
+                                rep_rows["least_loaded"])
+            ]),
+            "goodput_ratio": med([
+                a["goodput_tokens_per_sec"]
+                / b["goodput_tokens_per_sec"]
+                for a, b in zip(rep_rows["affinity"],
+                                rep_rows["least_loaded"])
+            ]),
+            "token_identity": identity,
+            "lost": aff["lost"] + ll["lost"],
+        }
+    finally:
+        for sup in sups:
+            sup.stop()
+
+
 def fleet_trace_overhead_bench(
     *,
     n_requests: int = 32,
@@ -2989,6 +3193,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "rate; tail keep-rules stay tenant-blind, so "
                         "fault-affected requests are kept for EVERY "
                         "tenant")
+    p.add_argument("--cache-aware", dest="cache_aware",
+                   action="store_true",
+                   help="with --procs: A/B cache-aware (prefix-"
+                        "affinity) routing against least-loaded over "
+                        "one shared-prefix trace through two identical "
+                        "paged+prefix-cache worker fleets at the same "
+                        "pool (serve/affinity.py) — reports the fleet "
+                        "prefix-hit-token rate and goodput ratios, "
+                        "zero-lost, and greedy token identity")
     p.add_argument("--autoscale", action="store_true",
                    help="with --procs: A/B an ELASTIC fleet against the "
                         "fixed --procs fleet under a 4x arrival step "
@@ -3342,6 +3555,38 @@ def main(argv=None) -> int:
                   f"{report['goodput_ratio']:.3f}x  ({report['gate']})")
             print(f"  exactly-once cross-check violations: "
                   f"{report['stream_violations']}")
+        return 0
+    if args.procs and args.cache_aware:
+        report = cache_routing_bench(
+            n_requests=args.requests, rate_hz=args.rate,
+            procs=args.procs, max_slots=args.max_slots,
+            block_size=args.block_size, seed=args.seed,
+            **({"decode_burst": args.decode_burst}
+               if args.decode_burst is not None else {}),
+        )
+        if args.json:
+            print(json.dumps(report))
+        else:
+            aff, ll = report["affinity"], report["least_loaded"]
+            print(f"[cache_routing_bench] {report['trace']['n_requests']}"
+                  f" requests @ {report['trace']['rate_hz']}/s, "
+                  f"{report['procs']} workers, "
+                  f"{report['trace']['k_prefixes']} prefix families x "
+                  f"{report['trace']['prefix_len']} tokens, pool "
+                  f"{report['pool']['num_blocks']} x "
+                  f"{report['pool']['block_size']}")
+            for r in (ll, aff):
+                print(f"  {r['mode']:>16}: "
+                      f"{r['goodput_tokens_per_sec']:8.1f} tok/s  "
+                      f"hit rate {r['hit_rate']:.3f}  "
+                      f"({r['hit_tokens']}/"
+                      f"{r['hit_tokens'] + r['miss_tokens']} prefill "
+                      f"tokens warm)  lost {r['lost']}")
+            print(f"  affinity/least-loaded: hit rate "
+                  f"{report['hit_rate_ratio']:.2f}x  goodput "
+                  f"{report['goodput_ratio']:.2f}x  token identity "
+                  f"{report['token_identity']:.2f}  routes "
+                  f"{aff['route_decisions']}")
         return 0
     if args.procs:
         from ddp_practice_tpu.serve.faults import FaultPlan

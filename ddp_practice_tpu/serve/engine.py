@@ -694,6 +694,10 @@ class PagedEngine(_EngineBase):
             RadixPrefixCache(self.blocks, bs) if config.prefix_cache
             else None
         )
+        # matched tokens of the MOST RECENT admit (None = no prefix
+        # cache): the scheduler reads this right after admit() to book
+        # prefix_hit_tokens into the request's flight record
+        self.last_prefix_hit: Optional[int] = None
         self._cache = make_paged_cache(model, num_blocks, bs)
         self._last_logits = jnp.zeros((s, model.vocab_size), model.dtype)
         self._keys = jnp.zeros((s, 2), jnp.uint32)
@@ -1125,6 +1129,7 @@ class PagedEngine(_EngineBase):
         matched = 0
         if self.radix is not None:
             shared, matched = self.radix.match(prompt)
+            self.last_prefix_hit = matched
         try:
             w = self.bucket_for(p - matched)
         except ValueError:

@@ -211,6 +211,11 @@ class RadixPrefixCache:
         self._nodes = 0
         self.hit_tokens = 0      # cumulative matched / recomputed token
         self.miss_tokens = 0     # counters (ServeMetrics exports deltas)
+        # structural-change counter (insert/evict edges only): the
+        # prefix-digest publisher (serve/affinity.py) rebuilds its
+        # fingerprint exactly when this moves, so idle heartbeats never
+        # re-walk a warm tree
+        self.edit_seq = 0
         # O(1) evictable accounting: `_leaf_index` maps block -> its LEAF
         # node (a block appears at most once in the tree — insert only
         # ever refs a freshly allocated, caller-owned block), and
@@ -357,6 +362,8 @@ class RadixPrefixCache:
                 self._leaf_gained(child)
             child.last_use = self._clock
             node = child
+        if added:
+            self.edit_seq += 1
         return added
 
     def evictable(self) -> int:
@@ -409,6 +416,8 @@ class RadixPrefixCache:
                 self.allocator.free([v.block])
                 self._nodes -= 1
                 freed += 1
+        if freed:
+            self.edit_seq += 1
         return freed
 
     def clear(self) -> int:

@@ -200,6 +200,14 @@ class RouterMetrics:
             labelled("serve_sheds_total", reason=reason)
         ).inc()
 
+    def on_route(self, decision: str) -> None:
+        """Dispatch-policy ledger: how often placement was won by cache
+        affinity vs the load tiebreak vs the digestless fallback —
+        the first thing to pivot on when fleet hit rate drifts."""
+        self.registry.counter(labelled(
+            "serve_route_decisions_total", decision=decision
+        )).inc()
+
     def on_replica_state(self, replica: int, state: str) -> None:
         self.registry.gauge(
             labelled("serve_replica_state", replica=replica)

@@ -111,6 +111,12 @@ class RouterConfig:
     # overhead bench's control arm; chunks from replicas are drained
     # and discarded so handle state stays bounded).
     streaming: bool = True
+    # ---- cache-aware dispatch: score HEALTHY replicas by expected
+    # prefix-hit tokens from their published radix digests (affinity.py)
+    # and dispatch by affinity minus a load penalty. Degrades to the
+    # least-loaded sort wherever digests are absent/cold, so fleets of
+    # non-paged engines behave byte-identically to cache_aware=False.
+    cache_aware: bool = True
 
 
 @dataclasses.dataclass
@@ -138,6 +144,12 @@ class _Tracked:
     # chunk's attempt-local `start` plus this base is its absolute
     # offset in the client's output (the dedup key after failover)
     dispatch_base: int = 0
+    # how the LAST dispatch picked its replica ("affinity" | "load" |
+    # "fallback") and the prefix tokens the replicas actually served
+    # from cache, summed across attempts — both surface in the flight
+    # record so a trace can say WHY a request landed where it did
+    route: Optional[str] = None
+    prefix_hit_tokens: int = 0
 
 
 @dataclasses.dataclass
@@ -311,6 +323,21 @@ class ReplicaHandle:
         except ValueError:
             return False
 
+    @property
+    def kv_summary(self) -> Optional[dict]:
+        """KV/radix-cache summary + prefix digest, read straight off
+        the engine — the in-process twin of the worker's `_kv_summary`
+        heartbeat payload (same builder, affinity.kv_summary), so the
+        router's affinity scorer works identically with and without
+        the RPC seam. None for non-paged engines."""
+        if getattr(self.engine, "radix", None) is None:
+            return None
+        if not hasattr(self, "_digest_pub"):
+            from ddp_practice_tpu.serve.affinity import DigestPublisher
+            self._digest_pub = DigestPublisher(self.engine.radix)
+        from ddp_practice_tpu.serve.affinity import kv_summary
+        return kv_summary(self.engine, self._digest_pub)
+
     # --------------------------------------------------- lifecycle
     def probe_ok(self, now: float) -> bool:
         """Half-open probe: is the replica reachable again? With an
@@ -350,7 +377,8 @@ class Router:
     def __init__(self, schedulers: Sequence, *, clock=None,
                  config: RouterConfig = RouterConfig(),
                  metrics: Optional[RouterMetrics] = None,
-                 tracer=None, slo=None, telemetry=None) -> None:
+                 tracer=None, slo=None, telemetry=None,
+                 policy=None) -> None:
         """`schedulers` is the replica fleet: Scheduler objects (the
         in-process fleet — wrapped in ReplicaHandle here) and/or
         prebuilt handle objects implementing ReplicaHandle's replica
@@ -391,6 +419,18 @@ class Router:
                 h = item
                 h.health = ReplicaHealth(bcfg)
             self.handles.append(h)
+        # dispatch policy seam: anything with order(cands, prompt, now)
+        # -> (ordered, decisions, expected_hits) and forget(replica_id).
+        # Default is digest-driven affinity (which itself degrades to
+        # the least-loaded sort when no digest is usable); pass an
+        # explicit policy= to override both.
+        if policy is None:
+            from ddp_practice_tpu.serve.affinity import (
+                AffinityPolicy, LeastLoadedPolicy,
+            )
+            policy = (AffinityPolicy() if config.cache_aware
+                      else LeastLoadedPolicy())
+        self.policy = policy
         self.tracked: Dict[int, _Tracked] = {}
         self.completions: List[Completion] = []
         # streaming registry: rid -> TokenStream, created at intake,
@@ -453,6 +493,9 @@ class Router:
             if not self._dispatch(tr):
                 self._park_or_shed(tr)
         self.handles.remove(h)
+        # drop its digest view: the slot is gone, and rendezvous
+        # placement over the surviving ids re-homes its sticky families
+        self.policy.forget(h.id)
         self.metrics.on_replica_state(h.id, "removed")
 
     # ------------------------------------------------------------ intake
@@ -614,11 +657,14 @@ class Router:
         cands = [h for h in self._alive() if h.has_queue_space]
         if not cands:
             return False
-        # HEALTHY before DEGRADED, then least-loaded, then stable id
-        cands.sort(key=lambda h: (
-            h.health.state is HealthState.DEGRADED, h.load, h.id,
-        ))
         req = tr.req
+        # the dispatch-policy seam: affinity scoring over the replicas'
+        # published prefix digests when usable, the classic HEALTHY-
+        # before-DEGRADED least-loaded sort otherwise (LeastLoadedPolicy
+        # and the cold-digest fallback produce the identical order)
+        cands, decisions, exp = self.policy.order(
+            cands, req.prompt, self.clock.now()
+        )
         for h in cands:
             if tr.prefix:
                 if not h.fits_prompt(len(req.prompt) + len(tr.prefix)):
@@ -674,13 +720,17 @@ class Router:
                 # of writing the replica off (it is finishing in-flight
                 # streams and will exit on its own)
                 continue
+            tr.route = decisions.get(h.id, "fallback")
+            self.metrics.on_route(tr.route)
             if t_dispatch is not None:
                 rec.record_instant(
                     "dispatch", t_dispatch, trace_id=req.trace_id,
                     pid=ROUTER_PID,
                     attrs={"replica": h.id,
                            "attempt": tr.retries + tr.failovers,
-                           "salvaged": len(tr.prefix)},
+                           "salvaged": len(tr.prefix),
+                           "route": tr.route,
+                           "affinity_tokens": exp.get(h.id, 0)},
                 )
             return True
         return False
@@ -743,6 +793,11 @@ class Router:
             h.health.on_probe(ok, now)
             if ok:
                 h.restart()
+                # the new incarnation's radix is cold: drop the digest
+                # view so affinity can't route on the dead cache's
+                # fingerprint (a stale digest costs a miss, never
+                # correctness — but why pay the miss on purpose)
+                self.policy.forget(h.id)
                 if self.tracer is not None and self.tracer.enabled:
                     self.tracer.instant("replica_restart", pid=ROUTER_PID,
                                         replica=h.id)
@@ -753,6 +808,7 @@ class Router:
         held — in-flight requests resume from their salvaged tokens."""
         now = self.clock.now()
         h.health.mark_dead(now)
+        self.policy.forget(h.id)  # its warm cache died with it
         self.metrics.breaker_trips.inc()
         self.metrics.on_replica_state(h.id, h.health.state.value)
         rec = self.tracer
@@ -808,8 +864,19 @@ class Router:
                 tr.decode_s += c.flight["decode_s"]
                 tr.spec_drafted += c.flight.get("spec_drafted", 0)
                 tr.spec_accepted += c.flight.get("spec_accepted", 0)
+                tr.prefix_hit_tokens += c.flight.get(
+                    "prefix_hit_tokens", 0)
             if tr.first_token_time is None and c.ttft is not None:
                 tr.first_token_time = tr.req.arrival + c.ttft
+            if c.status == "refused":
+                # one-way submit reconciled as a DRAINING refusal
+                # (supervisor._reconcile_confirm): typed and certain,
+                # not a fault — re-dispatch on the next candidate
+                # without a breaker mark or a retry charge, exactly
+                # like the synchronous last_submit_refused skip
+                if not self._dispatch(tr):
+                    self._park_or_shed(tr)
+                continue
             if c.status in ("eos", "length"):
                 h.health.mark_success()
                 self._finalize(tr, tr.prefix + c.tokens, c.status,
@@ -961,6 +1028,12 @@ class Router:
             flight["spec_drafted"] = tr.spec_drafted
             flight["spec_accepted"] = tr.spec_accepted
             flight["spec_accept_rate"] = tr.spec_accepted / tr.spec_drafted
+        if tr.route is not None:
+            # the routing decision behind this request's placement and
+            # the prefix tokens its replicas served warm — the flight
+            # record says WHY a request was fast (affinity hit) or not
+            flight["route"] = tr.route
+            flight["prefix_hit_tokens"] = tr.prefix_hit_tokens
         st = self.streams.get(req.rid)
         if st is not None and not st.closed:
             # flush the authoritative tail (tokens the completion holds

@@ -195,6 +195,32 @@ class RpcClient:
                 return reply
         raise last  # type: ignore[misc]
 
+    def cast(self, op: str, *, timeout_s: Optional[float] = None,
+             **fields) -> None:
+        """One-way send: ship the frame, read NO reply. The frame is
+        flagged `oneway` so the server skips its response (see
+        _serve_conn) — an unread reply left in the socket would desync
+        the next call() on this connection. Delivery is NOT confirmed:
+        callers must be idempotent and reconcile (the fleet submit path
+        confirms by rid on the next poll and resubmits what never
+        landed). One reconnect attempt on transport failure, then the
+        error propagates — the caller's breaker accounting judges."""
+        req = {"op": op, "oneway": True, **fields}
+        deadline = timeout_s if timeout_s is not None else self.timeout_s
+        last: Optional[Exception] = None
+        with self._lock:
+            for _ in range(2):
+                try:
+                    if self._sock is None:
+                        self._sock = self._connect()
+                    self._sock.settimeout(deadline)
+                    send_frame(self._sock, req)
+                    return
+                except RpcError as e:
+                    self._drop()
+                    last = e
+        raise last  # type: ignore[misc]
+
     def close(self) -> None:
         with self._lock:
             self._drop()
@@ -392,6 +418,13 @@ class RpcServer:
                         reply = {"ok": False,   # answer, not kill the
                                  "error":       # connection
                                  f"{type(e).__name__}: {e}"}
+                    if req.get("oneway"):
+                        # fire-and-forget frame (RpcClient.cast): the
+                        # client reads no reply, so sending one — even
+                        # an error — would be read as the NEXT call's
+                        # response and desync the connection. Errors
+                        # surface through the caller's reconcile path.
+                        continue
                     try:
                         send_frame(conn, reply)
                     except RpcError:

@@ -289,6 +289,11 @@ class Scheduler:
         # verify dispatches (rid-keyed, so preemption/readmission keeps
         # accumulating); popped into the completion's flight record
         self._spec_stats: Dict[int, list] = {}
+        # rid -> prefix-cache matched tokens, cumulative across this
+        # request's admits (a preempted continuation re-matches its own
+        # earlier blocks); popped into the flight record the same way.
+        # Only tracked for engines with a radix (last_prefix_hit set).
+        self._prefix_hits: Dict[int, int] = {}
         # preempted-request resume state (PagedEngine block-aware
         # preemption): rid -> {"orig": the ORIGINAL request, "prefix":
         # tokens generated before the eviction, "ftt": their first-token
@@ -404,6 +409,11 @@ class Scheduler:
             flight["spec_accepted"] = spec[1]
             if spec[0] > 0:
                 flight["spec_accept_rate"] = spec[1] / spec[0]
+        ph = self._prefix_hits.pop(req.rid, None)
+        if ph is not None:
+            # token count, not a latency phase — same placement rule as
+            # the spec_* tallies above
+            flight["prefix_hit_tokens"] = ph
         c = Completion(
             rid=req.rid, tokens=tokens, status=status,
             arrival=req.arrival, finish=now, ttft=ttft, tpot=tpot,
@@ -661,6 +671,11 @@ class Scheduler:
             slot = eng.admit(req.prompt, seed=req.seed,
                              max_positions=needed, trace_id=req.trace_id)
             t_admit1 = self.clock.now()
+            hit = getattr(eng, "last_prefix_hit", None)
+            if hit is not None:
+                self._prefix_hits[req.rid] = (
+                    self._prefix_hits.get(req.rid, 0) + hit
+                )
             if tr is not None and tr.enabled:
                 sub = req.submitted if req.submitted is not None \
                     else req.arrival
@@ -864,6 +879,7 @@ class Scheduler:
         self.queue.clear()
         self._chunk_seq.clear()
         self._spec_stats.clear()
+        self._prefix_hits.clear()
         return out
 
     @property

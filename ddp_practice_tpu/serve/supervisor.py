@@ -681,6 +681,13 @@ class RemoteReplicaHandle:
         #                                 process's completions list
         self.chunks_consumed = 0        # same contract, TokenChunk list
         self.outstanding: Dict[int, dict] = {}
+        # fire-and-forget submits awaiting confirmation: rid -> casts
+        # sent. Confirmation is the rid surfacing in a pub/poll frame
+        # (completion or inflight salvage) or the reconcile poll's
+        # `confirmed` answer; a rid the worker never saw is resubmitted
+        # (idempotent by rid), a refused one surfaces as a typed
+        # "refused" completion so the router re-dispatches penalty-free
+        self._unconfirmed: Dict[int, int] = {}
         self._pending: List[Completion] = []
         self._pending_chunks: List[TokenChunk] = []
         # set when the worker refused a submit as DRAINING (typed, not
@@ -755,6 +762,23 @@ class RemoteReplicaHandle:
         if c is None:
             self._broken = True
             return
+        cast = getattr(c, "cast", None)
+        if cast is not None:
+            # fire-and-forget: ship the frame, wait for NO ack — the
+            # ack round trip was most of the remaining TTFT hop at the
+            # RPC seam. The worker dedups by rid, so delivery is
+            # confirmed (and re-driven) by the reconcile poll instead:
+            # step() asks the worker to `confirm` every unconfirmed
+            # rid, resubmits the lost ones, and surfaces a draining
+            # refusal as a typed "refused" completion.
+            try:
+                cast("submit", request=self._request_dict(req))
+            except (RpcError, RpcRemoteError):
+                self._broken = True
+                return
+            self._unconfirmed[req.rid] = 1
+            return
+        # legacy blocking path (test fakes without one-way support)
         try:
             r = c.call("submit", request=self._request_dict(req))
         except (RpcError, RpcRemoteError):
@@ -789,6 +813,7 @@ class RemoteReplicaHandle:
         if upto > self.consumed:
             start = max(0, self.consumed - from_wm)
             for d in completions[start:]:
+                self._unconfirmed.pop(d["rid"], None)
                 if d["rid"] in self._shed_skip:
                     self._shed_skip.discard(d["rid"])
                     continue  # already finalized from the shed reply
@@ -810,6 +835,7 @@ class RemoteReplicaHandle:
                 ))
             self.chunks_consumed = chunks_upto
         for item in inflight:
+            self._unconfirmed.pop(item["rid"], None)
             st = self.outstanding.get(item["rid"])
             if st is not None:
                 st["tokens"] = list(item["tokens"])
@@ -935,12 +961,18 @@ class RemoteReplicaHandle:
         c = self._client()
         sent_wm = self.consumed
         sent_cwm = self.chunks_consumed
+        # reconcile fire-and-forget submits: ask the worker which of
+        # the unconfirmed rids it has seen (answered from its dedup
+        # map, on the same connection the casts rode)
+        asked = list(self._unconfirmed) if self._unconfirmed else None
+        extra = {"confirm": asked} if asked else {}
         t0 = self.clock.now()
         try:
             r = c.call("poll", watermark=sent_wm,
                        chunks_watermark=sent_cwm,
                        version=self._pub_version,
-                       timeout_s=self.poll_timeout_s, retries=0)
+                       timeout_s=self.poll_timeout_s, retries=0,
+                       **extra)
         except (RpcError, RpcRemoteError):
             hb = self._last_heartbeat
             if hb is None:
@@ -958,6 +990,8 @@ class RemoteReplicaHandle:
         self._clock_sample(r, t0, self.clock.now())
         if r.get("unchanged"):
             self._pub_version = r.get("version", self._pub_version)
+            if asked:
+                self._reconcile_confirm(r.get("confirmed"), asked)
             return  # heartbeat only: salvage/stats still current
         self._apply_snapshot(
             version=r.get("version"), from_wm=sent_wm,
@@ -967,6 +1001,63 @@ class RemoteReplicaHandle:
             chunks_from=r.get("chunks_from", sent_cwm),
             chunks_upto=r.get("chunks_watermark"),
         )
+        if asked:
+            self._reconcile_confirm(r.get("confirmed"), asked)
+
+    def _reconcile_confirm(self, confirmed: Optional[dict],
+                           asked: list) -> None:
+        """Resolve fire-and-forget submits against the worker's dedup
+        answer. True = accepted (confirmed); False = refused at the
+        door (draining) — surface a typed "refused" completion so the
+        router re-dispatches without burning a retry, the one-way twin
+        of `last_submit_refused`; absent = the cast never landed —
+        resubmit (idempotent by rid), and after the resubmit budget
+        treat the replica as broken so evacuation re-homes the work."""
+        if confirmed is None:
+            return
+        now = self.clock.now()
+        for rid in asked:
+            if rid not in self._unconfirmed:
+                continue  # resolved by a frame in the meantime
+            verdict = confirmed.get(str(rid))
+            if verdict is True:
+                self._unconfirmed.pop(rid, None)
+                continue
+            if verdict is False:
+                self._unconfirmed.pop(rid, None)
+                st = self.outstanding.pop(rid, None)
+                self._remote_draining = True
+                if st is not None:
+                    req = st["req"]
+                    self._pending.append(Completion(
+                        rid=rid, tokens=[], status="refused",
+                        arrival=req.arrival, finish=now,
+                        ttft=None, tpot=None, flight=None,
+                        trace_id=req.trace_id, tenant=req.tenant,
+                    ))
+                continue
+            # never seen by the worker: the one-way frame was lost
+            tries = self._unconfirmed.get(rid, 1)
+            st = self.outstanding.get(rid)
+            if st is None:
+                self._unconfirmed.pop(rid, None)
+                continue
+            if tries >= 3:
+                self._unconfirmed.pop(rid, None)
+                self._broken = True  # evacuation re-admits it elsewhere
+                continue
+            c = self._client()
+            cast = getattr(c, "cast", None) if c is not None else None
+            if cast is None:
+                self._unconfirmed.pop(rid, None)
+                self._broken = True
+                continue
+            try:
+                cast("submit", request=self._request_dict(st["req"]))
+            except (RpcError, RpcRemoteError):
+                self._broken = True
+                return
+            self._unconfirmed[rid] = tries + 1
 
     def _clock_sample(self, reply: dict, t0: float, t3: float) -> None:
         """Feed one timestamped round trip to the collector's offset
@@ -1043,6 +1134,7 @@ class RemoteReplicaHandle:
             for rid, st in self.outstanding.items() if rid not in done
         ]
         self.outstanding.clear()
+        self._unconfirmed.clear()  # salvage owns the rids now
         return out
 
     def shed_queued(self, min_priority: int) -> List[int]:
@@ -1168,7 +1260,9 @@ class RemoteReplicaHandle:
                 pass  # probe_ok just passed; a blip here resolves via
                 #       the normal poll path (worst case: a fresh
                 #       process replays nothing anyway)
-        self._stats = {}
+        self._stats = {}           # also drops any cached digest: a
+        #                            fresh radix publishes a new epoch
+        self._unconfirmed.clear()  # old incarnation's casts are moot
         self._remote_draining = False
         self.last_submit_refused = False
         self._pub_version = None   # a fresh process numbers its own

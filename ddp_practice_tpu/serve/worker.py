@@ -195,6 +195,18 @@ class WorkerServer:
         cfg = EngineConfig(**eng_kw)
         engine_cls = PagedEngine if paged else SlotEngine
         self.engine = engine_cls(model, params, cfg)
+        # prefix-digest publisher (serve/affinity.py): fingerprints the
+        # warm radix tree into every heartbeat so the router can route
+        # by expected prefix hit. None without a prefix cache — the
+        # kv summary simply carries no digest and the router falls back
+        # to least-loaded.
+        radix = getattr(self.engine, "radix", None)
+        if radix is not None:
+            from ddp_practice_tpu.serve.affinity import DigestPublisher
+
+            self._digest = DigestPublisher(radix)
+        else:
+            self._digest = None
         self.registry = MetricsRegistry()
         self.flight = FlightStats()
         self.scheduler = Scheduler(
@@ -303,26 +315,14 @@ class WorkerServer:
     # ------------------------------------------------------------- ops
     def _kv_summary(self) -> dict:
         """KV/radix-cache occupancy riding every heartbeat frame: blocks
-        in use / shared, prefix-cache hit rate, evictable count. Zeros
-        for the slot engine (no paged pool) — the getattr guards mirror
-        ServeMetrics.on_tick. Federated into per-worker gauges by the
-        fleet view; the groundwork for cache-aware routing."""
-        eng = self.engine
-        blocks = getattr(eng, "blocks", None)  # PagedEngine only
-        radix = getattr(eng, "radix", None)
-        hit = getattr(radix, "hit_tokens", 0) if radix is not None else 0
-        miss = getattr(radix, "miss_tokens", 0) if radix is not None else 0
-        return {
-            "blocks_used": blocks.num_used if blocks is not None else 0,
-            "blocks_shared": blocks.num_shared if blocks is not None else 0,
-            # minus the garbage block, same accounting as the gauges
-            "blocks_total": (blocks.num_blocks - 1
-                             if blocks is not None else 0),
-            "evictable": radix.evictable() if radix is not None else 0,
-            "hit_tokens": hit,
-            "miss_tokens": miss,
-            "prefix_hit_rate": hit / (hit + miss) if hit + miss else 0.0,
-        }
+        in use / shared, prefix-cache hit rate, evictable count — plus
+        the prefix digest (serve/affinity.py) cache-aware routing scores
+        against. Zeros (and no digest) for the slot engine. Federated
+        into per-worker gauges by the fleet view; the router's affinity
+        index feeds straight off this payload."""
+        from ddp_practice_tpu.serve.affinity import kv_summary
+
+        return kv_summary(self.engine, self._digest)
 
     def _stats(self) -> dict:
         return {
@@ -564,6 +564,8 @@ class WorkerServer:
         watermark = int(req.get("watermark", 0))
         cwm = int(req.get("chunks_watermark", 0))
         seen_version = req.get("version")
+        confirm = req.get("confirm")
+        confirmed: Optional[dict] = None
         with self._io_lock:
             version = self._pub_version
             pub = self._published
@@ -571,14 +573,29 @@ class WorkerServer:
             cupto = pub["chunks_len"]
             inflight = pub["inflight"]
             stats = pub["stats"]
+            if confirm:
+                # fire-and-forget reconcile: for each rid the client
+                # cast a one-way submit for, answer what _op_submit
+                # recorded — True accepted, False refused (draining),
+                # absent = the frame never landed (client resubmits;
+                # submit is idempotent by rid). Served on the SAME
+                # connection the casts rode, so TCP ordering makes
+                # "absent" mean lost, not merely not-yet-processed.
+                confirmed = {
+                    str(rid): self._seen_rids[rid]
+                    for rid in confirm if rid in self._seen_rids
+                }
         if seen_version == version and watermark >= upto \
                 and cwm >= cupto:
             # nothing moved since the client's last poll: answer with a
             # frame small enough that a high-rate heartbeat costs the
             # decode loop (same single core!) close to nothing. "t" =
             # this clock read (clock-offset sampling, see _op_ping).
-            return {"version": version, "unchanged": True,
-                    "t": time.monotonic()}
+            out = {"version": version, "unchanged": True,
+                   "t": time.monotonic()}
+            if confirmed is not None:
+                out["confirmed"] = confirmed
+            return out
         comps = self.scheduler.completions  # append-only list
         new = [self._completion_dict(c) for c in comps[watermark:upto]]
         chunks = self.scheduler.chunks      # append-only too
@@ -586,15 +603,18 @@ class WorkerServer:
         if stats is None:
             with self._lock:
                 stats = self._stats()
-        return {"version": version,
-                "completions": new,
-                "watermark": upto,
-                "chunks": new_chunks,
-                "chunks_from": cwm,
-                "chunks_watermark": cupto,
-                "inflight": inflight,
-                "stats": stats,
-                "t": time.monotonic()}
+        out = {"version": version,
+               "completions": new,
+               "watermark": upto,
+               "chunks": new_chunks,
+               "chunks_from": cwm,
+               "chunks_watermark": cupto,
+               "inflight": inflight,
+               "stats": stats,
+               "t": time.monotonic()}
+        if confirmed is not None:
+            out["confirmed"] = confirmed
+        return out
 
     def _drain_intake_locked(self) -> int:
         """Move intake into the scheduler (big lock held by caller)."""

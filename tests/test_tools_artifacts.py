@@ -36,6 +36,15 @@ ELASTIC_OK = os.path.join(ROOT, "tests", "data",
                           "fleet_healthz_autoscale_ok.json")
 ELASTIC_BAD = os.path.join(ROOT, "tests", "data",
                            "fleet_healthz_autoscale_bad.json")
+# the cache-aware pair (ISSUE 15): _ok is a 2-worker fleet whose
+# heartbeats carry the full kv summary + prefix digest (one full
+# frame, one delta frame — both wire forms rendered); _bad has a
+# worker claiming more blocks in use than its pool holds — the
+# accounting the affinity router scores against is lying
+CACHE_OK = os.path.join(ROOT, "tests", "data",
+                        "fleet_healthz_cache_ok.json")
+CACHE_BAD = os.path.join(ROOT, "tests", "data",
+                         "fleet_healthz_cache_bad.json")
 # streaming exactly-once audit artifacts: a deterministic FakeClock
 # 2-replica run with a scripted mid-stream crash (so the PASSING
 # artifact contains resumed markers — failover is part of the
@@ -203,6 +212,42 @@ def test_check_fleet_autoscale_verdict_as_library():
     assert not ok
     assert any("above max" in p for p in problems)
     assert any("worker 2" in p for p in problems)
+
+
+def test_check_fleet_cache_exit_codes_both_ways():
+    """ISSUE-15 satellite: the heartbeat-carried cache summary rendered
+    per worker (blocks used/shared, hit rate, digest version/age — the
+    very payload serve/affinity.py scores against) and judged: a worker
+    claiming more blocks in use than its pool holds is a page, because
+    an affinity router trusting that summary routes into a lie."""
+    r = _run("tools/check_fleet.py", CACHE_OK)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert ": OK" in r.stdout
+    # both workers render a cache line; worker 0 published a full
+    # digest frame, worker 1 a delta frame — n counts entries either way
+    assert "cache: blocks 31/47 (9 shared)" in r.stdout
+    assert "hit rate 80.0%" in r.stdout
+    assert "digest v7 (4 prefixes, age 0.18s)" in r.stdout
+    assert "cache: blocks 18/47 (4 shared)" in r.stdout
+    assert "digest v3 (2 prefixes, age 0.27s)" in r.stdout
+    r = _run("tools/check_fleet.py", CACHE_BAD)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "FLEET UNHEALTHY" in r.stdout
+    assert ("worker 0: cache accounting broken (61 blocks used of 47)"
+            in r.stdout)
+    # a kv summary WITHOUT a digest is fine (pre-ISSUE-15 worker, or
+    # digests disabled): rendered without the digest suffix, no page
+    assert "worker 1: cache accounting" not in r.stdout
+
+
+def test_check_fleet_cache_verdict_as_library():
+    from tools.check_fleet import fleet_verdict, load_snapshot
+
+    ok, problems = fleet_verdict(load_snapshot(CACHE_OK))
+    assert ok and problems == []
+    ok, problems = fleet_verdict(load_snapshot(CACHE_BAD))
+    assert not ok
+    assert any("cache accounting broken" in p for p in problems)
     # a size below min pages the other way too
     snap = load_snapshot(ELASTIC_OK)
     snap["autoscaler"]["size"] = 0
@@ -334,6 +379,15 @@ def test_check_bench_exit_codes_both_ways(tmp_path):
     assert "autoscale_burst_100rps.reaction_within_window" in r.stdout
     assert "autoscale_burst_100rps.oscillation_ok" in r.stdout
     assert "autoscale_burst_100rps.promote_join_s" in r.stdout
+    # the ISSUE-15 cache-routing gates regress in the same ledger: the
+    # affinity edge evaporated (hit-rate AND goodput ratios below the
+    # band), two requests lost, and one stream diverged from the
+    # least-loaded arm — identity is an absolute contract (baseline
+    # 1.0, tol 0), so the planted 0.958 must fail, not drift
+    assert "cache_routing_100rps.hit_rate_ratio" in r.stdout
+    assert "cache_routing_100rps.goodput_ratio" in r.stdout
+    assert "cache_routing_100rps.lost" in r.stdout
+    assert "cache_routing_100rps.token_identity" in r.stdout
     # unreadable input is exit 2, not a fake verdict
     garbage = tmp_path / "garbage.json"
     garbage.write_text("{broken")

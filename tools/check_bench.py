@@ -135,6 +135,20 @@ DEFAULT_GATES: Dict[str, dict] = {
         {"direction": "higher", "tol": 0.0},
     "autoscale_burst_100rps.promote_join_s":
         {"direction": "lower", "tol": 4.0},
+    # cache-aware routing (ISSUE 15): affinity must keep beating
+    # least-loaded on the fleet prefix-hit-token rate at the same
+    # undersized pool (drift-tolerant — the contrast, not its exact
+    # size, is the claim) without taxing goodput; zero-lost and greedy
+    # token identity are CONTRACTS (routing changes WHERE a request
+    # runs, never WHAT it produces), gated absolute
+    "cache_routing_100rps.hit_rate_ratio":
+        {"direction": "higher", "tol": 0.06},
+    "cache_routing_100rps.goodput_ratio":
+        {"direction": "higher", "tol": 0.06},
+    "cache_routing_100rps.lost":
+        {"direction": "lower", "tol": 0.0},
+    "cache_routing_100rps.token_identity":
+        {"direction": "higher", "tol": 0.0},
 }
 
 

@@ -136,6 +136,18 @@ def fleet_verdict(healthz: dict,
                 f"worker {wid}: heartbeat stale "
                 f"({hb:.2f}s > {max_heartbeat_age_s}s)"
             )
+        kv = w.get("kv")
+        if isinstance(kv, dict):
+            used = kv.get("blocks_used", 0)
+            total = kv.get("blocks_total", 0)
+            if total and used > total:
+                # more blocks in use than the pool holds: the summary
+                # (or the allocator behind it) is lying — page, because
+                # cache-aware routing scores against this very payload
+                problems.append(
+                    f"worker {wid}: cache accounting broken "
+                    f"({used} blocks used of {total})"
+                )
     asc = healthz.get("autoscaler")
     if isinstance(asc, dict):
         size = asc.get("size")
@@ -193,6 +205,28 @@ def render(source: str, healthz: dict, ok: bool,
             + (f"{hb:.2f}s" if hb is not None else "-")
             + ("  [draining]" if w.get("draining") else "")
         )
+        kv = w.get("kv")
+        if isinstance(kv, dict):
+            # the heartbeat-carried cache summary the affinity router
+            # scores against (serve/affinity.py): occupancy, hit rate,
+            # and the digest's version/entry-count — its age IS the
+            # heartbeat age (it rode the same frame)
+            hit_rate = kv.get("prefix_hit_rate", 0.0)
+            line = (
+                f"    cache: blocks {kv.get('blocks_used', 0)}"
+                f"/{kv.get('blocks_total', 0)}"
+                f" ({kv.get('blocks_shared', 0)} shared)"
+                f"  hit rate {hit_rate * 100:.1f}%"
+            )
+            dg = kv.get("digest")
+            if isinstance(dg, dict):
+                line += (
+                    f"  digest v{dg.get('v', '?')}"
+                    f" ({dg.get('n', 0)} prefixes"
+                    + (f", age {hb:.2f}s" if hb is not None else "")
+                    + ")"
+                )
+            lines.append(line)
     asc = healthz.get("autoscaler")
     if isinstance(asc, dict):
         lines.append(
