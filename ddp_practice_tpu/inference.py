@@ -161,6 +161,69 @@ def sample_logits(
     return jax.random.categorical(key, logits, axis=-1)
 
 
+def sample_logits_batch(
+    logits: jnp.ndarray,
+    keys: jnp.ndarray,
+    *,
+    temperature: jnp.ndarray,
+    top_k: jnp.ndarray,
+    top_p: jnp.ndarray,
+) -> jnp.ndarray:
+    """Per-ROW sampling over logits (b, vocab): temperature / top_k /
+    top_p are traced (b,) arrays, not compile-time constants — the
+    per-slot sampling path (serve/engine.py `per_slot_sampling`), where
+    one jitted decode program serves a batch mixing greedy and sampled
+    requests with arbitrary per-request params and never recompiles
+    when they change.
+
+    Row semantics match `sample_logits` exactly (pinned in
+    tests/test_per_slot_sampling.py): temperature <= 0 is greedy
+    argmax, top_k keeps the k highest logits (k <= 0 = off; ties at
+    the kth value survive, as with lax.top_k), top_p keeps the
+    smallest sorted prefix whose EXCLUSIVE cumulative probability is
+    below p (p <= 0 = off); the filters compose k-then-p. The only
+    difference is mechanism: a static k can call lax.top_k, a traced
+    per-row k cannot, so the threshold comes from a descending sort —
+    the same kth-largest VALUE either way. `keys` is (b, 2) uint32 raw
+    key data, one independent chain per row; greedy rows ignore their
+    draw (the chain still advances uniformly, so a request's stream
+    never depends on its batchmates' params).
+    """
+    v = logits.shape[-1]
+    logits32 = logits.astype(jnp.float32)
+    greedy = jnp.argmax(logits32, axis=-1)
+    temperature = jnp.asarray(temperature, jnp.float32)
+    top_k = jnp.asarray(top_k, jnp.int32)
+    top_p = jnp.asarray(top_p, jnp.float32)
+    is_greedy = temperature <= 0.0
+    safe_t = jnp.where(is_greedy, 1.0, temperature)
+    scaled = logits32 / safe_t[:, None]
+    neg = jnp.asarray(-1e30, jnp.float32)
+    k = jnp.clip(top_k, 0, v)
+    desc = jnp.sort(scaled, axis=-1)[:, ::-1]
+    kth = jnp.take_along_axis(
+        desc, jnp.maximum(k - 1, 0)[:, None], axis=-1
+    )
+    scaled = jnp.where((k[:, None] > 0) & (scaled < kth), neg, scaled)
+    desc = jnp.sort(scaled, axis=-1)[:, ::-1]
+    probs = jax.nn.softmax(desc, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1) - probs
+    keep = cum < top_p[:, None]
+    thresh = jnp.min(
+        jnp.where(keep, desc, jnp.inf), axis=-1, keepdims=True
+    )
+    scaled = jnp.where(
+        (top_p[:, None] > 0.0) & (scaled < thresh), neg, scaled
+    )
+    # one categorical per row under its own key, called at the same
+    # (1, vocab) shape as the per-request path so the drawn bits match
+    # sample_logits bit-for-bit under the same sub-key
+    sampled = jax.vmap(
+        lambda kk, row: jax.random.categorical(kk, row[None], axis=-1)[0]
+    )(keys, scaled)
+    return jnp.where(is_greedy, greedy, sampled.astype(greedy.dtype))
+
+
 def make_generate_fn(
     model,
     *,

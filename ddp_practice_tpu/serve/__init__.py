@@ -68,8 +68,19 @@ Layers (each its own module, composable and separately testable):
 - bench.py     — serve_bench: one Poisson trace through the continuous
   engine, the static-batch baseline, and (--replicas) the router fleet
   with optional --fault-plan goodput runs (BENCHMARKS.md records the
-  curves); also the `cli.py serve` entry point.
+  curves); also the `cli.py serve` entry point;
+- frontdoor.py — the HTTP/SSE wire surface over Router.stream: POST
+  /v1/generate streams the typed tokens/resumed/end events as SSE
+  frames (sse.py codec, shared by server and client), per-tenant
+  admission at the door (admission.py token buckets + concurrency
+  caps), auth/validation hooks, bounded-buffer slow-consumer shedding,
+  and a SIGTERM-shaped graceful drain.
 """
+
+from ddp_practice_tpu.serve.admission import (
+    AdmissionController,
+    TenantPolicy,
+)
 
 from ddp_practice_tpu.serve.engine import (
     EngineConfig,
@@ -92,8 +103,18 @@ from ddp_practice_tpu.serve.kv_pages import (
     BlockAllocator,
     RadixPrefixCache,
 )
+from ddp_practice_tpu.serve.frontdoor import (
+    Frontdoor,
+    FrontdoorConfig,
+    RouterDriver,
+    sse_request,
+)
 from ddp_practice_tpu.serve.kv_slots import SlotAllocator
-from ddp_practice_tpu.serve.metrics import RouterMetrics, ServeMetrics
+from ddp_practice_tpu.serve.metrics import (
+    FrontdoorMetrics,
+    RouterMetrics,
+    ServeMetrics,
+)
 from ddp_practice_tpu.serve.router import (
     Router,
     RouterConfig,
@@ -132,6 +153,7 @@ from ddp_practice_tpu.serve.supervisor import (
 from ddp_practice_tpu.serve.worker import WorkerSpec
 
 __all__ = [
+    "AdmissionController",
     "AlertSinkSpec",
     "AlertSinks",
     "BlockAllocator",
@@ -145,6 +167,9 @@ __all__ = [
     "FaultInjector",
     "FaultPlan",
     "FaultSpec",
+    "Frontdoor",
+    "FrontdoorConfig",
+    "FrontdoorMetrics",
     "HealthState",
     "MonotonicClock",
     "PagedEngine",
@@ -156,6 +181,7 @@ __all__ = [
     "Request",
     "Router",
     "RouterConfig",
+    "RouterDriver",
     "RouterMetrics",
     "RpcClient",
     "RpcError",
@@ -169,7 +195,9 @@ __all__ = [
     "SlotEngine",
     "Supervisor",
     "SupervisorConfig",
+    "TenantPolicy",
     "WorkerSpec",
     "make_fleet_router",
     "make_router",
+    "sse_request",
 ]

@@ -44,6 +44,7 @@ excluded; tracing overhead measured < 1%, BENCHMARKS.md).
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import json
 import os
 import sys
@@ -2438,6 +2439,460 @@ def streaming_bench(
         sup_off.stop()
 
 
+def _mixed_prefill_trace(*, n_requests, rate_hz, vocab, long_len,
+                         short_range=(2, 10), max_new_range=(4, 8),
+                         seed=0) -> list:
+    """Every third request carries a LONG cold prompt, the rest are
+    short interactive ones — the Sarathi mixed workload where one
+    monolithic long prefill head-of-line-blocks every short request
+    queued behind it. Chunked prefill's whole claim is the short
+    requests' TTFT tail on exactly this trace."""
+    rng = np.random.default_rng(seed)
+    gaps = rng.exponential(1.0 / rate_hz, n_requests)
+    arrivals = np.cumsum(gaps)
+    trace = []
+    for i in range(n_requests):
+        long = i % 3 == 0
+        plen = (long_len if long
+                else int(rng.integers(short_range[0],
+                                      short_range[1] + 1)))
+        trace.append({
+            "rid": i,
+            "arrival": float(arrivals[i]),
+            "prompt": rng.integers(0, vocab, plen).tolist(),
+            "max_new_tokens": int(rng.integers(
+                max_new_range[0], max_new_range[1] + 1)),
+            "long": long,
+        })
+    return trace
+
+
+def _wire_replay(port, trace, *, body_extra=None,
+                 timeout_s: float = 600.0) -> tuple:
+    """Fire one arrival trace at a live Frontdoor through REAL client
+    sockets — one thread per request, sleeping to its Poisson arrival,
+    then a blocking `sse_request`. Returns ``({rid: {status, sent,
+    events}}, elapsed_s)``; `body_extra(t)` merges per-request fields
+    (sampling knobs, tenant) into the POSTed JSON."""
+    import threading
+
+    from ddp_practice_tpu.serve.frontdoor import sse_request
+
+    results: dict = {}
+    lock = threading.Lock()
+    t0 = time.monotonic()
+
+    def one(t):
+        wait = t0 + t["arrival"] - time.monotonic()
+        if wait > 0:
+            time.sleep(wait)
+        body = {"prompt": t["prompt"],
+                "max_new_tokens": t["max_new_tokens"], "seed": 0}
+        if body_extra is not None:
+            body.update(body_extra(t))
+        sent = time.monotonic()
+        try:
+            status, events = sse_request(
+                "127.0.0.1", port, body, timeout_s=timeout_s)
+        except OSError:
+            status, events = -1, []
+        with lock:
+            results[t["rid"]] = {
+                "status": status, "sent": sent, "events": events}
+
+    threads = [threading.Thread(target=one, args=(t,), daemon=True)
+               for t in trace]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    return results, time.monotonic() - t0
+
+
+def _score_wire(trace, results, elapsed) -> tuple:
+    """Score a wire replay the way _replay_through_router scores an
+    in-process one — goodput over terminal-ok streams, client-side
+    TTFT/latency percentiles, loss — and keep the raw SSE capture
+    (`{"stream", "id", "event", "data"}` records) for the
+    tools/check_stream.py --sse audit. Returns (row, tokens_by_rid,
+    capture)."""
+    tokens: dict = {}
+    capture: list = []
+    ttfts, lats = [], []
+    statuses: dict = {}
+    ended_ok = 0
+    resumed = 0
+    ok_tokens = 0
+    for t in trace:
+        rid = t["rid"]
+        r = results.get(rid)
+        if r is None or r["status"] != 200:
+            statuses[f"http_{r['status'] if r else 'none'}"] = (
+                statuses.get(
+                    f"http_{r['status'] if r else 'none'}", 0) + 1)
+            continue
+        toks: list = []
+        end_status = None
+        first_tok_t = None
+        for ev in r["events"]:
+            capture.append({"stream": f"rid:{rid}", "id": ev["id"],
+                            "event": ev["event"], "data": ev["data"]})
+            data = ev["data"] if isinstance(ev["data"], dict) else {}
+            if ev["event"] == "tokens":
+                toks.extend(data.get("tokens") or [])
+                if first_tok_t is None:
+                    first_tok_t = ev["t"]
+            elif ev["event"] == "resumed":
+                resumed += 1
+            elif ev["event"] == "end":
+                end_status = data.get("status")
+        tokens[rid] = toks
+        key = end_status if end_status is not None else "unterminated"
+        statuses[key] = statuses.get(key, 0) + 1
+        if end_status in ("eos", "length", "stop"):
+            ended_ok += 1
+            ok_tokens += len(toks)
+            if first_tok_t is not None:
+                ttfts.append(first_tok_t - r["sent"])
+            lats.append(r["events"][-1]["t"] - r["sent"])
+    row = {
+        "elapsed_s": elapsed,
+        "useful_tokens": ok_tokens,
+        "goodput_tokens_per_sec": ok_tokens / elapsed,
+        "ttft_s": _percentiles(ttfts) if ttfts else {},
+        "latency_s": _percentiles(lats) if lats else {},
+        "completions": ended_ok,
+        "lost": len(trace) - ended_ok,
+        "statuses": statuses,
+        "resumed_markers": resumed,
+    }
+    return row, tokens, capture
+
+
+def _sse_audit(capture) -> dict:
+    """The offline wire audit, in-process: map the SSE capture through
+    tools/check_stream.py --sse and report the verdict (the bench's
+    own acceptance row, same rules the CLI applies to a dump)."""
+    try:
+        from tools.check_stream import sse_to_chunks, stream_verdict
+    except ImportError:  # tools/ not importable (installed pkg)
+        return {"ok": None}
+    ok, audit = stream_verdict(sse_to_chunks(capture))
+    return {
+        "ok": ok, "streams": audit["streams"],
+        "violations": sum(len(v)
+                          for v in audit["violations"].values()),
+    }
+
+
+def frontdoor_bench(
+    *,
+    n_requests: int = 24,
+    rate_hz: float = 100.0,
+    max_slots: int = 8,
+    vocab: int = 32,
+    hidden: int = 64,
+    depth: int = 2,
+    heads: int = 4,
+    mlp: int = 128,
+    decode_burst: int = 8,
+    procs: int = 2,
+    seed: int = 0,
+    sse_out: Optional[str] = None,
+) -> dict:
+    """End-to-end HTTP/SSE front door (serve/frontdoor.py), four arms
+    producing the BENCH_serve.json `frontdoor_100rps` entry and its
+    check_bench-gated keys:
+
+    - **wire vs in-process** — the SAME Poisson trace replays through a
+      bare `Router.stream` loop and through real client sockets against
+      a Frontdoor over an identical router. Gates: `token_identity`
+      (greedy streams bit-identical across the wire, 1.0) and
+      `goodput_ratio` (wire/in-process — the whole HTTP+SSE+thread hop
+      must cost single-digit percent). The wire capture is audited by
+      tools/check_stream.py --sse (`check_stream.ok`).
+    - **chunked prefill TTFT** — a mixed long/short trace through two
+      paged+prefix-cache front doors, `prefill_chunk` on vs off. Gate:
+      `ttft_p99_ratio_chunked`, the SHORT (interactive) requests'
+      client-side TTFT p99 ratio — chunking exists to stop a monolithic
+      long prefill head-of-line-blocking them (<= 0.85 acceptance).
+    - **mid-stream SIGKILL** — the same wire consumer against a
+      `procs`-worker FLEET front door with a real SIGKILL mid-decode.
+      Gate: `sigkill_lost` == 0 (every socket still gets its typed
+      terminal; resumes splice under the same ids the --sse audit
+      checks).
+    - **mixed sampling churn** — greedy and per-request sampled
+      traffic interleaved through one per_slot_sampling engine. Gate:
+      `sampling_new_compiles` == 0 (one jitted decode program serves
+      both, no shape/program churn from the knobs).
+    """
+    from ddp_practice_tpu.serve.engine import EngineConfig, PagedEngine
+    from ddp_practice_tpu.serve.frontdoor import (
+        Frontdoor,
+        FrontdoorConfig,
+    )
+    from ddp_practice_tpu.serve.metrics import ServeMetrics
+    from ddp_practice_tpu.serve.router import (
+        Router,
+        RouterConfig,
+        make_router,
+    )
+    from ddp_practice_tpu.serve.scheduler import (
+        MonotonicClock,
+        Request,
+        Scheduler,
+    )
+
+    model, params = _build_model(
+        vocab=vocab, max_len=128, hidden=hidden, depth=depth,
+        heads=heads, mlp=mlp,
+    )
+    trace = build_trace(
+        n_requests=n_requests, rate_hz=rate_hz, vocab=vocab,
+        prompt_len_range=(2, 16), max_new_range=(4, 24), seed=seed,
+    )
+    ecfg = EngineConfig(
+        max_slots=max_slots, max_len=96, prompt_buckets=(16,),
+        temperature=0.0, decode_burst=decode_burst, eos_id=None,
+    )
+    report: dict = {
+        "trace": {
+            "n_requests": n_requests, "rate_hz": rate_hz,
+            "seed": seed, "prompt_len_range": [2, 16],
+            "max_new_range": [4, 24],
+        },
+    }
+
+    # ---------------- arm 1: wire identity + goodput vs in-process
+    ip_router = make_router(model, params, 1, ecfg)
+    ip_router.warmup()
+    row_ip = _replay_through_router(ip_router, trace)
+    ref_tokens = {t["rid"]: ip_router.stream(t["rid"]).tokens()
+                  for t in trace}
+    row_ip["mode"] = "in-process router.stream"
+    report["in_process"] = row_ip
+
+    fd = Frontdoor(make_router(model, params, 1, ecfg),
+                   config=FrontdoorConfig())
+    fd.driver.router.warmup()
+    fd.start()
+    try:
+        results, elapsed = _wire_replay(fd.port, trace)
+    finally:
+        fd.close()
+    row_wire, wire_tokens, capture = _score_wire(
+        trace, results, elapsed)
+    row_wire["mode"] = "frontdoor wire"
+    matched = sum(
+        1 for t in trace
+        if wire_tokens.get(t["rid"]) == ref_tokens[t["rid"]]
+        and ref_tokens[t["rid"]]
+    )
+    report.update({
+        "wire": row_wire,
+        "token_identity": matched / len(trace),
+        "goodput_ratio": (row_wire["goodput_tokens_per_sec"]
+                          / row_ip["goodput_tokens_per_sec"]),
+        "check_stream": _sse_audit(capture),
+    })
+
+    # ---------------- arm 2: chunked prefill TTFT on mixed long/short
+    long_len = 720
+    model_l, params_l = _build_model(
+        vocab=vocab, max_len=1024, hidden=hidden, depth=depth,
+        heads=heads, mlp=mlp,
+    )
+    mixed = _mixed_prefill_trace(
+        n_requests=18, rate_hz=rate_hz, vocab=vocab,
+        long_len=long_len, seed=seed,
+    )
+
+    def paged_frontdoor(chunk: int) -> Frontdoor:
+        # bucket 768 + a burst-rounded reservation + the request's own
+        # new tokens: leave two bursts of headroom past the bucket
+        cap_blocks = -(-(768 + 2 * 32 + decode_burst) // 16)
+        engine = PagedEngine(
+            model_l, params_l,
+            EngineConfig(
+                max_slots=4, max_len=1024,
+                prompt_buckets=(16, 32, 768), temperature=0.0,
+                decode_burst=decode_burst, eos_id=None,
+                block_size=16, max_blocks_per_slot=cap_blocks,
+                num_blocks=1 + 4 * cap_blocks,
+                prefix_cache=True, prefill_chunk=chunk,
+            ),
+        )
+        clock = MonotonicClock()
+        sched = Scheduler(engine, clock=clock,
+                          max_queue=len(mixed),
+                          metrics=ServeMetrics())
+        router = Router([sched], clock=clock)
+        router.warmup()
+        return Frontdoor(router, config=FrontdoorConfig())
+
+    chunk_rows = {}
+    chunk_tokens = {}
+    for label, chunk in (("unchunked", 0), ("chunked", 32)):
+        fd2 = paged_frontdoor(chunk)
+        fd2.start()
+        try:
+            results, elapsed = _wire_replay(fd2.port, mixed)
+        finally:
+            fd2.close()
+        row, toks, _ = _score_wire(mixed, results, elapsed)
+        short_ttfts = []
+        for t in mixed:
+            r = results.get(t["rid"])
+            if t["long"] or r is None or r["status"] != 200:
+                continue
+            first = next((ev["t"] for ev in r["events"]
+                          if ev["event"] == "tokens"), None)
+            if first is not None:
+                short_ttfts.append(first - r["sent"])
+        row["ttft_short_s"] = (_percentiles(short_ttfts)
+                               if short_ttfts else {})
+        chunk_rows[label] = row
+        chunk_tokens[label] = toks
+    ttft_ratio = (chunk_rows["chunked"]["ttft_short_s"]["p99"]
+                  / chunk_rows["unchunked"]["ttft_short_s"]["p99"])
+    report.update({
+        "chunked_prefill": {
+            "trace": {"n_requests": len(mixed), "long_len": long_len,
+                      "prefill_chunk": 32},
+            "chunked": chunk_rows["chunked"],
+            "unchunked": chunk_rows["unchunked"],
+            "token_identity": sum(
+                1 for t in mixed
+                if chunk_tokens["chunked"].get(t["rid"])
+                == chunk_tokens["unchunked"].get(t["rid"])
+                and chunk_tokens["unchunked"].get(t["rid"])
+            ) / len(mixed),
+        },
+        "ttft_p99_ratio_chunked": ttft_ratio,
+    })
+
+    # ---------------- arm 3: mid-stream worker SIGKILL, zero lost
+    import threading
+
+    from ddp_practice_tpu.serve.supervisor import (
+        SupervisorConfig,
+        make_fleet_router,
+    )
+    from ddp_practice_tpu.serve.worker import WorkerSpec
+
+    kill_trace = [
+        dict(t, rid=t["rid"] + 300_000, max_new_tokens=32)
+        for t in build_trace(
+            n_requests=12, rate_hz=rate_hz, vocab=vocab,
+            prompt_len_range=(2, 16), max_new_range=(24, 48),
+            seed=seed + 1,
+        )
+    ]
+    router_f, sup, handles = make_fleet_router(
+        WorkerSpec(
+            model={"vocab_size": vocab, "max_len": 128,
+                   "hidden_dim": hidden, "depth": depth,
+                   "num_heads": heads, "mlp_dim": mlp,
+                   "pos_emb": "rope"},
+            engine={"max_slots": max_slots, "max_len": 96,
+                    "prompt_buckets": [16], "temperature": 0.0,
+                    "decode_burst": decode_burst, "eos_id": None},
+            max_queue=len(kill_trace), stream=True,
+        ),
+        procs,
+        config=RouterConfig(streaming=True),
+        sup_config=SupervisorConfig(restart_base_s=0.25),
+    )
+    fd3 = Frontdoor(router_f, config=FrontdoorConfig())
+    fd3.start()
+    kill_at_s = 0.75
+    killer = threading.Timer(kill_at_s, sup.kill, (0, "SIGKILL"))
+    try:
+        killer.start()
+        results, elapsed = _wire_replay(fd3.port, kill_trace)
+    finally:
+        killer.cancel()
+        fd3.close()
+        sup.stop()
+    row_kill, _, kill_capture = _score_wire(
+        kill_trace, results, elapsed)
+    row_kill["mode"] = f"frontdoor fleet x{procs} + SIGKILL"
+    capture.extend(kill_capture)
+    report.update({
+        "sigkill": {
+            **row_kill,
+            "kill_at_s": kill_at_s,
+            "worker_restarts": list(sup.restarts),
+            "check_stream": _sse_audit(kill_capture),
+        },
+        "sigkill_lost": row_kill["lost"],
+    })
+
+    # ---------------- arm 4: mixed greedy+sampled, zero new compiles
+    ecfg_s = dataclasses.replace(ecfg, per_slot_sampling=True)
+    router_s = make_router(model, params, 1, ecfg_s)
+    router_s.warmup()
+    # settle: one greedy + one sampled request so every program the
+    # mixed traffic exercises is resident BEFORE the snapshot
+    router_s.submit(Request(rid=400_000, prompt=[1, 2, 3],
+                            max_new_tokens=4))
+    router_s.submit(Request(rid=400_001, prompt=[4, 5, 6],
+                            max_new_tokens=4, temperature=0.9,
+                            top_k=8, top_p=0.9, seed=7))
+    router_s.run_until_idle()
+    before = router_s.compile_stats()
+
+    def _count(stats) -> int:
+        if isinstance(stats, dict):
+            return sum(_count(v) for v in stats.values())
+        return int(stats)
+
+    churn = [
+        dict(t, rid=t["rid"] + 410_000)
+        for t in build_trace(
+            n_requests=16, rate_hz=rate_hz, vocab=vocab,
+            prompt_len_range=(2, 16), max_new_range=(4, 16),
+            seed=seed + 2,
+        )
+    ]
+
+    def sampling_fields(t):
+        i = t["rid"] - 410_000
+        if i % 2 == 0:
+            return {}
+        return {"temperature": 0.6 + 0.05 * (i % 5),
+                "top_k": 8 if i % 4 == 1 else 0,
+                "top_p": 0.9 if i % 4 == 3 else 0.0,
+                "seed": i}
+
+    fd4 = Frontdoor(router_s, config=FrontdoorConfig())
+    fd4.start()
+    try:
+        results, elapsed = _wire_replay(
+            fd4.port, churn, body_extra=sampling_fields)
+    finally:
+        fd4.close()
+    row_mix, _, mix_capture = _score_wire(churn, results, elapsed)
+    after = router_s.compile_stats()
+    report.update({
+        "sampling": {
+            **row_mix,
+            "mode": "per_slot_sampling mixed greedy+sampled",
+            "compile_stats_before": before,
+            "compile_stats_after": after,
+            "check_stream": _sse_audit(mix_capture),
+        },
+        "sampling_new_compiles": _count(after) - _count(before),
+    })
+
+    if sse_out:
+        with open(sse_out, "w") as f:
+            for rec in capture:
+                f.write(json.dumps(rec) + "\n")
+        report["sse_out"] = sse_out
+    return report
+
+
 def _exemplar_resolution(sup, handles, tracer) -> dict:
     """Scrape each worker's /metrics and answer the acceptance
     question: does the TTFT p99 latency bucket carry an exemplar
@@ -3202,6 +3657,21 @@ def build_parser() -> argparse.ArgumentParser:
                         "pool (serve/affinity.py) — reports the fleet "
                         "prefix-hit-token rate and goodput ratios, "
                         "zero-lost, and greedy token identity")
+    p.add_argument("--frontdoor", action="store_true",
+                   help="bench the HTTP/SSE front door end-to-end "
+                        "through REAL client sockets "
+                        "(serve/frontdoor.py): wire-vs-in-process "
+                        "goodput + greedy token identity, chunked-"
+                        "prefill short-request TTFT p99 ratio, "
+                        "mid-stream worker SIGKILL with zero lost "
+                        "streams (--procs workers), and mixed greedy+"
+                        "sampled churn with zero new compiles — the "
+                        "BENCH_serve.json frontdoor_100rps entry")
+    p.add_argument("--sse-out", dest="sse_out", default=None,
+                   metavar="PATH",
+                   help="with --frontdoor: dump the wire-side SSE "
+                        "frame capture as JSONL — audit with "
+                        "tools/check_stream.py --sse")
     p.add_argument("--autoscale", action="store_true",
                    help="with --procs: A/B an ELASTIC fleet against the "
                         "fixed --procs fleet under a 4x arrival step "
@@ -3346,6 +3816,56 @@ def main(argv=None) -> int:
                   f"latency p50: {report['latency_ratio_p50']:.2f}x  "
                   f"accept rate {report['accept_rate']:.2f}  "
                   f"token identity {report['token_identity']:.2f}")
+        return 0
+    if args.frontdoor:
+        report = frontdoor_bench(
+            n_requests=args.requests, rate_hz=args.rate,
+            max_slots=args.max_slots, procs=args.procs or 2,
+            seed=args.seed, sse_out=args.sse_out,
+            **({"decode_burst": args.decode_burst}
+               if args.decode_burst is not None else {}),
+        )
+        if args.json:
+            print(json.dumps(report))
+        else:
+            ip, w = report["in_process"], report["wire"]
+            print(f"[frontdoor_bench] "
+                  f"{report['trace']['n_requests']} requests @ "
+                  f"{report['trace']['rate_hz']}/s through real "
+                  f"sockets")
+            for r in (ip, w):
+                print(f"  {r['mode']:>24}: "
+                      f"{r['goodput_tokens_per_sec']:8.1f} tok/s  "
+                      f"ttft p50 {r['ttft_s']['p50'] * 1e3:7.1f} ms  "
+                      f"lost {r['lost']}")
+            cs = report["check_stream"]
+            print(f"  wire/in-process goodput "
+                  f"{report['goodput_ratio']:.3f}x  token identity "
+                  f"{report['token_identity']:.2f}  --sse audit "
+                  f"ok={cs.get('ok')} ({cs.get('streams', 0)} "
+                  f"streams, {cs.get('violations', 0)} violations)")
+            cp = report["chunked_prefill"]
+            print(f"  chunked prefill: short-TTFT p99 "
+                  f"{cp['chunked']['ttft_short_s']['p99'] * 1e3:.0f}"
+                  f" ms vs "
+                  f"{cp['unchunked']['ttft_short_s']['p99'] * 1e3:.0f}"
+                  f" ms unchunked — ratio "
+                  f"{report['ttft_p99_ratio_chunked']:.3f}x  "
+                  f"identity {cp['token_identity']:.2f}")
+            sk = report["sigkill"]
+            print(f"  SIGKILL @ {sk['kill_at_s']}s: lost "
+                  f"{report['sigkill_lost']}  resumed markers "
+                  f"{sk['resumed_markers']}  restarts "
+                  f"{sk['worker_restarts']}  audit "
+                  f"ok={sk['check_stream'].get('ok')}")
+            sm = report["sampling"]
+            print(f"  sampling churn: new compiles "
+                  f"{report['sampling_new_compiles']}  statuses "
+                  f"{sm['statuses']}  audit "
+                  f"ok={sm['check_stream'].get('ok')}")
+            if "sse_out" in report:
+                print(f"  wrote SSE capture to {report['sse_out']} — "
+                      f"audit with tools/check_stream.py --sse")
         return 0
     if args.procs and args.otlp_push_overhead:
         report = fleet_otlp_push_bench(

@@ -50,7 +50,12 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from ddp_practice_tpu.inference import decode_apply, make_cache, sample_logits
+from ddp_practice_tpu.inference import (
+    decode_apply,
+    make_cache,
+    sample_logits,
+    sample_logits_batch,
+)
 from ddp_practice_tpu.serve.kv_pages import (
     GARBAGE_BLOCK,
     BlockAllocator,
@@ -137,13 +142,53 @@ class EngineConfig:
     # prompt-lookup n-gram match lengths, tried longest-first
     spec_ngram_max: int = 3
     spec_ngram_min: int = 1
+    # ---- per-slot sampling (both engines) ----
+    # temperature / top_k / top_p stop being compile-time constants:
+    # every slot carries its own (temp, k, p) in small device arrays
+    # shipped per dispatch (like the page table), and the decode
+    # program samples through inference.sample_logits_batch — ONE
+    # jitted program serves a batch mixing greedy and sampled requests,
+    # and a request's params can never cause a recompile. Slots get
+    # their params at admit (`admit(..., sampling=(t, k, p))`, None
+    # fields falling back to the config values above). Excludes
+    # spec_decode: exact acceptance is greedy string matching, which
+    # per-request temperatures would break.
+    per_slot_sampling: bool = False
+    # ---- chunked prefill (PagedEngine + prefix_cache only) ----
+    # split long COLD prompts into chunks of at most this many tokens,
+    # prefilled one chunk per scheduler tick interleaved with decode
+    # bursts (Sarathi-style): a long admit no longer stalls every
+    # running stream for its whole prefill, so TTFT jitter is bounded
+    # by one chunk's forward instead of the longest prompt's. 0 = off
+    # (whole-prompt admission, the pre-16 behavior). Chunks ride the
+    # `_prefix_prefill` program at canonical right-padded slot-local
+    # positions — which is why prefix_cache is required — and a prompt
+    # may now EXCEED the largest bucket: servability is bounded by the
+    # per-slot block capacity, not the bucket table.
+    prefill_chunk: int = 0
 
 
-def _sample_step(cfg: EngineConfig, last_logits, active, keys):
+def _sample_step(cfg: EngineConfig, last_logits, active, keys,
+                 sampling=None):
     """One sampling step shared by both engines: per-slot PRNG chains,
     greedy fast path, pad tokens for free slots. Returns
-    (tokens int32, new_keys)."""
-    if cfg.temperature == 0.0:
+    (tokens int32, new_keys).
+
+    `sampling` is None (params baked from cfg — the legacy single-
+    compile path, pytree-empty so it costs no trace arg) or a triple of
+    traced (s,) arrays (temperature, top_k, top_p) — the
+    per_slot_sampling path, where every slot samples under its own
+    params via sample_logits_batch and the key chains ALWAYS advance
+    (greedy rows discard their draw), so a request's stream never
+    depends on its batchmates' params."""
+    if sampling is not None:
+        temp, tk, tp = sampling
+        split = jax.vmap(lambda k: jax.random.split(k, 2))(keys)
+        subs, new_keys = split[:, 0], split[:, 1]
+        toks = sample_logits_batch(
+            last_logits, subs, temperature=temp, top_k=tk, top_p=tp
+        )
+    elif cfg.temperature == 0.0:
         toks = sample_logits(last_logits, None, temperature=0.0)
         new_keys = keys
     else:
@@ -211,6 +256,11 @@ def warm_engine(engine, widths=None) -> None:
     for w in widths or engine.buckets:
         slot = engine.admit([1] * w,
                             max_positions=engine.config.decode_burst)
+        # chunk-admitted prompts (prefill_chunk) activate only once
+        # every chunk has run — drive the chunk program to completion
+        # so its compiles land in warmup too
+        while getattr(engine, "is_prefilling", lambda s: False)(slot):
+            engine.prefill_step(slot)
         engine.step_burst()
         engine.release(slot)
     if getattr(engine, "drafter", None) is not None:
@@ -278,6 +328,51 @@ class _EngineBase:
             f"{self.buckets[-1]}"
         )
 
+    def fits_prompt(self, prompt_len: int) -> bool:
+        """Can this engine EVER serve a prompt of this length? The
+        feasibility probe the router's salvage/failover path asks
+        before re-targeting a request — bucket-bounded here; the
+        chunk-capable PagedEngine overrides it with a capacity bound."""
+        try:
+            self.bucket_for(prompt_len)
+            return True
+        except ValueError:
+            return False
+
+    def _sampling_args(self):
+        """Per-slot sampling params for the next decode dispatch: a
+        triple of (s,) device arrays when per_slot_sampling, else None.
+        None is an EMPTY pytree, so the legacy path's decode program
+        keeps its single compile and the per-slot path adds exactly
+        one — the churn pins (compile_stats) cover both."""
+        if not self.config.per_slot_sampling:
+            return None
+        return (jnp.asarray(self._temp), jnp.asarray(self._topk),
+                jnp.asarray(self._topp))
+
+    def _set_sampling(self, slot: int, sampling) -> None:
+        """Record a slot's sampling params at admit. `sampling` is
+        (temperature, top_k, top_p) with None fields falling back to
+        the engine config — the scheduler passes a request's overrides
+        verbatim. Overrides without per_slot_sampling raise: silently
+        sampling at the WRONG params is the one outcome this must
+        never produce (the decode program bakes the config values in)."""
+        cfg = self.config
+        t, k, p = sampling if sampling is not None else (None, None, None)
+        t = cfg.temperature if t is None else float(t)
+        k = cfg.top_k if k is None else int(k)
+        p = cfg.top_p if p is None else float(p)
+        if not cfg.per_slot_sampling and (
+                t != cfg.temperature or k != cfg.top_k
+                or p != cfg.top_p):
+            raise ValueError(
+                "per-request sampling params need "
+                "EngineConfig.per_slot_sampling=True"
+            )
+        self._temp[slot] = t
+        self._topk[slot] = k
+        self._topp[slot] = p
+
     @property
     def num_active(self) -> int:
         return self.allocator.num_used
@@ -333,6 +428,13 @@ class SlotEngine(_EngineBase):
                 "paged prefill through per-slot page tables, which the "
                 "shared-cursor slot pool cannot express"
             )
+        if config.prefill_chunk:
+            raise ValueError(
+                "prefill_chunk needs PagedEngine with prefix_cache — "
+                "chunks append at canonical slot-local positions "
+                "through the page table, which the shared-cursor slot "
+                "pool cannot express"
+            )
         self.model = model
         self.params = params
         self.batch_stats = batch_stats
@@ -355,6 +457,12 @@ class SlotEngine(_EngineBase):
         self._attn_starts = jnp.zeros((s,), jnp.int32)
         self._keys = jnp.zeros((s, 2), jnp.uint32)
         self._active = np.zeros((s,), bool)
+        # per-slot sampling mirrors (host side, shipped per dispatch
+        # like _active when per_slot_sampling is on); config-filled so
+        # a slot admitted without overrides samples exactly as before
+        self._temp = np.full((s,), config.temperature, np.float32)
+        self._topk = np.full((s,), config.top_k, np.int32)
+        self._topp = np.full((s,), config.top_p, np.float32)
         self.last_finite = np.ones((1, s), bool)  # updated per step_burst
         self._slot_trace: dict = {}  # slot -> trace_id (tracer attached)
         if config.decode_burst < 1:
@@ -383,7 +491,7 @@ class SlotEngine(_EngineBase):
         return pool, last_logits, attn_starts
 
     def _decode_body(self, params, pool, last_logits, attn_starts,
-                     active, keys):
+                     active, keys, sampling):
         cfg = self.config
         # per-slot finite-logits flag, computed on the SAMPLING INPUT: a
         # non-finite row (bf16 overflow, poisoned cache) marks only its
@@ -391,7 +499,8 @@ class SlotEngine(_EngineBase):
         # and this flag is what lets the scheduler finish ONE request
         # with status "error" instead of serving garbage batch-wide
         finite = jnp.isfinite(last_logits).all(axis=-1)
-        toks, new_keys = _sample_step(cfg, last_logits, active, keys)
+        toks, new_keys = _sample_step(cfg, last_logits, active, keys,
+                                      sampling)
         pool, logits = decode_apply(
             self.model, params, pool, toks[:, None],
             attn_start=attn_starts, batch_stats=self.batch_stats,
@@ -399,7 +508,7 @@ class SlotEngine(_EngineBase):
         return pool, logits[:, -1], toks, new_keys, finite
 
     def _decode_burst(self, params, pool, last_logits, attn_starts,
-                      active, keys):
+                      active, keys, sampling):
         """lax.scan of `decode_burst` single-token steps per dispatch —
         the host-overhead amortizer (multi-step scheduling). Returns
         tokens (K, max_slots); K=1 is plain token-granular stepping."""
@@ -407,7 +516,8 @@ class SlotEngine(_EngineBase):
         def body(carry, _):
             pool, last_logits, keys = carry
             pool, last_logits, toks, keys, finite = self._decode_body(
-                params, pool, last_logits, attn_starts, active, keys
+                params, pool, last_logits, attn_starts, active, keys,
+                sampling,
             )
             return (pool, last_logits, keys), (toks, finite)
 
@@ -461,7 +571,8 @@ class SlotEngine(_EngineBase):
 
     def admit(self, prompt: Sequence[int], *, seed: int = 0,
               max_positions: Optional[int] = None,
-              trace_id: Optional[str] = None) -> int:
+              trace_id: Optional[str] = None,
+              sampling: Optional[Tuple] = None) -> int:
         """Prefill `prompt` into a free slot; returns the slot index.
 
         The prompt joins exactly where the running batch is: its last
@@ -473,7 +584,9 @@ class SlotEngine(_EngineBase):
         parity with PagedEngine (which reserves blocks per request) and
         ignored here: slot-pool positions are a global resource.
         `trace_id` names the prefill span / profiler annotation when a
-        tracer is attached.
+        tracer is attached. `sampling` = per-request (temperature,
+        top_k, top_p) overrides, None fields defaulting to the config
+        (needs EngineConfig.per_slot_sampling).
         """
         p = len(prompt)
         if p == 0:
@@ -482,6 +595,11 @@ class SlotEngine(_EngineBase):
         slot = self.allocator.alloc()
         if slot is None:
             raise RuntimeError("no free slot — scheduler must gate admits")
+        try:
+            self._set_sampling(slot, sampling)
+        except ValueError:
+            self.allocator.free(slot)
+            raise
         start = self.cursor - w
         assert start >= 0, (self.cursor, w)  # cursor >= base >= every bucket
         padded = np.full((1, w), self.config.pad_id, np.int32)
@@ -548,6 +666,7 @@ class SlotEngine(_EngineBase):
                 self.params, self._cache, self._last_logits,
                 self._attn_starts,
                 jnp.asarray(self._active), self._keys,
+                self._sampling_args(),
             )
             _await_dispatch(self._cache, self._last_logits, self._keys)
             self.cursor += k
@@ -666,6 +785,29 @@ class PagedEngine(_EngineBase):
                 )
             if config.spec_k < 1:
                 raise ValueError("spec_k must be >= 1")
+            if config.per_slot_sampling:
+                raise ValueError(
+                    "spec_decode excludes per_slot_sampling — exact "
+                    "acceptance is greedy string matching, which a "
+                    "slot sampling at its own temperature would break"
+                )
+        if config.prefill_chunk:
+            if not config.prefix_cache:
+                raise ValueError(
+                    "prefill_chunk needs prefix_cache=True — chunks "
+                    "append at canonical right-padded positions through "
+                    "the page table (_prefix_prefill), the layout only "
+                    "the prefix-cache mode uses"
+                )
+            if config.prefill_chunk < 1:
+                raise ValueError("prefill_chunk must be >= 1 (0 = off)")
+            if config.prefill_chunk > max(config.prompt_buckets):
+                raise ValueError(
+                    f"prefill_chunk {config.prefill_chunk} exceeds the "
+                    f"largest prompt bucket "
+                    f"{max(config.prompt_buckets)} — each chunk is "
+                    f"bucketed for the prefill compile cache"
+                )
         self.model = model
         self.params = params
         self.batch_stats = batch_stats
@@ -702,6 +844,15 @@ class PagedEngine(_EngineBase):
         self._last_logits = jnp.zeros((s, model.vocab_size), model.dtype)
         self._keys = jnp.zeros((s, 2), jnp.uint32)
         self._active = np.zeros((s,), bool)
+        # per-slot sampling mirrors — same contract as SlotEngine's
+        self._temp = np.full((s,), config.temperature, np.float32)
+        self._topk = np.full((s,), config.top_k, np.int32)
+        self._topp = np.full((s,), config.top_p, np.float32)
+        # chunk-admitted slots mid-prefill: slot -> {"prompt", "done"}.
+        # The slot holds blocks and a page table but stays INACTIVE
+        # (decode bursts pad it, preemption never picks it) until
+        # prefill_step lands the final chunk.
+        self._pending_prompt: dict = {}
         # host-side per-slot state; tiny, shipped to device per dispatch
         self._pt = np.zeros((s, self.max_blocks_per_slot), np.int32)
         self._len = np.zeros((s,), np.int32)
@@ -808,7 +959,7 @@ class PagedEngine(_EngineBase):
         return last_logits, keys
 
     def _decode_burst(self, params, pool, last_logits, attn_starts,
-                      active, keys, page_table, lengths):
+                      active, keys, page_table, lengths, sampling):
         """lax.scan of `decode_burst` paged single-token steps. Each step
         writes active slots' K/V at their own `lengths` position and
         advances only active lengths; retired slots keep scattering into
@@ -817,7 +968,8 @@ class PagedEngine(_EngineBase):
         def body(carry, _):
             pool, last_logits, keys, lengths = carry
             finite = jnp.isfinite(last_logits).all(axis=-1)
-            toks, keys = _sample_step(self.config, last_logits, active, keys)
+            toks, keys = _sample_step(self.config, last_logits, active,
+                                      keys, sampling)
             pool, logits = decode_apply(
                 self.model, params, pool, toks[:, None],
                 attn_start=attn_starts, batch_stats=self.batch_stats,
@@ -946,10 +1098,17 @@ class PagedEngine(_EngineBase):
         no bucket fits the uncached suffix. need_now = prompt-table
         blocks not already cached + one decode block — THE one place the
         gate, make_room, and preempt_headroom derive it, so the three
-        can never disagree on what an admission must take right now."""
+        can never disagree on what an admission must take right now.
+        With prefill_chunk on, a suffix longer than one chunk is
+        bucketed at the CHUNK width (the first chunk is all an
+        admission prefills; later chunks grow like decode), so prompts
+        past the largest bucket stop being "never"."""
         matched = self._probe_prefix(prompt) if prompt is not None else 0
+        suffix = prompt_len - matched
+        if self.config.prefill_chunk:
+            suffix = min(suffix, self.config.prefill_chunk)
         try:
-            w = self.bucket_for(prompt_len - matched)
+            w = self.bucket_for(suffix)
         except ValueError:
             return None
         need_now = self._blocks_for(matched + w) \
@@ -1106,7 +1265,8 @@ class PagedEngine(_EngineBase):
     # ---------------------------------------------------------- admission
     def admit(self, prompt: Sequence[int], *, seed: int = 0,
               max_positions: Optional[int] = None,
-              trace_id: Optional[str] = None) -> int:
+              trace_id: Optional[str] = None,
+              sampling: Optional[Tuple] = None) -> int:
         """Prefill `prompt` into a free slot + blocks; the slot id.
 
         `max_positions` is the request's decode-position budget
@@ -1120,6 +1280,17 @@ class PagedEngine(_EngineBase):
         refcounted (their prefill is SKIPPED), only the suffix runs
         through `_prefix_prefill` at canonical positions, and the
         prompt's own full blocks are inserted for future admissions.
+
+        With `EngineConfig.prefill_chunk`, an uncached suffix longer
+        than one chunk makes this a CHUNK admission: bookkeeping only
+        here (the slot stays inactive, holding just the shared prefix
+        blocks), and the caller drives `prefill_step(slot)` once per
+        tick until it returns True — Sarathi-style prefill/decode
+        interleaving (the scheduler's chunk pump).
+
+        `sampling` = per-request (temperature, top_k, top_p) overrides,
+        None fields defaulting to the config
+        (EngineConfig.per_slot_sampling).
         """
         p = len(prompt)
         if p == 0:
@@ -1130,8 +1301,11 @@ class PagedEngine(_EngineBase):
         if self.radix is not None:
             shared, matched = self.radix.match(prompt)
             self.last_prefix_hit = matched
+        chunk = self.config.prefill_chunk
+        chunked = bool(chunk) and (p - matched) > chunk
         try:
-            w = self.bucket_for(p - matched)
+            w = self.bucket_for(min(p - matched, chunk) if chunked
+                                else p - matched)
         except ValueError:
             self.blocks.free(shared)
             raise
@@ -1163,7 +1337,46 @@ class PagedEngine(_EngineBase):
         if slot is None:
             self.blocks.free(shared)
             raise RuntimeError("no free slot — scheduler must gate admits")
+        try:
+            self._set_sampling(slot, sampling)
+        except ValueError:
+            self.allocator.free(slot)
+            self.blocks.free(shared)
+            raise
         n_shared = len(shared)
+        if chunked:
+            # chunk admission: bookkeeping only. The shared prefix
+            # joins the table refcounted; every uncached token —
+            # including the first chunk — lands through prefill_step,
+            # which grows blocks like decode does (_acquire_decode).
+            # The slot stays INACTIVE until the final chunk: decode
+            # bursts pad it (their garbage write at _len[slot] is
+            # overwritten by the next chunk, or lands in the garbage
+            # block while unallocated) and preemption never picks it.
+            self._pt[slot, :] = 0
+            self._pt[slot, :n_shared] = shared
+            self._nblk[slot] = n_shared
+            self._budget[slot] = min(
+                max(self._blocks_for(end), n_shared),
+                self.max_blocks_per_slot,
+            )
+            self._seq[slot] = self._admit_seq
+            self._admit_seq += 1
+            self._len[slot] = matched
+            self._attn[slot] = 0
+            self._pending_prompt[slot] = {
+                "prompt": [int(t) for t in prompt], "done": matched,
+            }
+            tr = self.tracer
+            if tr is not None and tr.enabled:
+                tid = trace_id or f"slot{slot}"
+                self._slot_trace[slot] = tid
+                tr.instant("chunk_admit", trace_id=tid,
+                           pid=self.replica, tid=SLOT_LANE_BASE + slot,
+                           prompt_len=p, prefix_hit=matched,
+                           chunk=chunk, slot=slot)
+            self._keys = self._keys.at[slot].set(jax.random.PRNGKey(seed))
+            return slot
         n_table = self._blocks_for(matched + w)
         try:
             ids = self._acquire_admit(n_table - n_shared)
@@ -1241,6 +1454,93 @@ class PagedEngine(_EngineBase):
             self.drafter.begin(slot, [int(t) for t in prompt])
         return slot
 
+    # ------------------------------------------------- chunked prefill
+    def is_prefilling(self, slot: int) -> bool:
+        """True while a chunk-admitted slot still has prompt chunks to
+        run (the scheduler's chunk pump drives prefill_step until this
+        flips)."""
+        return slot in self._pending_prompt
+
+    def prefill_step(self, slot: int) -> bool:
+        """Run ONE prefill chunk for a chunk-admitted slot; True when
+        the prompt is fully prefilled (the slot just went active).
+
+        Each chunk is a `_prefix_prefill` dispatch — the suffix-append
+        program admission already compiles, at the chunk's bucket width
+        — placed at slot-local positions [done, done+take) through the
+        page table. Blocks grow per chunk via `_acquire_decode` (free
+        list → prefix eviction → LIFO preemption of ACTIVE slots; this
+        inactive slot is never its own victim), and only for the REAL
+        tokens: a chunk's pad-tail rows scatter into the garbage block
+        past the table, so no block is ever held for padding. The final
+        chunk publishes the prompt's full blocks to the radix cache,
+        seeds the drafter, and activates the slot — exactly the state a
+        whole-prompt admission leaves behind, so everything downstream
+        (decode, preemption, release) is chunk-blind.
+
+        Raises RuntimeError when the pool cannot cover a chunk even
+        after preempting every active slot — the scheduler treats that
+        like any admission failure (releases and requeues)."""
+        st = self._pending_prompt[slot]
+        prompt = st["prompt"]
+        p = len(prompt)
+        done = st["done"]
+        take = min(p - done, self.config.prefill_chunk)
+        w = self.bucket_for(take)
+        need = self._blocks_for(done + take)
+        grow = need - int(self._nblk[slot])
+        if grow > 0:
+            if need > self.max_blocks_per_slot:
+                raise RuntimeError(
+                    f"slot {slot} prompt chunk needs {need} blocks, "
+                    f"past the per-slot capacity "
+                    f"{self.max_blocks_per_slot}"
+                )
+            ids = self._acquire_decode(grow, protect=slot)
+            self._pt[slot, self._nblk[slot]:need] = ids
+            self._nblk[slot] = need
+        padded = np.full((1, w), self.config.pad_id, np.int32)
+        padded[0, :take] = np.asarray(prompt[done:done + take], np.int32)
+        tr = self.tracer
+        if tr is not None and tr.enabled:
+            tid = self._slot_trace.get(slot, f"slot{slot}")
+            span = tr.span("prefill_chunk", trace_id=tid,
+                           pid=self.replica, tid=SLOT_LANE_BASE + slot,
+                           bucket=w, pos0=done, take=take, slot=slot)
+            ann = jax.profiler.TraceAnnotation(
+                f"serve:prefill_chunk:{tid}"
+            )
+        else:
+            span = ann = _NULL
+        with span, ann:
+            self._cache, self._last_logits = self._prefix_jit(
+                self.params, self._cache, self._last_logits,
+                jnp.asarray(padded), jnp.int32(done), jnp.int32(take),
+                jnp.asarray(self._pt[slot:slot + 1]), jnp.int32(slot),
+            )
+            _await_dispatch(self._cache, self._last_logits)
+        done += take
+        st["done"] = done
+        self._len[slot] = done
+        if done < p:
+            return False
+        # final chunk: the slot now looks exactly like a whole-prompt
+        # prefix admission — publish, seed the drafter, go active
+        del self._pending_prompt[slot]
+        floor = self._blocks_for(p)
+        self._nblk[slot] = rewind_block_tail(
+            self.blocks, self._pt[slot], int(self._nblk[slot]), floor
+        )
+        n_full = p // self.config.block_size
+        if n_full:
+            self.radix.insert(
+                prompt, [int(b) for b in self._pt[slot, :n_full]]
+            )
+        if self.drafter is not None:
+            self.drafter.begin(slot, [int(t) for t in prompt])
+        self._active[slot] = True
+        return True
+
     def fork(self, slot: int, *, seed: Optional[int] = None,
              trace_id: Optional[str] = None) -> int:
         """Clone a running request into a new slot WITHOUT copying its
@@ -1264,6 +1564,11 @@ class PagedEngine(_EngineBase):
         self._attn[child] = self._attn[slot]
         self._nblk[child] = n
         self._budget[child] = self._budget[slot]
+        # siblings sample under the parent's params (they diverge by
+        # PRNG chain, not by distribution)
+        self._temp[child] = self._temp[slot]
+        self._topk[child] = self._topk[slot]
+        self._topp[child] = self._topp[slot]
         self._seq[child] = self._admit_seq
         self._admit_seq += 1
         key = (jax.random.PRNGKey(seed) if seed is not None
@@ -1381,6 +1686,7 @@ class PagedEngine(_EngineBase):
                 self.params, self._cache, self._last_logits,
                 jnp.asarray(self._attn), jnp.asarray(self._active),
                 self._keys, jnp.asarray(self._pt), jnp.asarray(self._len),
+                self._sampling_args(),
             )
             _await_dispatch(self._cache, self._last_logits, self._keys)
             self._len[self._active] += k
@@ -1494,6 +1800,16 @@ class PagedEngine(_EngineBase):
         tokens) — can exceed the model's max_len, the paged headline."""
         return int(self._len[slot])
 
+    def fits_prompt(self, prompt_len: int) -> bool:
+        """Chunked mode unbinds servability from the bucket table: any
+        prompt whose tokens + one decode position fit the per-slot
+        capacity and the pool can be chunk-prefilled."""
+        if self.config.prefill_chunk:
+            return (prompt_len + 1 <= self.max_context
+                    and self._blocks_for(prompt_len + 1)
+                    <= self.blocks.num_blocks - 1)
+        return super().fits_prompt(prompt_len)
+
     def poison_slot(self, slot: int) -> None:
         """NaN one slot's pending sampling input (serve/faults.py) —
         identical contract to SlotEngine.poison_slot."""
@@ -1513,6 +1829,7 @@ class PagedEngine(_EngineBase):
         }
 
     def _clear_slot(self, slot: int) -> None:
+        self._pending_prompt.pop(slot, None)
         n = int(self._nblk[slot])
         if n:
             self.blocks.free([int(b) for b in self._pt[slot, :n]])

@@ -246,3 +246,34 @@ class RouterMetrics:
 
     def emit(self, logger=None):
         return emit_metrics(self.report(), logger)
+
+
+class FrontdoorMetrics:
+    """Wire-surface observability for serve/frontdoor.py.
+
+    Everything below the door is already measured (ServeMetrics per
+    replica, RouterMetrics per fleet); this layer counts what only the
+    door can see — HTTP responses by status code, admission refusals
+    by reason, SSE frames shipped, and slow-consumer sheds. The
+    generic `count` hook keeps the front door decoupled from metric
+    naming: it labels and prefixes so the door just states facts
+    ("http code=429", "admission_refused reason=rate").
+    """
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None) -> None:
+        self.registry = registry or MetricsRegistry()
+        r = self.registry
+        self.sse_frames = r.counter("frontdoor_sse_frames_total")
+        self.slow_consumer_sheds = r.counter(
+            "frontdoor_slow_consumer_sheds_total")
+
+    def count(self, what: str, **labels) -> None:
+        self.registry.counter(
+            labelled(f"frontdoor_{what}_total", **labels)
+        ).inc()
+
+    def report(self) -> dict:
+        return self.registry.snapshot()
+
+    def emit(self, logger=None):
+        return emit_metrics(self.report(), logger)

@@ -315,8 +315,12 @@ class ReplicaHandle:
         return self.engine.num_active
 
     def fits_prompt(self, n_tokens: int) -> bool:
-        """Can a prompt of n_tokens prefill here (any bucket holds it,
-        counting a warm prefix where the engine supports one)?"""
+        """Can a prompt of n_tokens prefill here? Delegates to the
+        engine's own feasibility probe (bucket-bounded, except the
+        chunk-capable paged engine, which is capacity-bounded)."""
+        probe = getattr(self.engine, "fits_prompt", None)
+        if probe is not None:
+            return probe(n_tokens)
         try:
             self.engine.bucket_for(n_tokens)
             return True
@@ -704,6 +708,12 @@ class Router:
                 sampled=(True if (tr.retries or tr.failovers)
                          else req.sampled),
                 tenant=req.tenant,
+                # per-request sampling overrides ride every dispatch —
+                # a failover re-admission must sample under the SAME
+                # params or the spliced stream changes distribution
+                temperature=req.temperature,
+                top_k=req.top_k,
+                top_p=req.top_p,
             )
             # stamp the dispatch time BEFORE the submit hop: a remote
             # worker can queue and even start prefill while the RPC is

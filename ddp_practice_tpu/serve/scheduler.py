@@ -127,6 +127,14 @@ class Request:
     # the ORIGINAL arrival): that earlier wait lands in stall_s, not
     # queue_s, so per-replica queue time stays honest under retries.
     submitted: Optional[float] = None
+    # per-request sampling overrides (None = the engine config's
+    # value). Carried across every seam like trace_id/tenant — requeue,
+    # failover, RPC — and handed to the engine at admit; engines
+    # without EngineConfig.per_slot_sampling REJECT overrides rather
+    # than silently sampling at the wrong params.
+    temperature: Optional[float] = None
+    top_k: Optional[int] = None
+    top_p: Optional[float] = None
 
 
 @dataclasses.dataclass
@@ -237,6 +245,10 @@ class _Running:
     # how many of st.tokens have already left as TokenChunks
     chunk_base: int = 0
     emitted: int = 0
+    # chunk-admitted and still mid-prefill (engine.is_prefilling): the
+    # slot holds blocks but is INACTIVE — the prefill pump drives it one
+    # chunk per tick, decode rows skip it, preemption never picks it
+    prefilling: bool = False
 
 
 class Scheduler:
@@ -474,6 +486,8 @@ class Scheduler:
             deadline=orig.deadline, seed=orig.seed, arrival=orig.arrival,
             priority=orig.priority, trace_id=orig.trace_id,
             sampled=orig.sampled, tenant=orig.tenant,
+            temperature=orig.temperature, top_k=orig.top_k,
+            top_p=orig.top_p,
         )
         creq.submitted = self.clock.now()
         return creq
@@ -541,8 +555,12 @@ class Scheduler:
         if not hasattr(eng, "preempt") or not self.running:
             return None
         key = ((req.arrival or 0.0), req.rid)
+        # mid-prefill slots are not preemptable (the engine raises on
+        # inactive slots; their progress is chunks, not salvageable
+        # tokens) — skip them like the engine's own victim search does
         fair = [(st.seq, slot) for slot, st in self.running.items()
-                if key < ((st.req.arrival or 0.0), st.req.rid)]
+                if not st.prefilling
+                and key < ((st.req.arrival or 0.0), st.req.rid)]
         if not fair:
             return None
         slot = max(fair)[1]
@@ -564,7 +582,8 @@ class Scheduler:
             return True
         key = ((req.arrival or 0.0), req.rid)
         fair = [s for s, st in self.running.items()
-                if key < ((st.req.arrival or 0.0), st.req.rid)]
+                if not st.prefilling
+                and key < ((st.req.arrival or 0.0), st.req.rid)]
         return eng.preempt_headroom(fair, len(req.prompt),
                                     prompt=req.prompt)
 
@@ -668,8 +687,23 @@ class Scheduler:
                 self._finish(req, [], "error")
                 continue
             t_admit0 = self.clock.now()
-            slot = eng.admit(req.prompt, seed=req.seed,
-                             max_positions=needed, trace_id=req.trace_id)
+            admit_kw = {}
+            if (req.temperature is not None or req.top_k is not None
+                    or req.top_p is not None):
+                # only when the request actually overrides — engines
+                # (and test fakes) without the kwarg stay untouched
+                admit_kw["sampling"] = (req.temperature, req.top_k,
+                                        req.top_p)
+            try:
+                slot = eng.admit(req.prompt, seed=req.seed,
+                                 max_positions=needed,
+                                 trace_id=req.trace_id, **admit_kw)
+            except ValueError:
+                # sampling overrides on an engine without
+                # per_slot_sampling (or a shape the gate missed): a
+                # typed fast negative, not a crashed tick
+                self._finish(req, [], "rejected")
+                continue
             t_admit1 = self.clock.now()
             hit = getattr(eng, "last_prefix_hit", None)
             if hit is not None:
@@ -690,19 +724,63 @@ class Scheduler:
                 # a preempted continuation's chunks continue the rid's
                 # global token offsets after the already-streamed prefix
                 chunk_base=len(prior["prefix"]) if prior else 0,
+                prefilling=bool(getattr(
+                    eng, "is_prefilling", lambda s: False)(slot)),
             )
+
+    def _prefill_pump(self) -> None:
+        """Drive ONE prefill chunk per mid-prefill slot per tick —
+        Sarathi-style interleaving: a long cold prompt shares every
+        tick with the running decode burst instead of monopolizing one,
+        so running streams see at most one chunk's forward of added
+        inter-token latency and TTFT jitter stops tracking the longest
+        admit. Deadline expiry mid-prefill is a "timeout" finish (the
+        blocks come back); a chunk the pool cannot cover even after
+        preemption releases the slot and requeues the request at the
+        front, like any admission failure."""
+        eng = self.engine
+        for slot, st in list(self.running.items()):
+            if not st.prefilling:
+                continue
+            now = self.clock.now()
+            if st.req.deadline is not None and now > st.req.deadline:
+                del self.running[slot]
+                eng.release(slot)
+                self._finish(st.req, [], "timeout",
+                             admitted=(st.admit_t0, now))
+                continue
+            try:
+                done = eng.prefill_step(slot)
+            except RuntimeError:
+                del self.running[slot]
+                eng.release(slot)
+                self.queue.appendleft(self._continuation(st))
+                continue
+            self.clock.tick()
+            if done:
+                # the slot just went active: prefill ends HERE for the
+                # flight record, and the next burst decodes it with
+                # everyone else
+                st.prefilling = False
+                st.admit_t1 = self.clock.now()
+        # chunk growth may have preempted active runners
+        # (_acquire_decode inside prefill_step) — requeue them before
+        # the burst maps token rows
+        self._drain_preempted()
 
     # ------------------------------------------------------------ the tick
     def step(self) -> List[Completion]:
-        """One tick: expire -> admit -> decode -> release. Returns the
-        completions finalized during this tick. May raise
-        faults.ReplicaCrashed when a chaos plan kills this replica."""
+        """One tick: expire -> admit -> prefill chunks -> decode ->
+        release. Returns the completions finalized during this tick.
+        May raise faults.ReplicaCrashed when a chaos plan kills this
+        replica."""
         if self.fault_hook is not None:
             self.fault_hook.on_tick(self)
         before = len(self.completions)
         self._expire_queue()
         self._admit()
-        if self.running:
+        self._prefill_pump()
+        if any(not st.prefilling for st in self.running.values()):
             eng = self.engine
             counts = None
             drafted = None
@@ -731,6 +809,8 @@ class Scheduler:
                 # Every slot still running was active at dispatch, so
                 # counts >= 1 (accepted = counts - 1).
                 for slot, st in self.running.items():
+                    if st.prefilling:
+                        continue  # inactive at dispatch: counts[slot]=0
                     stats = self._spec_stats.setdefault(
                         st.req.rid, [0, 0])
                     stats[0] += int(drafted[1][slot])
@@ -745,6 +825,8 @@ class Scheduler:
                 self.clock.tick()
                 now = self.clock.now()
                 for slot, st in list(self.running.items()):
+                    if st.prefilling:
+                        continue  # inactive at dispatch: rows are pads
                     if counts is not None and k >= int(counts[slot]):
                         continue  # this slot's verified run was shorter
                     if not finite[k, slot]:

@@ -732,6 +732,8 @@ class RemoteReplicaHandle:
             "arrival": req.arrival, "priority": req.priority,
             "trace_id": req.trace_id, "sampled": req.sampled,
             "tenant": req.tenant,
+            "temperature": req.temperature, "top_k": req.top_k,
+            "top_p": req.top_p,
         }
 
     @staticmethod

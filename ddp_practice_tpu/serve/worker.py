@@ -385,6 +385,9 @@ class WorkerServer:
                 # the same deterministic hash and agrees anyway
                 sampled=r.get("sampled"),
                 tenant=r.get("tenant"),
+                temperature=r.get("temperature"),
+                top_k=r.get("top_k"),
+                top_p=r.get("top_p"),
             ))
             self._seen_rids[rid] = True
             # the dedup window only needs to outlive a transport retry

@@ -125,6 +125,27 @@ def compile_guard():
     return guard
 
 
+@pytest.fixture
+def ephemeral_port():
+    """OS-assigned localhost port, as a callable: `port = ephemeral_port()`.
+
+    Shared by every `net`-marked test that needs a port BEFORE the
+    server binds (worker RPC specs, telemetry endpoints). Binding to
+    port 0 and releasing leaves a tiny reuse race — acceptable for
+    tests on a loopback-only box, and servers that can bind 0 directly
+    (frontdoor's default) should do that instead and read the bound
+    port back."""
+    import socket
+
+    def alloc() -> int:
+        with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    return alloc
+
+
 @pytest.fixture(scope="session", autouse=True)
 def _reap_fleet_workers():
     """No spawned worker process survives the session — and a leak is a

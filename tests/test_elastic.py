@@ -173,9 +173,12 @@ def test_fit_recovers_from_injected_failure(tmp_path):
     segfaults flakily on this image's XLA CPU — crash inside
     block_until_ready, load/memory dependent, reproduces on the
     untouched seed tree — and an in-process SIGSEGV would kill the
-    whole pytest session. Real assertion failures still fail here
-    (nonzero exit, traceback in the captured output); only the known
-    signal-death flake skips."""
+    whole pytest session. Signal death gets ONE subprocess rerun (the
+    flake is load-dependent, so a retry usually lands) before the
+    known-flake skip; each attempt is a fresh tmp subdir so a partial
+    checkpoint from the crashed run can't corrupt the retry. Real
+    assertion failures still fail here immediately (nonzero exit,
+    traceback in the captured output) — only signal death reruns."""
     import os
     import signal
     import subprocess
@@ -187,16 +190,20 @@ def test_fit_recovers_from_injected_failure(tmp_path):
     env["PYTHONPATH"] = os.pathsep.join(
         p for p in (repo_root, env.get("PYTHONPATH")) if p
     )
-    proc = subprocess.run(
-        [sys.executable, worker, str(tmp_path)],
-        capture_output=True, text=True, timeout=600, env=env,
-        cwd=repo_root,
-    )
+    for attempt in (1, 2):
+        proc = subprocess.run(
+            [sys.executable, worker, str(tmp_path / f"try{attempt}")],
+            capture_output=True, text=True, timeout=600, env=env,
+            cwd=repo_root,
+        )
+        if proc.returncode >= 0:
+            break
     if proc.returncode < 0:
         sig = signal.Signals(-proc.returncode).name
         pytest.skip(
             f"known flaky XLA-CPU crash ({sig}) in the elastic e2e fit "
-            f"— pre-existing on the seed tree, see tests/elastic_worker.py"
+            f"twice in a row — pre-existing on the seed tree, see "
+            f"tests/elastic_worker.py"
         )
     assert proc.returncode == 0, (
         f"elastic worker failed (rc={proc.returncode})\n"

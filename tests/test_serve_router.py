@@ -386,3 +386,123 @@ def test_replica_recovery_after_down_window(devices, lm):
     router.submit(Request(rid=99, prompt=[5, 6], max_new_tokens=3))
     router.run_until_idle()
     assert {c.rid: c.status for c in router.completions}[99] == "length"
+
+
+# --------------------------------------------- one-way submit cast (PR 15)
+def _cast_handle(drop_first_cast: bool):
+    """Host-pure RemoteReplicaHandle over a scripted one-way wire: the
+    stub client records every submit cast, optionally drops the first
+    frame on the floor, and answers the reconcile poll's `confirm` ask
+    from a worker-side dedup map keyed by rid — the exact seam the
+    fire-and-forget path trusts."""
+    from ddp_practice_tpu.serve.supervisor import (
+        RemoteReplicaHandle,
+        Supervisor,
+        SupervisorConfig,
+    )
+    from ddp_practice_tpu.serve.worker import WorkerSpec
+
+    wire = {"casts": [], "delivered": [], "drop": drop_first_cast,
+            "seen": {}}
+
+    class Client:
+        def cast(self, op, **fields):
+            assert op == "submit"
+            req = fields["request"]
+            wire["casts"].append(req["rid"])
+            if wire["drop"]:
+                wire["drop"] = False
+                return                      # the frame never arrives
+            # worker-side dedup by rid: a replayed cast is absorbed,
+            # never double-admitted
+            if req["rid"] not in wire["seen"]:
+                wire["seen"][req["rid"]] = True
+                wire["delivered"].append(req)
+
+        def call(self, op, **fields):
+            if op == "poll":
+                reply = {
+                    "completions": [], "watermark": 0, "inflight": [],
+                    "stats": {"queue": 0,
+                              "active": len(wire["delivered"]),
+                              "max_slots": 2},
+                    "version": 1,
+                }
+                if fields.get("confirm"):
+                    # absent = never saw the rid (the lost-frame answer)
+                    reply["confirmed"] = {
+                        str(r): True for r in fields["confirm"]
+                        if r in wire["seen"]
+                    }
+                return reply
+            return {"ok": True}
+
+        def close(self):
+            pass
+
+    class Worker:
+        def __init__(self, spec):
+            self.pid = 4242
+            self.spec = spec
+            self.client = Client()
+            self.telemetry_port = 0
+
+        def poll(self):
+            return None
+
+        def kill_signal(self, sig):
+            pass
+
+        def reap(self, timeout_s=5.0):
+            pass
+
+    spec = WorkerSpec(engine={"max_slots": 2, "prompt_buckets": [8]},
+                      max_queue=4)
+    clock = FakeClock(step_s=0.01)
+    sup = Supervisor([spec], SupervisorConfig(), spawn_fn=Worker,
+                     spawn_in_thread=False, clock=clock)
+    sup.start()
+    return RemoteReplicaHandle(0, sup, spec, clock=clock), clock, wire
+
+
+def test_dropped_submit_cast_redispatches_exactly_once():
+    """The PR-15 fire-and-forget seam: a submit cast lost on the wire
+    is re-dispatched by confirm-on-poll reconciliation EXACTLY once —
+    same rid (idempotent at the worker's dedup map), no further casts
+    once the worker confirms, and the request never leaves
+    `outstanding` (the salvage point failover needs)."""
+    h, clock, wire = _cast_handle(drop_first_cast=True)
+    h.submit(Request(rid=9, prompt=[1, 2], max_new_tokens=4,
+                     arrival=0.0))
+    assert wire["casts"] == [9] and wire["delivered"] == []
+    assert 9 in h.outstanding
+
+    clock.advance(10.0)            # past the poll throttle
+    h.step()                       # confirm ask -> "never saw rid 9"
+    assert wire["casts"] == [9, 9]             # re-cast, once
+    assert [r["rid"] for r in wire["delivered"]] == [9]
+
+    for _ in range(3):             # confirmed: reconciliation goes quiet
+        clock.advance(10.0)
+        h.step()
+    assert wire["casts"] == [9, 9]             # no third dispatch
+    assert [r["rid"] for r in wire["delivered"]] == [9]
+    assert 9 in h.outstanding      # still inflight, awaiting completion
+
+
+def test_duplicate_cast_is_absorbed_by_rid_dedup():
+    """The other half of at-least-once delivery: when the first frame
+    DID land but its confirmation hadn't yet, a conservative re-cast
+    reaches the worker as a duplicate rid and must admit nothing new."""
+    h, clock, wire = _cast_handle(drop_first_cast=False)
+    h.submit(Request(rid=3, prompt=[1, 2, 3], max_new_tokens=4,
+                     arrival=0.0))
+    assert [r["rid"] for r in wire["delivered"]] == [3]
+    # replay the same frame (the reconcile path's worst case)
+    h._client().cast("submit", request=h._request_dict(
+        h.outstanding[3]["req"]))
+    assert wire["casts"] == [3, 3]
+    assert [r["rid"] for r in wire["delivered"]] == [3]   # dedup held
+    clock.advance(10.0)
+    h.step()                       # poll confirms; unconfirmed clears
+    assert wire["casts"] == [3, 3]

@@ -413,13 +413,20 @@ def test_check_bench_as_library():
     cur = json.load(open(os.path.join(ROOT, "BENCH_serve.json")))
     base = json.load(open(BENCH_BASELINE))
     ok, rows = bench_verdict(cur, base)
-    assert ok, [r for r in rows if r["status"] not in ("ok", "skipped")]
-    # a key absent from the baseline is SKIPPED (ungated until the
-    # ledger refreshes), but one that vanished from current is a miss
+    assert ok, [r for r in rows
+                if r["status"] not in ("ok", "skipped", "new")]
+    # a key absent from BOTH sides is SKIPPED; one measured in current
+    # with no baseline history is NEW (passes with a note — landing a
+    # new bench entry must not require hand-editing old baselines);
+    # one that vanished from current is a miss
     ok, rows = bench_verdict(
         cur, base, {"nonexistent.key": {"direction": "lower",
                                         "tol": 0.1}})
     assert ok and rows[0]["status"] == "skipped"
+    ok, rows = bench_verdict(
+        {"brand": {"new_metric": 1.23}}, base,
+        {"brand.new_metric": {"direction": "lower", "tol": 0.1}})
+    assert ok and rows[0]["status"] == "new" and "note" in rows[0]
     ok, rows = bench_verdict(
         {}, base, {"fleet_x2_overhead_8rps.latency_ratio_p50":
                    {"direction": "lower", "tol": 0.1}})

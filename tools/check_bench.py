@@ -27,9 +27,13 @@ tolerance::
 baseline * (1 + tol). ``higher`` = higher is better (goodput ratios):
 current >= baseline * (1 - tol). A baseline value of 0 degenerates to
 an absolute bound of tol (the zero-lost invariant: baseline 0 lost,
-tol 0 -> current must be 0). Keys absent from the BASELINE are skipped
-with a note (a new measurement has no history yet — it becomes gated
-when the baseline is refreshed).
+tol 0 -> current must be 0). A gated key measured in CURRENT but
+absent from the BASELINE is "new" — it PASSES with a note (a new
+bench entry has no history yet; landing it must not require
+hand-editing old baselines) and becomes gated when the baseline is
+refreshed. A key absent from BOTH sides is skipped; one that was in
+the baseline but vanished from current is a MISSING failure (that is
+how a regression hides).
 
 The default gate set covers the serving headlines this repo's
 acceptance criteria actually pinned: the RPC-seam and trace-plane
@@ -149,6 +153,25 @@ DEFAULT_GATES: Dict[str, dict] = {
         {"direction": "lower", "tol": 0.0},
     "cache_routing_100rps.token_identity":
         {"direction": "higher", "tol": 0.0},
+    # the wire surface (ISSUE 16): greedy token identity through real
+    # sockets vs in-process Router.stream is a CONTRACT (baseline 1.0,
+    # tol 0 — one diverged stream breaks the front door's whole
+    # claim); chunked prefill must keep its TTFT-p99 edge on the mixed
+    # long/short trace (acceptance: ratio <= 0.85x vs unchunked —
+    # drift-tolerant, the CONTRAST is the claim); wire goodput must
+    # track the in-process arm; zero lost streams under a mid-stream
+    # worker SIGKILL and zero new decode compiles under mixed
+    # greedy+sampled churn are absolutes
+    "frontdoor_100rps.token_identity":
+        {"direction": "higher", "tol": 0.0},
+    "frontdoor_100rps.ttft_p99_ratio_chunked":
+        {"direction": "lower", "tol": 0.15},
+    "frontdoor_100rps.goodput_ratio":
+        {"direction": "higher", "tol": 0.10},
+    "frontdoor_100rps.sigkill_lost":
+        {"direction": "lower", "tol": 0.0},
+    "frontdoor_100rps.sampling_new_compiles":
+        {"direction": "lower", "tol": 0.0},
 }
 
 
@@ -170,8 +193,15 @@ def judge_key(key: str, gate: dict, current, baseline) -> dict:
     row = {"key": key, "direction": direction, "tol": tol,
            "baseline": baseline, "current": current}
     if baseline is None or not isinstance(baseline, (int, float)):
-        row["status"] = "skipped"
-        row["note"] = "no baseline value — ungated until refreshed"
+        if isinstance(current, (int, float)):
+            # measured now, no history: a NEW entry passes with a note
+            # instead of demanding a hand-edited baseline to land
+            row["status"] = "new"
+            row["note"] = ("new measurement, no baseline history — "
+                           "passes; gated once the baseline refreshes")
+        else:
+            row["status"] = "skipped"
+            row["note"] = "no baseline value — ungated until refreshed"
         return row
     if current is None or not isinstance(current, (int, float)):
         # the measurement DISAPPEARED: that is how a regression hides
@@ -200,7 +230,7 @@ def bench_verdict(current: dict, baseline: dict,
         judge_key(key, gate, dig(current, key), dig(baseline, key))
         for key, gate in sorted((gates or DEFAULT_GATES).items())
     ]
-    ok = all(r["status"] in ("ok", "skipped") for r in rows)
+    ok = all(r["status"] in ("ok", "skipped", "new") for r in rows)
     return ok, rows
 
 
@@ -219,7 +249,8 @@ def render(source: str, ok: bool, rows: List[dict]) -> str:
     lines = []
     for r in rows:
         st = r["status"]
-        mark = {"ok": "ok", "skipped": "--", "missing": "MISSING",
+        mark = {"ok": "ok", "skipped": "--", "new": "NEW",
+                "missing": "MISSING",
                 "regression": "REGRESSION"}[st]
         cur = (f"{r['current']:.4g}"
                if isinstance(r["current"], (int, float)) else "-")

@@ -9,6 +9,7 @@ tests/test_zzz_t1_budget.py::
 
     python tools/check_durations.py [/tmp/_t1_durations.json]
         [--budget-s 870] [--top 10] [--json]
+        [--strict-slow] [--noise-margin S]
 
 Exit codes: 0 the run fits its budget, 1 it projects past the budget,
 2 unreadable/shape-invalid ledger.
@@ -21,7 +22,12 @@ What it checks:
 - **slow-marker hygiene** (WARNINGs): any test over 10 s inside a
   ``not slow`` run belongs behind ``@pytest.mark.slow`` (the repo's
   marker contract) — printed per offender so the fix is a one-line
-  diff, escalated to exit 1 under ``--strict-slow``.
+  diff, escalated to exit 1 under ``--strict-slow``. ``--noise-margin
+  S`` raises the threshold to 10+S seconds for the STRICT verdict
+  only (tier-1 runs with ``--strict-slow --noise-margin 2.0``: the
+  1-core CI box jitters a borderline 10.5 s test across the line run
+  to run, and a gate that flaps is a gate that gets ignored — the
+  plain warning still fires at 10 s so the drift stays visible).
 """
 
 from __future__ import annotations
@@ -37,10 +43,16 @@ OVERHEAD_FACTOR = 1.05
 TAIL_ALLOWANCE_S = 45.0
 
 
-def audit(ledger: dict, budget_s: float = DEFAULT_BUDGET_S):
-    """-> (errors, warnings, report) for one parsed ledger object."""
+def audit(ledger: dict, budget_s: float = DEFAULT_BUDGET_S,
+          noise_margin_s: float = 0.0):
+    """-> (errors, warnings, report) for one parsed ledger object.
+    Warnings over SLOW_MARK_S + noise_margin_s carry a ``strict``
+    prefix marker via the returned `strict_warnings` list in the
+    report — --strict-slow fails on those only, so CI jitter inside
+    the margin can't flap the gate."""
     errors: List[str] = []
     warnings: List[str] = []
+    strict_warnings: List[str] = []
     if not isinstance(ledger, dict) or not isinstance(
             ledger.get("tests"), dict):
         return (["ledger must be an object with a 'tests' mapping"],
@@ -68,11 +80,15 @@ def audit(ledger: dict, budget_s: float = DEFAULT_BUDGET_S):
     if "not slow" in markexpr:
         for nodeid, d in sorted(tests.items(), key=lambda kv: -kv[1]):
             if d > SLOW_MARK_S:
-                warnings.append(
+                msg = (
                     f"{nodeid} took {d:.1f}s inside a 'not slow' run "
                     f"(> {SLOW_MARK_S:.0f}s) — mark it "
                     f"@pytest.mark.slow"
                 )
+                warnings.append(msg)
+                if d > SLOW_MARK_S + noise_margin_s:
+                    strict_warnings.append(msg)
+    report["strict_warnings"] = strict_warnings
     return errors, warnings, report
 
 
@@ -85,6 +101,7 @@ def main(argv=None) -> int:
     top = 10
     as_json = False
     strict_slow = False
+    noise_margin = 0.0
     path = None
     it = iter(args)
     for a in it:
@@ -93,6 +110,12 @@ def main(argv=None) -> int:
                 budget_s = float(next(it))
             except (StopIteration, ValueError):
                 print("--budget-s wants a number (seconds)")
+                return 2
+        elif a == "--noise-margin":
+            try:
+                noise_margin = float(next(it))
+            except (StopIteration, ValueError):
+                print("--noise-margin wants a number (seconds)")
                 return 2
         elif a == "--top":
             try:
@@ -116,11 +139,12 @@ def main(argv=None) -> int:
     except (OSError, json.JSONDecodeError) as e:
         print(f"{path}: UNREADABLE — {e}")
         return 2
-    errors, warnings, report = audit(ledger, budget_s)
+    errors, warnings, report = audit(ledger, budget_s, noise_margin)
     if not report:
         print(f"{path}: INVALID — {errors[0]}")
         return 2
-    rc = 1 if errors or (strict_slow and warnings) else 0
+    rc = 1 if errors or (strict_slow
+                         and report["strict_warnings"]) else 0
     verdict = "OVER BUDGET" if errors else "OK"
     print(f"{path}: {verdict} — {report['tests']} tests, "
           f"projected {report['projected_s']}s of "

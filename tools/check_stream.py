@@ -31,6 +31,16 @@ exit 0 = every stream holds the contract; 1 = at least one violation;
 2 = input unreadable/malformed — a broken audit must be
 distinguishable from a broken stream (same convention as
 tools/check_bench.py / check_slo.py).
+
+``--sse`` audits the OTHER side of the wire: a JSONL capture of SSE
+frames as a socket consumer actually parsed them (one line per frame:
+``{"stream": key, "id": int, "event": kind, "data": {...}}`` — the
+shape serve/frontdoor.py `sse_request` returns, which the bench and
+the socket tests dump verbatim). The frames are mapped onto the same
+chunk-line schema (wire ``id`` IS the seq, ``data.start``/``tokens``
+are the offsets) and judged by the identical rules — the front door's
+claim is precisely that the wire consumer sees the in-process
+contract, so the wire capture must pass the in-process audit.
 """
 
 from __future__ import annotations
@@ -135,6 +145,32 @@ def stream_verdict(lines: List[dict]) -> Tuple[bool, dict]:
     return (len(streams) > 0 and not violations), report
 
 
+def sse_to_chunks(records: List[dict]) -> List[dict]:
+    """Captured SSE frames -> chunk-line schema, losslessly enough for
+    the audit: wire id -> seq, event name -> event, payload start/
+    token-count/status carried through. A frame whose ``data`` is not
+    an object (malformed payload on the wire) maps to a line with no
+    seq — audit_stream flags it rather than this converter hiding it."""
+    out: List[dict] = []
+    for rec in records:
+        data = rec.get("data")
+        if not isinstance(data, dict):
+            data = {}
+        key = (rec.get("stream")
+               or data.get("trace_id")
+               or f"rid:{rec.get('rid')}")
+        out.append({
+            "kind": "chunk",
+            "trace_id": key,
+            "seq": rec.get("id"),
+            "event": rec.get("event"),
+            "start": data.get("start", 0),
+            "n": len(data.get("tokens") or ()),
+            "status": data.get("status"),
+        })
+    return out
+
+
 def load_jsonl(path: str) -> List[dict]:
     out: List[dict] = []
     with open(path) as f:
@@ -176,11 +212,18 @@ def main(argv=None) -> int:
                     "no duplicate/missing tokens, one typed terminal "
                     "per stream)",
     )
-    p.add_argument("telemetry", help="telemetry JSONL path")
+    p.add_argument("telemetry", help="telemetry JSONL path (or, with "
+                                     "--sse, an SSE frame capture)")
+    p.add_argument("--sse", action="store_true",
+                   help="input is a wire-side SSE frame capture "
+                        "(frontdoor sse_request records), audited "
+                        "under the same exactly-once rules")
     p.add_argument("--json", action="store_true")
     args = p.parse_args(argv)
     try:
         lines = load_jsonl(args.telemetry)
+        if args.sse:
+            lines = sse_to_chunks(lines)
     except (OSError, ValueError) as e:
         print(f"UNREADABLE — {e}", file=sys.stderr)
         return UNREADABLE
