@@ -74,7 +74,19 @@ Layers (each its own module, composable and separately testable):
   frames (sse.py codec, shared by server and client), per-tenant
   admission at the door (admission.py token buckets + concurrency
   caps), auth/validation hooks, bounded-buffer slow-consumer shedding,
-  and a SIGTERM-shaped graceful drain.
+  and a SIGTERM-shaped graceful drain;
+- fairshare.py — the tenant QoS ledgers: VTC-style weighted-fair
+  service counters (least-served drives the scheduler's fair head
+  pick, most-over-served drives the door's "fairness" refusal — both
+  behind flags that degrade byte-identically to FIFO when off),
+  per-tenant cost metering (the /tenants endpoint + fleet federation),
+  and Jain's fairness index; slo.py's TenantSLORegistry gives each
+  tenant its own error budget so a hostile tenant's burn pages as ITS
+  alert and scopes the brown-out to ITS work;
+- workload.py  — deterministic multi-tenant workload plans (the QoS
+  lab): per-tenant Poisson/bursty/diurnal arrivals, heavy-tailed
+  lengths, multi-turn sessions, a hostile marker — JSON-serializable
+  and byte-replayable, judged offline by tools/check_qos.py.
 """
 
 from ddp_practice_tpu.serve.admission import (
@@ -82,6 +94,12 @@ from ddp_practice_tpu.serve.admission import (
     TenantPolicy,
 )
 
+from ddp_practice_tpu.serve.fairshare import (
+    TenantLedger,
+    VirtualTokenCounter,
+    federate_tenant_reports,
+    jains_index,
+)
 from ddp_practice_tpu.serve.engine import (
     EngineConfig,
     PagedEngine,
@@ -143,6 +161,7 @@ from ddp_practice_tpu.serve.slo import (
     FleetAlerts,
     SLOConfig,
     SLOWatchdog,
+    TenantSLORegistry,
 )
 from ddp_practice_tpu.serve.supervisor import (
     RemoteReplicaHandle,
@@ -151,6 +170,7 @@ from ddp_practice_tpu.serve.supervisor import (
     make_fleet_router,
 )
 from ddp_practice_tpu.serve.worker import WorkerSpec
+from ddp_practice_tpu.serve.workload import TenantSpec, WorkloadPlan
 
 __all__ = [
     "AdmissionController",
@@ -195,8 +215,15 @@ __all__ = [
     "SlotEngine",
     "Supervisor",
     "SupervisorConfig",
+    "TenantLedger",
     "TenantPolicy",
+    "TenantSLORegistry",
+    "TenantSpec",
+    "VirtualTokenCounter",
     "WorkerSpec",
+    "WorkloadPlan",
+    "federate_tenant_reports",
+    "jains_index",
     "make_fleet_router",
     "make_router",
     "sse_request",

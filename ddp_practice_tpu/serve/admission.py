@@ -54,17 +54,28 @@ class AdmissionController:
 
     def __init__(self, policies: Optional[Dict[str, TenantPolicy]] = None,
                  *, default: TenantPolicy = TenantPolicy(),
-                 clock=None) -> None:
+                 clock=None, vtc=None,
+                 fair_max_inflight: int = 0) -> None:
         self.policies = dict(policies or {})
         self.default = default
         self._clock = clock
+        # optional serve/fairshare.py VirtualTokenCounter + pressure
+        # threshold: with BOTH set, once total inflight reaches
+        # `fair_max_inflight` the door refuses the MOST-OVER-SERVED
+        # tenant's requests first (typed reason "fairness") — the VTC
+        # paper's admission half. Static rate/concurrency envelopes
+        # can't do this: they don't know who already ate the capacity.
+        self.vtc = vtc
+        self.fair_max_inflight = fair_max_inflight
         self._lock = threading.Lock()
         self._tokens: Dict[str, float] = {}     # bucket fill per tenant
         self._refill_at: Dict[str, float] = {}  # last refill timestamp
         self._inflight: Dict[str, int] = {}
         # cumulative per-reason refusal counts (the front door exports
         # these; kept here so a headless controller is still auditable)
-        self.refused: Dict[str, int] = {"rate": 0, "concurrency": 0}
+        self.refused: Dict[str, int] = {
+            "rate": 0, "concurrency": 0, "fairness": 0,
+        }
 
     def _now(self) -> float:
         return self._clock.now() if self._clock is not None \
@@ -75,12 +86,33 @@ class AdmissionController:
             return self.policies[tenant]
         return self.default
 
+    def _fairness_refuses(self, tenant: Optional[str]) -> bool:
+        """Under pressure (total inflight >= fair_max_inflight), refuse
+        the requester iff it is the MOST-OVER-SERVED tenant among those
+        competing (tenants currently inflight, plus itself). Needs at
+        least two competing tenants: with one there is no fairness
+        question, only capacity — the rate/concurrency envelopes' job.
+        Caller holds the lock."""
+        if self.vtc is None or self.fair_max_inflight <= 0:
+            return False
+        if sum(self._inflight.values()) < self.fair_max_inflight:
+            return False
+        competing = {k or None for k, n in self._inflight.items()
+                     if n > 0}
+        competing.add(tenant)
+        if len(competing) < 2:
+            return False
+        worst = self.vtc.most_over_served(competing)
+        return (worst or "") == (tenant or "")
+
     def try_acquire(self, tenant: Optional[str]
                     ) -> Tuple[bool, Optional[str]]:
-        """(admitted, refusal_reason). Reasons: "rate" (bucket empty)
-        or "concurrency" (cap reached). Checks concurrency FIRST so a
-        refused-over-cap tenant does not also burn a rate token for a
-        request that was never going to run."""
+        """(admitted, refusal_reason). Reasons: "fairness" (the
+        most-over-served tenant under pressure — see
+        `_fairness_refuses`), "rate" (bucket empty) or "concurrency"
+        (cap reached). Checks concurrency FIRST, then fairness, so a
+        refused tenant does not also burn a rate token for a request
+        that was never going to run."""
         pol = self.policy_for(tenant)
         key = tenant or ""
         with self._lock:
@@ -88,6 +120,9 @@ class AdmissionController:
                     and self._inflight.get(key, 0) >= pol.max_concurrent):
                 self.refused["concurrency"] += 1
                 return False, "concurrency"
+            if self._fairness_refuses(tenant):
+                self.refused["fairness"] += 1
+                return False, "fairness"
             if pol.rate_rps > 0:
                 now = self._now()
                 size = pol.bucket_size()
@@ -101,6 +136,10 @@ class AdmissionController:
                     return False, "rate"
                 self._tokens[key] = fill - 1.0
             self._inflight[key] = self._inflight.get(key, 0) + 1
+            if self.vtc is not None:
+                # register at the current service floor so the first
+                # fairness comparison sees this tenant at all
+                self.vtc.touch(tenant)
             return True, None
 
     def release(self, tenant: Optional[str]) -> None:

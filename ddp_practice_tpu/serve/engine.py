@@ -865,6 +865,15 @@ class PagedEngine(_EngineBase):
         self.preemptions = 0         # cumulative (metrics export)
         self.last_finite = np.ones((1, s), bool)
         self._slot_trace: dict = {}  # slot -> trace_id (tracer attached)
+        # replayable fork seeds: slot -> the request's SEED PATH — the
+        # admit seed plus one fork ordinal per ancestor fork, e.g.
+        # (seed,) for an admitted request, (seed, 2) for its second
+        # fork child. fork() derives the child key by folding the path,
+        # so a sibling's sample stream is a function of (admit seed,
+        # fork order) alone — replayable across slot layouts and
+        # independent of how many decode steps ran before the fork.
+        self._slot_seed: dict = {}
+        self._fork_n: dict = {}      # slot -> forks taken off it so far
         # speculative decoding (serve/spec.py): the host-side drafter
         # tracks every slot's context; its proposals feed step_verify.
         # Cumulative counters are the metrics-plane observable
@@ -1254,6 +1263,8 @@ class PagedEngine(_EngineBase):
                        pid=self.replica, tid=ENGINE_LANE, slot=slot,
                        blocks_free=self.blocks.num_free)
         self._slot_trace.pop(slot, None)
+        self._slot_seed.pop(slot, None)
+        self._fork_n.pop(slot, None)
 
     def take_preempted(self) -> list:
         """Slots preempted since the last drain (the scheduler calls
@@ -1376,6 +1387,8 @@ class PagedEngine(_EngineBase):
                            prompt_len=p, prefix_hit=matched,
                            chunk=chunk, slot=slot)
             self._keys = self._keys.at[slot].set(jax.random.PRNGKey(seed))
+            self._slot_seed[slot] = (seed,)
+            self._fork_n.pop(slot, None)
             return slot
         n_table = self._blocks_for(matched + w)
         try:
@@ -1446,6 +1459,8 @@ class PagedEngine(_EngineBase):
         # keyed by the REQUEST's seed alone, as in SlotEngine: placement
         # must stay invisible to the sample stream
         self._keys = self._keys.at[slot].set(jax.random.PRNGKey(seed))
+        self._slot_seed[slot] = (seed,)
+        self._fork_n.pop(slot, None)
         self._active[slot] = True
         if self.drafter is not None:
             # readmission after preemption passes prompt + salvaged
@@ -1547,11 +1562,19 @@ class PagedEngine(_EngineBase):
         context: the child references every parent block (refcounted)
         and carries the same pending logits under a fresh PRNG chain —
         n>1 parallel sampling per prompt for the price of the tail
-        blocks the siblings eventually split via copy-on-write. With no
-        explicit seed the child's chain is folded out of the parent's
-        CURRENT key, so siblings diverge by construction — a seed that
-        merely defaulted to the parent's admit seed would sample the
-        identical tokens."""
+        blocks the siblings eventually split via copy-on-write.
+
+        Child keys are REPLAYABLE: with no explicit seed the child's
+        chain is folded from the parent's seed path plus this fork's
+        ordinal — a pure function of (request seed, fork order), so
+        siblings diverge by construction AND a replay reproduces each
+        sibling's exact stream whatever slot the allocator hands out
+        and however many decode steps ran before the fork (the old
+        fold-from-current-key default was deterministic in-process but
+        changed with both). An explicit `seed=` starts a fresh chain —
+        the per-request knob the front door's n>1 sampling rides. A
+        slot with no recorded seed path (direct `_keys` manipulation in
+        tests) falls back to folding the parent's current key."""
         if not self._active[slot]:
             raise ValueError(f"slot {slot} is not active")
         child = self.allocator.alloc()
@@ -1571,8 +1594,20 @@ class PagedEngine(_EngineBase):
         self._topp[child] = self._topp[slot]
         self._seq[child] = self._admit_seq
         self._admit_seq += 1
-        key = (jax.random.PRNGKey(seed) if seed is not None
-               else jax.random.fold_in(self._keys[slot], child))
+        if seed is not None:
+            key = jax.random.PRNGKey(seed)
+            self._slot_seed[child] = (seed,)
+        else:
+            path = self._slot_seed.get(slot)
+            if path is not None:
+                self._fork_n[slot] = self._fork_n.get(slot, 0) + 1
+                path = path + (self._fork_n[slot],)
+                key = jax.random.PRNGKey(path[0])
+                for ordinal in path[1:]:
+                    key = jax.random.fold_in(key, ordinal)
+                self._slot_seed[child] = path
+            else:
+                key = jax.random.fold_in(self._keys[slot], child)
         self._last_logits, self._keys = self._fork_jit(
             self._last_logits, self._keys, jnp.int32(slot),
             jnp.int32(child), key,
@@ -1853,6 +1888,8 @@ class PagedEngine(_EngineBase):
         tests/test_kv_pages.py)."""
         self._clear_slot(slot)
         self._slot_trace.pop(slot, None)
+        self._slot_seed.pop(slot, None)
+        self._fork_n.pop(slot, None)
 
     def reset_epoch(self) -> None:
         """Interface parity with SlotEngine (the router calls this in

@@ -117,6 +117,12 @@ class RouterConfig:
     # least-loaded sort wherever digests are absent/cold, so fleets of
     # non-paged engines behave byte-identically to cache_aware=False.
     cache_aware: bool = True
+    # ---- weighted-fair service (serve/fairshare.py): when on,
+    # make_router threads one VirtualTokenCounter through every
+    # scheduler — queue heads go to the least-served tenant instead of
+    # strict FIFO. Off (default) no VTC exists anywhere on the path, so
+    # scheduling is byte-identical to the pre-fairness router.
+    fair: bool = False
 
 
 @dataclasses.dataclass
@@ -273,14 +279,22 @@ class ReplicaHandle:
         replica held — the failover harvest (Scheduler.evacuate)."""
         return self.scheduler.evacuate()
 
-    def shed_queued(self, min_priority: int) -> List[int]:
+    def shed_queued(self, min_priority: int,
+                    covers=None, tenants=None) -> List[int]:
         """Shed queued requests with priority >= min_priority (the
-        brown-out lever); returns their rids. The shed completions are
-        consumed HERE (watermark advanced): the router finalizes from
-        the returned rids, so replaying them from poll() would
-        double-book — worse, the rid may have been reused by then."""
+        brown-out lever); returns their rids. `covers` (tenant -> bool)
+        narrows the shed to the burning tenants' work — a tenant-scoped
+        brown-out must never pay a compliant tenant's requests for a
+        hostile tenant's burn. `tenants` is the remote seam's
+        serializable rendering of the same scope; the in-process handle
+        has the exact predicate, so it is ignored here. The shed
+        completions are consumed HERE
+        (watermark advanced): the router finalizes from the returned
+        rids, so replaying them from poll() would double-book — worse,
+        the rid may have been reused by then."""
         shed = self.scheduler.shed_queued(
             lambda r: r.priority >= min_priority
+            and (covers is None or covers(r.tenant))
         )
         self.consumed = len(self.scheduler.completions)
         return [r.rid for r in shed]
@@ -382,7 +396,7 @@ class Router:
                  config: RouterConfig = RouterConfig(),
                  metrics: Optional[RouterMetrics] = None,
                  tracer=None, slo=None, telemetry=None,
-                 policy=None) -> None:
+                 policy=None, vtc=None, ledger=None) -> None:
         """`schedulers` is the replica fleet: Scheduler objects (the
         in-process fleet — wrapped in ReplicaHandle here) and/or
         prebuilt handle objects implementing ReplicaHandle's replica
@@ -405,6 +419,16 @@ class Router:
         # on_completion): streams one "flight" line per finalization and
         # feeds the /flight rolling window
         self.telemetry = telemetry
+        # optional serve/fairshare.py pair: the VirtualTokenCounter the
+        # schedulers charge (kept here for introspection — /tenants,
+        # the bench's service report) and the TenantLedger fed one
+        # on_completion per finalization (cost metering)
+        self.vtc = vtc
+        self.ledger = ledger
+        # tenant scope of the CURRENT brown-out: None = global (pressure
+        # trip, or an slo= without per-tenant queries); a tuple of
+        # burning tenant names = shed/door-shed only their work
+        self._brownout_scope = None
         if tracer is not None:
             label_router(tracer)
         self.handles = []
@@ -531,7 +555,7 @@ class Router:
             # (never resubmit), "shed" invites a retry that can only fail
             self._finalize(self._track(req, budget), [], "rejected")
             return False
-        if self.brownout:
+        if self.brownout and self._brownout_covers(req.tenant):
             if req.priority >= cfg.shed_priority:
                 tr = self._track(req, budget)
                 # slo_exempt: this shed IS the brown-out response — if
@@ -965,6 +989,46 @@ class Router:
             self._requeue(tr, self.config.retry_base_s)
 
     # --------------------------------------------------------- brown-out
+    def _brownout_covers(self, tenant) -> bool:
+        """Whether the active brown-out applies to `tenant`'s work.
+        Global scope (pressure trip, or an slo= object without
+        per-tenant queries) covers everyone; an SLO-scoped brown-out
+        covers only the burning tenants — the compliant tenant keeps
+        its full budget and its queue slots."""
+        if self._brownout_scope is None:
+            return True
+        is_b = getattr(self.slo, "is_burning", None)
+        if is_b is None:
+            return True
+        return bool(is_b(tenant))
+
+    def _shed_brownout_queued(self, covers=None) -> None:
+        """Shed low-priority WAITERS too, not just new arrivals — the
+        queue backlog is exactly the overload being answered.
+        (shed_queued consumes its own sub-completions — replaying
+        them from poll() would double-book against whatever
+        request is tracked under the rid by then.)
+
+        Scoped sheds ride the seam twice: `covers` (the exact
+        registry-backed predicate, overflow fold included) for
+        in-process handles, and the raw scope NAMES for remote ones —
+        a callable cannot cross the RPC wire, so the worker matches
+        folded tenant names instead. The one divergence (an "other"
+        overflow scope names no raw tenant remotely) self-heals via
+        the escalation path."""
+        tenants = (None if covers is None
+                   else list(self._brownout_scope or ()))
+        for h in self._alive():
+            for rid in h.shed_queued(self.config.shed_priority,
+                                     covers=covers, tenants=tenants):
+                tr = self.tracked.get(rid)
+                if tr is not None and not tr.done:
+                    # slo_exempt: see submit() — the brown-out's own
+                    # sheds must not burn the SLO that drives it
+                    self._finalize(tr, list(tr.prefix), "shed",
+                                   slo_exempt=True)
+                    self.metrics.on_shed("brownout")
+
     def _update_brownout(self) -> None:
         """Brown-out has TWO triggers: fleet pressure (the PR-2
         occupancy heuristic) and SLO burn (serve/slo.py — pressure is a
@@ -972,7 +1036,15 @@ class Router:
         proxy stands for). Either engages it; disengage requires BOTH
         pressure under `brownout_off` and no active SLO alert — the
         pressure hysteresis band and the watchdog's trip/resolve
-        asymmetry compose, so neither trigger can flap the mode."""
+        asymmetry compose, so neither trigger can flap the mode.
+
+        An SLO-only trip against a TenantSLORegistry is TENANT-SCOPED:
+        only the burning tenants' low-priority work sheds (door and
+        queues) — per-tenant budgets exist precisely so a hostile
+        tenant's burn cannot cost the compliant tenant's requests. The
+        scope tracks the burning set while engaged and ESCALATES to
+        global if pressure later crosses `brownout_on` (overload is
+        everyone's problem, whoever caused it)."""
         cfg = self.config
         alive = self._alive()
         slots = sum(h.max_slots for h in alive)
@@ -980,37 +1052,54 @@ class Router:
         pressure = (work / slots) if slots else float("inf")
         self.metrics.fleet_pressure.set(min(pressure, 1e9))
         slo_burning = self.slo is not None and self.slo.active
+        traced = self.tracer is not None and self.tracer.enabled
+        burning_fn = getattr(self.slo, "burning_tenants", None)
         if not self.brownout and (pressure >= cfg.brownout_on
                                   or slo_burning):
             self.brownout = True
+            scope = None
+            if pressure < cfg.brownout_on and burning_fn is not None:
+                scope = tuple(burning_fn())
+            self._brownout_scope = scope
             self.metrics.brownout_active.set(1)
-            if self.tracer is not None and self.tracer.enabled:
+            if traced:
+                attrs = dict(pressure=round(pressure, 3),
+                             trigger=("pressure"
+                                      if pressure >= cfg.brownout_on
+                                      else "slo"))
+                if scope is not None:
+                    attrs["tenants"] = ",".join(scope)
                 self.tracer.instant("brownout_on", pid=ROUTER_PID,
-                                    pressure=round(pressure, 3),
-                                    trigger=("pressure"
-                                             if pressure >= cfg.brownout_on
-                                             else "slo"))
-            # shed low-priority WAITERS too, not just new arrivals — the
-            # queue backlog is exactly the overload being answered.
-            # (shed_queued consumes its own sub-completions — replaying
-            # them from poll() would double-book against whatever
-            # request is tracked under the rid by then.)
-            for h in alive:
-                for rid in h.shed_queued(cfg.shed_priority):
-                    tr = self.tracked.get(rid)
-                    if tr is not None and not tr.done:
-                        # slo_exempt: see submit() — the brown-out's own
-                        # sheds must not burn the SLO that drives it
-                        self._finalize(tr, list(tr.prefix), "shed",
-                                       slo_exempt=True)
-                        self.metrics.on_shed("brownout")
+                                    **attrs)
+            self._shed_brownout_queued(
+                None if scope is None else self._brownout_covers)
         elif self.brownout and pressure <= cfg.brownout_off \
                 and not slo_burning:
             self.brownout = False
+            self._brownout_scope = None
             self.metrics.brownout_active.set(0)
-            if self.tracer is not None and self.tracer.enabled:
+            if traced:
                 self.tracer.instant("brownout_off", pid=ROUTER_PID,
                                     pressure=round(pressure, 3))
+        elif self.brownout and self._brownout_scope is not None:
+            # engaged and tenant-scoped: keep the scope current
+            if pressure >= cfg.brownout_on:
+                # overload joined the party — escalate to global and
+                # shed the backlog the scoped pass left untouched
+                self._brownout_scope = None
+                if traced:
+                    self.tracer.instant("brownout_escalate",
+                                        pid=ROUTER_PID,
+                                        pressure=round(pressure, 3))
+                self._shed_brownout_queued(None)
+            elif burning_fn is not None:
+                now_burning = tuple(burning_fn())
+                newly = set(now_burning) - set(self._brownout_scope)
+                self._brownout_scope = now_burning
+                if newly:
+                    # a tenant that STARTED burning mid-brown-out gets
+                    # the same treatment the original offenders got
+                    self._shed_brownout_queued(self._brownout_covers)
 
     # ---------------------------------------------------------- finalize
     def _finalize(self, tr: _Tracked, tokens: List[int], status: str,
@@ -1081,6 +1170,11 @@ class Router:
         self.tracked.pop(req.rid, None)
         self.completions.append(c)
         self.metrics.on_finalize(c)
+        if self.ledger is not None:
+            # cost metering (serve/fairshare.py): one fold per terminal,
+            # prompt length from the request (the Completion doesn't
+            # carry the prompt), phases/prefix hits off the flight
+            self.ledger.on_completion(c, prompt_tokens=len(req.prompt))
         if self.telemetry is not None:
             # the exemption travels with the flight line, so the
             # offline verdict (tools/check_slo.py) reproduces the
@@ -1140,6 +1234,8 @@ def make_router(
     trace_sample: float = 1.0,
     trace_keep_slow_s: Optional[float] = None,
     trace_tenant_rates: Optional[dict] = None,
+    vtc=None,
+    ledger=None,
 ) -> Router:
     """Build a fleet of identical replicas (replicated params — the
     sharded-params variant is ROADMAP follow-up) on one shared clock,
@@ -1153,6 +1249,9 @@ def make_router(
     if n_replicas < 1:
         raise ValueError("n_replicas must be >= 1")
     clock = clock or MonotonicClock()
+    if config.fair and vtc is None:
+        from ddp_practice_tpu.serve.fairshare import VirtualTokenCounter
+        vtc = VirtualTokenCounter()
     if tracer is not None and (trace_sample < 1.0
                                or trace_keep_slow_s is not None
                                or trace_tenant_rates):
@@ -1173,10 +1272,10 @@ def make_router(
             engine, clock=clock, max_queue=max_queue,
             metrics=ServeMetrics(),
             fault_hook=fault_plan.injector(i) if fault_plan else None,
-            tracer=tracer, replica=i,
+            tracer=tracer, replica=i, vtc=vtc,
         ))
     return Router(
         schedulers, clock=clock, config=config,
         metrics=RouterMetrics(registry), tracer=tracer,
-        slo=slo, telemetry=telemetry,
+        slo=slo, telemetry=telemetry, vtc=vtc, ledger=ledger,
     )

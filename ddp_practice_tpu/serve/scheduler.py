@@ -257,7 +257,7 @@ class Scheduler:
     def __init__(self, engine: SlotEngine, *, clock=None, max_queue: int = 64,
                  metrics=None, fault_hook=None, tracer=None,
                  replica: int = 0, telemetry=None,
-                 stream: bool = True) -> None:
+                 stream: bool = True, vtc=None) -> None:
         self.engine = engine
         self.clock = clock or MonotonicClock()
         self.max_queue = max_queue
@@ -312,6 +312,12 @@ class Scheduler:
         # time}. The continuation re-prefills prompt+prefix; `_finish`
         # folds the prefix back so the client sees one completion.
         self._resume: Dict[int, dict] = {}
+        # optional serve/fairshare.py VirtualTokenCounter: when set,
+        # _admit serves the LEAST-SERVED tenant's earliest request
+        # instead of strict FIFO, and this scheduler charges the
+        # counters (prefill at admit, decode at finish). None (the
+        # default) leaves every code path byte-identical to FIFO.
+        self.vtc = vtc
 
     # ------------------------------------------------------------ intake
     def submit(self, req: Request) -> bool:
@@ -341,6 +347,11 @@ class Scheduler:
             self._finish(req, [], "shed")
             return False
         self.queue.append(req)
+        if self.vtc is not None:
+            # register at the current service floor (VTC lift) — a
+            # newly-seen tenant competes from here, not from an idle-
+            # hours credit balance
+            self.vtc.touch(req.tenant)
         if self.metrics:
             self.metrics.on_submit(self)
         return True
@@ -396,6 +407,12 @@ class Scheduler:
             # ever streamed is its preemption prefix (queued shed /
             # timeout / stale continuation) or nothing (fresh request)
             chunked = len(prior["prefix"]) if prior is not None else 0
+        if self.vtc is not None and tokens:
+            # decode service lands at the terminal: each DELIVERED token
+            # charges once, whatever preemption/readmission path
+            # produced it (re-prefill work was charged as prefill at
+            # each admit — both costs were actually incurred)
+            self.vtc.charge(req.tenant, decode=len(tokens))
         # the terminal marker: whatever tokens have not streamed yet
         # ride out with it, so chunk delivery is complete exactly when
         # the completion exists (one final chunk per completion, even
@@ -426,6 +443,10 @@ class Scheduler:
             # token count, not a latency phase — same placement rule as
             # the spec_* tallies above
             flight["prefix_hit_tokens"] = ph
+        # prompt size rides the flight record so downstream cost
+        # metering (serve/fairshare.py TenantLedger) can bill prefill
+        # work without a back-pointer to the request
+        flight["prompt_tokens"] = len(req.prompt)
         c = Completion(
             rid=req.rid, tokens=tokens, status=status,
             arrival=req.arrival, finish=now, ttft=ttft, tpot=tpot,
@@ -601,10 +622,36 @@ class Scheduler:
             needed += self._spec_k + 1
         return needed
 
+    def _rotate_fair_head(self) -> None:
+        """Weighted-fair head pick (serve/fairshare.py, vtc set):
+        rotate the LEAST-SERVED tenant's earliest request to the queue
+        head. Within a tenant order stays FIFO; a tie on service breaks
+        toward the earlier queue position, so equal-service tenants
+        degrade to plain arrival order. Everything downstream —
+        admission gates, preemption, the insert(1) staging — still
+        operates on the head, unchanged. No-op without a vtc: the
+        default path stays byte-identical to FIFO."""
+        if self.vtc is None or len(self.queue) <= 1:
+            return
+        firsts: Dict[str, int] = {}
+        for i, r in enumerate(self.queue):
+            name = r.tenant if r.tenant is not None else "default"
+            if name not in firsts:
+                firsts[name] = i
+        if len(firsts) <= 1:
+            return
+        i = min(firsts.items(),
+                key=lambda kv: (self.vtc.service(kv[0]), kv[1]))[1]
+        if i:
+            req = self.queue[i]
+            del self.queue[i]
+            self.queue.appendleft(req)
+
     def _admit(self) -> None:
         eng = self.engine
         tr = self.tracer
         while self.queue and eng.num_free > 0:
+            self._rotate_fair_head()
             req = self.queue[0]
             needed = self._needed_positions(req.max_new_tokens)
             # memory policy is the ENGINE's: the slot engine gates on
@@ -710,6 +757,12 @@ class Scheduler:
                 self._prefix_hits[req.rid] = (
                     self._prefix_hits.get(req.rid, 0) + hit
                 )
+            if self.vtc is not None:
+                # prefill service at admit (cache-warm tokens are free:
+                # the engine never recomputed them) — immediate, so the
+                # NEXT head pick already sees this tenant's spend
+                self.vtc.charge(req.tenant, prefill=max(
+                    0, len(req.prompt) - (hit or 0)))
             if tr is not None and tr.enabled:
                 sub = req.submitted if req.submitted is not None \
                     else req.arrival

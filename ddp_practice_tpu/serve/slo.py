@@ -173,7 +173,8 @@ class SLOWatchdog:
 
     def __init__(self, config: SLOConfig, *, clock=None,
                  registry=None, tracer=None, telemetry=None,
-                 sinks=None, pid: int = ROUTER_PID) -> None:
+                 sinks=None, pid: int = ROUTER_PID,
+                 tenant: Optional[str] = None) -> None:
         self.config = config
         self.budgets = config.objectives()
         self.tracer = tracer
@@ -183,6 +184,11 @@ class SLOWatchdog:
         # (command/webhook/jsonl); evaluate() drives the retry backoff
         self.sinks = sinks
         self.pid = pid
+        # tenant-scoped watchdog (TenantSLORegistry member): gauges and
+        # edge events carry the tenant label; None keeps the single-
+        # watchdog surface byte-identical to before the registry existed
+        self.tenant = tenant
+        self._labels = {} if tenant is None else {"tenant": tenant}
         # default time source when a caller omits `now`/`t` (the router
         # always passes its own clock reading explicitly — same domain)
         self._now = _resolve_clock(clock)
@@ -282,12 +288,15 @@ class SLOWatchdog:
             if self.registry is not None:
                 self.registry.gauge(labelled(
                     "slo_burn_rate", objective=objective, window="fast",
+                    **self._labels,
                 )).set(fast)
                 self.registry.gauge(labelled(
                     "slo_burn_rate", objective=objective, window="slow",
+                    **self._labels,
                 )).set(slow)
                 self.registry.gauge(labelled(
                     "slo_alert_active", objective=objective,
+                    **self._labels,
                 )).set(float(active))
         if self.sinks is not None:
             # both paths flush: a backed-off retry must come due even
@@ -302,22 +311,30 @@ class SLOWatchdog:
         self.alert_log.append((now, edge, objective))
         if edge == "trip" and self._alerts_ctr is not None:
             self._alerts_ctr.inc()
+            if self.tenant is not None and self.registry is not None:
+                # per-tenant attribution ALONGSIDE the shared total: a
+                # fleet dashboard sums one series, a tenant page reads
+                # its own
+                self.registry.counter(labelled(
+                    "slo_alerts_total", tenant=self.tenant,
+                )).inc()
         if self.tracer is not None and self.tracer.enabled:
             self.tracer.instant(
                 f"slo_{edge}" if edge == "resolve" else "slo_alert",
                 pid=self.pid, objective=objective,
                 burn_fast=round(fast, 3), burn_slow=round(slow, 3),
+                **self._labels,
             )
         if self.telemetry is not None:
             self.telemetry.emit(
                 "alert", event=edge, objective=objective,
-                burn_fast=fast, burn_slow=slow,
+                burn_fast=fast, burn_slow=slow, **self._labels,
             )
         if self.sinks is not None:
             self.sinks.send({
                 "kind": "alert", "t": now, "scope": "slo",
                 "event": edge, "objective": objective,
-                "burn_fast": fast, "burn_slow": slow,
+                "burn_fast": fast, "burn_slow": slow, **self._labels,
             })
 
     @property
@@ -344,6 +361,131 @@ class SLOWatchdog:
             "active": self.active,
             "resolved": (not self.active
                          and slow <= self.config.resolve_burn),
+        }
+
+
+class TenantSLORegistry:
+    """Keyed SLO watchdogs: one error budget per tenant.
+
+    A single fleet-wide watchdog averages a hostile tenant's burn into
+    everyone's, so the tenant being starved never pages and the tenant
+    doing the starving never stands out. This registry gives each
+    tenant its own `SLOWatchdog` (lazily, keyed off `Request.tenant`,
+    None folding to the shared "default" tenant), each with its own
+    windows, alert edges, and ``slo_burn_rate{tenant,objective}``
+    gauges.
+
+    It presents the SAME surface the router consumes from a single
+    watchdog — `observe` / `evaluate` / `active` / `burn_signal` — so
+    `Router(slo=...)` takes either interchangeably, plus the
+    tenant-scoped queries the brown-out needs to shed ONLY the burning
+    tenant's work: `is_burning(tenant)` and `burning_tenants()`.
+
+    Cardinality is bounded like the metric label guard: past
+    `max_tenants` distinct tenants, newcomers share one "other"
+    watchdog (an unbounded hostile tenant-id space must not mint
+    unbounded deques and gauge families). Per-tenant objective
+    overrides ride `overrides` (e.g. a batch tenant with a relaxed
+    TTFT target).
+    """
+
+    OVERFLOW = "other"
+    DEFAULT_TENANT = "default"
+
+    def __init__(self, config: SLOConfig, *, clock=None, registry=None,
+                 tracer=None, telemetry=None, sinks=None,
+                 pid: int = ROUTER_PID, max_tenants: int = 64,
+                 overrides: Optional[Dict[str, SLOConfig]] = None) -> None:
+        self.config = SLOConfig.from_json(config)
+        self.overrides = {
+            name: SLOConfig.from_json(cfg)
+            for name, cfg in (overrides or {}).items()
+        }
+        self.max_tenants = max_tenants
+        self._deps = dict(clock=clock, registry=registry, tracer=tracer,
+                          telemetry=telemetry, sinks=sinks, pid=pid)
+        self._dogs: Dict[str, SLOWatchdog] = {}
+
+    def _name(self, tenant: Optional[str]) -> str:
+        return tenant if tenant is not None else self.DEFAULT_TENANT
+
+    def _key(self, tenant: Optional[str]) -> str:
+        name = self._name(tenant)
+        if name in self._dogs or len(self._dogs) < self.max_tenants:
+            return name
+        return self.OVERFLOW
+
+    def watchdog(self, tenant: Optional[str]) -> SLOWatchdog:
+        """The tenant's watchdog, created on first sight (or the shared
+        overflow dog past the cap)."""
+        name = self._key(tenant)
+        dog = self._dogs.get(name)
+        if dog is None:
+            cfg = self.overrides.get(name, self.config)
+            dog = SLOWatchdog(cfg, tenant=name, **self._deps)
+            self._dogs[name] = dog
+        return dog
+
+    # ------------------------------------------------------------ intake
+    def observe(self, completion) -> None:
+        self.watchdog(getattr(completion, "tenant", None)).observe(
+            completion)
+
+    def observe_event(self, *, tenant: Optional[str] = None,
+                      **kw) -> None:
+        self.watchdog(tenant).observe_event(**kw)
+
+    # -------------------------------------------------------- evaluation
+    def evaluate(self, now: Optional[float] = None,
+                 force: bool = False) -> Dict[str, dict]:
+        """Evaluate every tenant's watchdog; returns
+        {tenant: per-objective report}."""
+        return {name: dog.evaluate(now, force)
+                for name, dog in self._dogs.items()}
+
+    @property
+    def active(self) -> bool:
+        return any(dog.active for dog in self._dogs.values())
+
+    def is_burning(self, tenant: Optional[str]) -> bool:
+        """Whether THIS tenant's budget is alerting — maps through the
+        overflow fold (an over-cap tenant answers for the shared
+        "other" dog, the price of bounded cardinality) but never
+        creates a watchdog."""
+        name = self._name(tenant)
+        dog = self._dogs.get(name)
+        if dog is None and len(self._dogs) >= self.max_tenants:
+            dog = self._dogs.get(self.OVERFLOW)
+        return dog is not None and dog.active
+
+    def burning_tenants(self) -> List[str]:
+        """Tenant names with an active alert — the brown-out's shed
+        scope (sorted: deterministic trace attrs and tests)."""
+        return sorted(
+            name for name, dog in self._dogs.items() if dog.active
+        )
+
+    @property
+    def alert_log(self) -> List[Tuple[float, str, str, str]]:
+        """Merged (t, edge, objective, tenant) history, time-ordered."""
+        out = [
+            (t, edge, objective, name)
+            for name, dog in self._dogs.items()
+            for (t, edge, objective) in dog.alert_log
+        ]
+        out.sort(key=lambda e: e[0])
+        return out
+
+    def burn_signal(self) -> dict:
+        """The autoscaler's view: WORST burn across tenants (capacity
+        decisions answer the most-burning budget), resolved only when
+        every tenant's slow window has settled."""
+        sigs = [dog.burn_signal() for dog in self._dogs.values()]
+        return {
+            "burn_fast": max((s["burn_fast"] for s in sigs), default=0.0),
+            "burn_slow": max((s["burn_slow"] for s in sigs), default=0.0),
+            "active": self.active,
+            "resolved": all(s["resolved"] for s in sigs),
         }
 
 

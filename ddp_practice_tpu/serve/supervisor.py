@@ -1139,12 +1139,18 @@ class RemoteReplicaHandle:
         self._unconfirmed.clear()  # salvage owns the rids now
         return out
 
-    def shed_queued(self, min_priority: int) -> List[int]:
+    def shed_queued(self, min_priority: int,
+                    covers=None, tenants=None) -> List[int]:
+        """`covers` (a callable) cannot cross the wire — the remote
+        form of a tenant-scoped shed is the `tenants` name list, which
+        the worker matches against folded tenant labels. None = shed
+        every priority-eligible waiter (the global brown-out)."""
         c = self._client()
         if c is None:
             return []
         try:
-            r = c.call("shed", min_priority=min_priority)
+            kw = {} if tenants is None else {"tenants": list(tenants)}
+            r = c.call("shed", min_priority=min_priority, **kw)
         except (RpcError, RpcRemoteError):
             self._broken = True
             return []
@@ -1299,6 +1305,7 @@ def make_fleet_router(
     tracer=None,
     slo=None,
     telemetry=None,
+    ledger=None,
     heartbeat_timeout_s: float = 2.0,
     spawn_fn: Optional[Callable] = None,
 ):
@@ -1358,7 +1365,7 @@ def make_fleet_router(
     router = Router(
         handles, clock=clock, config=config or RouterConfig(),
         metrics=RouterMetrics(registry), tracer=tracer,
-        slo=slo, telemetry=telemetry,
+        slo=slo, telemetry=telemetry, ledger=ledger,
     )
     router.trace_collector = collector
     return router, supervisor, handles

@@ -98,6 +98,12 @@ class WorkerSpec:
     # meaningful for paged workers (the SlotEngine refuses it).
     spec_decode: bool = False
     spec_k: int = 4
+    # weighted-fair scheduling (serve/fairshare.py): the worker builds
+    # its own VirtualTokenCounter + TenantLedger, the scheduler picks
+    # the least-served tenant's queue head, and /tenants serves the
+    # per-tenant cost rollup. Off = byte-identical FIFO (no VTC
+    # exists) — the same contract as RouterConfig.fair in-process.
+    fair: bool = False
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self))
@@ -108,6 +114,19 @@ class WorkerSpec:
 
 
 READY_PREFIX = "WORKER_READY "
+
+
+class _TelemetryFanout:
+    """Scheduler takes ONE telemetry object; a fair worker needs two
+    sinks per completion (FlightStats window + TenantLedger billing).
+    Tiny fan-out instead of widening the scheduler seam."""
+
+    def __init__(self, *sinks) -> None:
+        self.sinks = sinks
+
+    def on_completion(self, completion, **kw) -> None:
+        for s in self.sinks:
+            s.on_completion(completion, **kw)
 
 
 class _TraceBuffer:
@@ -209,11 +228,23 @@ class WorkerServer:
             self._digest = None
         self.registry = MetricsRegistry()
         self.flight = FlightStats()
+        self.ledger = None
+        vtc = None
+        if spec.fair:
+            from ddp_practice_tpu.serve.fairshare import (
+                TenantLedger,
+                VirtualTokenCounter,
+            )
+
+            vtc = VirtualTokenCounter()
+            self.ledger = TenantLedger(registry=self.registry, vtc=vtc)
         self.scheduler = Scheduler(
             self.engine, max_queue=spec.max_queue,
             metrics=ServeMetrics(self.registry),
-            telemetry=self.flight, replica=spec.replica,
-            stream=spec.stream,
+            telemetry=(self.flight if self.ledger is None
+                       else _TelemetryFanout(self.flight, self.ledger)),
+            replica=spec.replica,
+            stream=spec.stream, vtc=vtc,
         )
         # two-lock discipline so the RPC plane NEVER waits out a decode
         # burst: `_lock` (the big one) serializes scheduler/engine
@@ -293,6 +324,8 @@ class WorkerServer:
             registry=self.registry,
             health_fn=lambda: {spec.replica: "healthy"},
             flight_fn=self.flight.report,
+            tenants_fn=(self.ledger.report
+                        if self.ledger is not None else None),
             port=spec.telemetry_port,
         )
         self.rpc = RpcServer({
@@ -680,11 +713,21 @@ class WorkerServer:
 
     def _op_shed(self, req: dict) -> dict:
         min_priority = int(req["min_priority"])
+        # tenant-scoped brown-out (serve/router.py): a name list rides
+        # the wire in place of the router's exact covers-predicate;
+        # None/absent = global shed
+        tenants = req.get("tenants")
+        scope = None if tenants is None else {
+            (t if t else "default") for t in tenants
+        }
         with self._lock:
             # intake items are queued-but-not-drained: shed sees them too
             self._drain_intake_locked()
             shed = self.scheduler.shed_queued(
                 lambda r: r.priority >= min_priority
+                and (scope is None
+                     or (r.tenant if r.tenant is not None
+                         else "default") in scope)
             )
             self._publish()
             return {"rids": [r.rid for r in shed]}

@@ -356,6 +356,7 @@ class TelemetryServer:
                  health_fn: Optional[Callable[[], Dict]] = None,
                  flight_fn: Optional[Callable[[], dict]] = None,
                  healthz_fn: Optional[Callable[[], dict]] = None,
+                 tenants_fn: Optional[Callable[[], dict]] = None,
                  port: int = 0, host: str = "127.0.0.1",
                  start: bool = True) -> None:
         # `registry` is duck-typed: anything with render_text() serves
@@ -364,10 +365,14 @@ class TelemetryServer:
         # /healthz body (the federated shape carries per-worker
         # heartbeat ages — richer than health_fn's flat state map);
         # the 503-on-DEAD contract is keyed off its "status" field.
+        # `tenants_fn` serves the per-tenant QoS rollup (a
+        # serve/fairshare.py TenantLedger.report, or a federator's
+        # tenants() for the fleet view).
         self.registry = registry
         self.health_fn = health_fn
         self.flight_fn = flight_fn
         self.healthz_fn = healthz_fn
+        self.tenants_fn = tenants_fn
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -415,6 +420,9 @@ class TelemetryServer:
                     "application/json")
         if path == "/flight":
             report = self.flight_fn() if self.flight_fn else {}
+            return json.dumps(report).encode(), 200, "application/json"
+        if path == "/tenants":
+            report = self.tenants_fn() if self.tenants_fn else {}
             return json.dumps(report).encode(), 200, "application/json"
         return b"not found", 404, "text/plain"
 
@@ -1076,6 +1084,41 @@ class ScrapeFederator:
         if exemplars:
             fleet["exemplars"] = exemplars
         return {"fleet": fleet, "workers": workers}
+
+    # -------------------------------------------------- /tenants rollup
+    def tenants(self) -> dict:
+        """Fleet-wide per-tenant QoS rollup: every worker's /tenants
+        body folded through serve/fairshare.federate_tenant_reports —
+        counters summed, raw latency tails pooled and re-summarized
+        (the /flight rule: never percentiles of percentiles), shares
+        and Jain's index re-derived over the SUMMED service. Dead
+        workers are absent; the rollup is over who answered."""
+        from ddp_practice_tpu.serve.fairshare import (
+            federate_tenant_reports,
+        )
+
+        targets = self.targets_fn()
+        scraped = self._get_many(targets, "/tenants")
+        reports = []
+        workers: Dict[str, dict] = {}
+        for wid in sorted(targets):
+            body = scraped.get(wid)
+            if body is None:
+                continue
+            try:
+                rep = json.loads(body)
+            except ValueError:
+                continue
+            if rep:
+                reports.append(rep)
+                workers[str(wid)] = {
+                    "tenants": sorted((rep.get("tenants") or {})),
+                    "fairness_index": rep.get("fairness_index"),
+                }
+        out = federate_tenant_reports(reports)
+        out["fleet"] = True
+        out["workers"] = workers
+        return out
 
     # ------------------------------------------------ /healthz verdict
     def healthz(self) -> dict:

@@ -202,6 +202,37 @@ def test_door_refusals_are_typed_json(stub_door):
 
 
 @pytest.mark.net
+def test_door_fairness_refusal_is_typed_429():
+    """The weighted-fair gate at the door (serve/fairshare.py VTC +
+    fair_max_inflight): under pressure the MOST-over-served tenant's
+    request bounces as a typed 429 "fairness" before it costs a queue
+    slot; the starved tenant's identical request still 503s PAST
+    admission (no replica) — the refusal is tenant-shaped, not load-
+    shaped."""
+    from ddp_practice_tpu.serve.fairshare import VirtualTokenCounter
+
+    vtc = VirtualTokenCounter()
+    vtc.charge("bulk", decode=100)
+    vtc.touch("acme")
+    adm = AdmissionController(vtc=vtc, fair_max_inflight=2)
+    fd = Frontdoor(_StubRouter(), config=FrontdoorConfig(),
+                   admission=adm, metrics=FrontdoorMetrics())
+    fd.start()
+    try:
+        for t in ("bulk", "acme"):   # reach the pressure threshold
+            assert adm.try_acquire(t) == (True, None)
+        status, ev = sse_request(
+            "127.0.0.1", fd.port, {"prompt": [1], "tenant": "bulk"})
+        assert status == 429 and ev[0]["data"]["reason"] == "fairness"
+        status, ev = sse_request(
+            "127.0.0.1", fd.port, {"prompt": [1], "tenant": "acme"})
+        assert status != 429    # admitted; fails later for other reasons
+        assert adm.refused["fairness"] == 1
+    finally:
+        fd.close()
+
+
+@pytest.mark.net
 def test_healthz_and_drain_refusal(stub_door):
     fd, _ = stub_door
     conn = http.client.HTTPConnection("127.0.0.1", fd.port, timeout=10)

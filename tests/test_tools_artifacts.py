@@ -388,6 +388,20 @@ def test_check_bench_exit_codes_both_ways(tmp_path):
     assert "cache_routing_100rps.goodput_ratio" in r.stdout
     assert "cache_routing_100rps.lost" in r.stdout
     assert "cache_routing_100rps.token_identity" in r.stdout
+    # the ISSUE-19 tenant-QoS gates regress in the same ledger: a
+    # FIFO-grade fairness index, an isolation ratio past the 0.7x
+    # acceptance bound (gated as the 0/1 isolation_ok verdict), a
+    # silent hostile alert next to a paging compliant tenant, a
+    # diverged stream in each arm, and lost work under SIGKILL — the
+    # 0/1 contracts are absolute, so every planted value must fail
+    assert "qos_mixed_tenants_100rps.isolation_ok" in r.stdout
+    assert "qos_mixed_tenants_100rps.fairness_index" in r.stdout
+    assert "qos_mixed_tenants_100rps.hostile_alert_tripped" in r.stdout
+    assert "qos_mixed_tenants_100rps.compliant_clean" in r.stdout
+    assert "qos_mixed_tenants_100rps.token_identity" in r.stdout
+    assert "qos_mixed_tenants_100rps.sigkill.check_qos_ok" in r.stdout
+    assert "qos_mixed_tenants_100rps.sigkill.trace_ok" in r.stdout
+    assert "qos_mixed_tenants_100rps.sigkill_lost" in r.stdout
     # unreadable input is exit 2, not a fake verdict
     garbage = tmp_path / "garbage.json"
     garbage.write_text("{broken")
@@ -636,6 +650,157 @@ def test_check_durations_exit_codes(tmp_path):
     notledger.write_text('{"tests": "oops"}')
     assert _run("tools/check_durations.py",
                 str(notledger)).returncode == 2
+
+
+# ------------------------------------ ISSUE 19: tenant QoS artifacts
+# the qos bench's SIGKILL leg (fair fleet x2, hostile "bulk" flooding
+# compliant "acme", one worker SIGKILLed mid-run), slimmed to the
+# record kinds check_qos judges (flight/alert/instant — chunk and
+# metrics-dump lines stripped for size); _bad is the same file with
+# the burn-alert edge reattributed to the compliant tenant, which
+# breaks BOTH isolation claims at once (a compliant trip appears, the
+# hostile trip vanishes)
+QOS_TELEMETRY = os.path.join(ROOT, "tests", "data",
+                             "qos_telemetry.jsonl")
+QOS_TELEMETRY_BAD = os.path.join(ROOT, "tests", "data",
+                                 "qos_telemetry_bad.jsonl")
+# federated snapshots with the /tenants rollup riding next to healthz:
+# _ok is a near-even two-tenant split, _bad a starved tenant (Jain
+# ~0.51) on an otherwise HEALTHY fleet — only --min-fairness pages it
+QOS_FLEET_OK = os.path.join(ROOT, "tests", "data",
+                            "fleet_healthz_qos_ok.json")
+QOS_FLEET_BAD = os.path.join(ROOT, "tests", "data",
+                             "fleet_healthz_qos_bad.json")
+# the failure budget the artifact run was recorded against: 5x the
+# steady-state 0.5s TTFT target, because a mid-run worker SIGKILL
+# makes the steady-state budget unmeetable by ANY scheduler (see
+# serve/bench.py qos_bench)
+QOS_SLO = json.dumps({"ttft_p99_s": 2.5, "fast_window_s": 0.5,
+                      "slow_window_s": 1.0})
+
+
+def test_check_qos_exit_codes_both_ways(tmp_path):
+    """ISSUE-19 satellite: the per-tenant verdict pinned through the
+    real CLI over the checked-in SIGKILL-leg telemetry. exit 0 = every
+    isolation claim held, 1 = a claim broke, 2 = unreadable input."""
+    r = _run("tools/check_qos.py", "--slo", QOS_SLO, "--hostile",
+             "bulk", "--min-fairness", "0.9", "--expect-hostile-trip",
+             QOS_TELEMETRY)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert ": OK" in r.stdout
+    assert "[hostile]" in r.stdout
+    assert "violated (hostile, not judged)" in r.stdout
+    # the corrupted copy fails BOTH isolation claims, by name
+    r = _run("tools/check_qos.py", "--slo", QOS_SLO, "--hostile",
+             "bulk", "--min-fairness", "0.9", "--expect-hostile-trip",
+             QOS_TELEMETRY_BAD)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "QOS VIOLATED" in r.stdout
+    assert "alert trip(s) on a compliant tenant" in r.stdout
+    assert "no hostile tenant tripped" in r.stdout
+    # without the hostile exemption the flooder's own pain pages too
+    r = _run("tools/check_qos.py", "--slo", QOS_SLO, QOS_TELEMETRY)
+    assert r.returncode == 1
+    assert "violated ttft_p99" in r.stdout
+    # unreadable input / bad --slo are exit 2, not a fake verdict
+    assert _run("tools/check_qos.py", "--slo", QOS_SLO,
+                str(tmp_path / "missing.jsonl")).returncode == 2
+    assert _run("tools/check_qos.py", "--slo", "{not json",
+                QOS_TELEMETRY).returncode == 2
+    # --json carries the per-tenant reports + fairness
+    r = _run("tools/check_qos.py", "--slo", QOS_SLO, "--hostile",
+             "bulk", "--json", QOS_TELEMETRY)
+    assert r.returncode == 0
+    rep = json.loads(r.stdout)[QOS_TELEMETRY]
+    assert rep["ok"] is True
+    assert rep["fairness_index"] >= 0.9
+    assert rep["tenants"]["bulk"]["hostile"] is True
+    assert rep["tenants"]["acme"]["trips"] == 0
+
+
+def test_check_qos_as_library():
+    """qos_report() is the seam the bench's SIGKILL leg calls
+    in-process — pinned on the same artifact the CLI sees, including
+    the contended-window rule that makes the fairness number mean
+    something (a drained run delivers everyone's totals eventually;
+    only tokens finished before the last arrival show who was served
+    during the fight)."""
+    from ddp_practice_tpu.serve.slo import SLOConfig
+    from tools.check_qos import qos_report
+    from tools.check_slo import load_events
+
+    records, truncated = load_events(QOS_TELEMETRY)
+    assert not truncated
+    rep = qos_report(records, SLOConfig.from_json(QOS_SLO),
+                     hostile=["bulk"], min_fairness=0.9,
+                     expect_hostile_trip=True)
+    assert rep["ok"], rep["problems"]
+    # the window bound bites: the flooder's full token count is far
+    # larger than what it got during the contended window, and the
+    # fairness verdict is computed over the latter
+    bulk = rep["tenants"]["bulk"]
+    assert bulk["window_tokens"] < bulk["output_tokens"]
+    assert rep["service_tokens"]["bulk"] == bulk["window_tokens"]
+    # per-tenant trips come from the live registry's attributed alert
+    # edges in the stream, not offline recomputation
+    assert bulk["trips"] == 1
+    assert rep["tenants"]["acme"]["trips"] == 0
+    # no flights at all is unreadable-grade, not an empty pass
+    try:
+        qos_report([], SLOConfig.from_json(QOS_SLO))
+        assert False, "expected ValueError"
+    except ValueError:
+        pass
+
+
+def test_check_fleet_qos_exit_codes_both_ways():
+    """ISSUE-19 satellite: the federated /tenants rollup rendered and
+    judged. Without --min-fairness the rollup is a VIEW (the starved
+    snapshot still exits 0 — every worker is healthy); with it, a
+    collapsed Jain's index pages even though no worker is sick,
+    because a starved tenant is an outage for THAT tenant."""
+    r = _run("tools/check_fleet.py", QOS_FLEET_OK)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "tenants (fleet rollup, fairness index" in r.stdout
+    assert "acme" in r.stdout and "bulk" in r.stdout
+    assert "ttft p99" in r.stdout
+    r = _run("tools/check_fleet.py", QOS_FLEET_BAD)
+    assert r.returncode == 0, r.stdout + r.stderr  # view only
+    r = _run("tools/check_fleet.py", "--min-fairness", "0.9",
+             QOS_FLEET_OK)
+    assert r.returncode == 0, r.stdout + r.stderr
+    r = _run("tools/check_fleet.py", "--min-fairness", "0.9",
+             QOS_FLEET_BAD)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "FLEET UNHEALTHY" in r.stdout
+    assert "most-starved tenant: acme" in r.stdout
+    # asking for the fairness judgment on a fleet that publishes no
+    # rollup is a misconfigured probe, not a silent pass
+    r = _run("tools/check_fleet.py", "--min-fairness", "0.9", FLEET_OK)
+    assert r.returncode == 1
+    assert "no /tenants rollup" in r.stdout
+    # --json carries the rollup summary for machine consumers
+    r = _run("tools/check_fleet.py", "--json", QOS_FLEET_BAD)
+    assert r.returncode == 0
+    rep = json.loads(r.stdout)[QOS_FLEET_BAD]
+    assert rep["tenants"]["names"] == ["acme", "bulk"]
+    assert rep["tenants"]["fairness_index"] < 0.6
+
+
+def test_check_fleet_qos_verdict_as_library():
+    from tools.check_fleet import load_snapshot_doc, tenant_problems
+
+    _hz, _fl, tenants = load_snapshot_doc(QOS_FLEET_OK)
+    assert tenant_problems(tenants, 0.9) == []
+    assert tenant_problems(tenants, 0.0) == []  # 0 disables
+    _hz, _fl, bad = load_snapshot_doc(QOS_FLEET_BAD)
+    probs = tenant_problems(bad, 0.9)
+    assert probs and "most-starved tenant: acme" in probs[0]
+    assert tenant_problems(None, 0.9)  # no rollup + gate = problem
+    # the rollup's pooled percentiles federate per the /flight rule —
+    # the snapshot's p99 must come from the pooled samples, never a
+    # percentile of percentiles
+    assert tenants["tenants"]["acme"]["ttft_s"]["p99"] > 0
 
 
 def test_check_stream_as_library():

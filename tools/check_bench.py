@@ -172,6 +172,39 @@ DEFAULT_GATES: Dict[str, dict] = {
         {"direction": "lower", "tol": 0.0},
     "frontdoor_100rps.sampling_new_compiles":
         {"direction": "lower", "tol": 0.0},
+    # tenant QoS plane (ISSUE 19): weighted-fair scheduling must keep
+    # the compliant tenant's TTFT p99 well under FIFO's during a
+    # hostile flood — the raw ratio sits near 0.03x and jitters 2x
+    # run-to-run, so the gated form is the 0/1 verdict against the
+    # <= 0.7x acceptance bound (isolation_ok), not the ratio itself.
+    # Jain's index over the contended window must stay near its
+    # >= 0.9 floor, and the rest are absolute CONTRACTS: fair
+    # scheduling reorders WHO decodes next but never WHAT a greedy
+    # request produces (token_identity 1.0 vs the FIFO arm, tol 0),
+    # nothing lost, the hostile tenant's per-tenant burn alert trips
+    # while the compliant tenant's stays silent, and the SIGKILL leg
+    # keeps all of it (offline check_qos verdict + merged fleet trace
+    # both green)
+    "qos_mixed_tenants_100rps.isolation_ok":
+        {"direction": "higher", "tol": 0.0},
+    "qos_mixed_tenants_100rps.fairness_index":
+        {"direction": "higher", "tol": 0.08},
+    "qos_mixed_tenants_100rps.token_identity":
+        {"direction": "higher", "tol": 0.0},
+    "qos_mixed_tenants_100rps.lost":
+        {"direction": "lower", "tol": 0.0},
+    "qos_mixed_tenants_100rps.hostile_alert_tripped":
+        {"direction": "higher", "tol": 0.0},
+    "qos_mixed_tenants_100rps.compliant_clean":
+        {"direction": "higher", "tol": 0.0},
+    "qos_mixed_tenants_100rps.sigkill_lost":
+        {"direction": "lower", "tol": 0.0},
+    "qos_mixed_tenants_100rps.sigkill.token_identity":
+        {"direction": "higher", "tol": 0.0},
+    "qos_mixed_tenants_100rps.sigkill.check_qos_ok":
+        {"direction": "higher", "tol": 0.0},
+    "qos_mixed_tenants_100rps.sigkill.trace_ok":
+        {"direction": "higher", "tol": 0.0},
 }
 
 

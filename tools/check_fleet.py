@@ -33,6 +33,15 @@ their stale heartbeats and dead probes are skipped, not paged — and an
 ``autoscaler`` block (current/min/max size, standby depth, last scale
 event) is rendered and judged (a size outside [min, max] means the
 control loop and the supervisor disagree about the world).
+
+Multi-tenant fleets (serve/fairshare.py) add a third view: the
+federated ``/tenants`` rollup (per-tenant request/token/cost counters,
+pooled TTFT/TPOT percentiles, service shares and Jain's fairness
+index). It is rendered per tenant, and ``--min-fairness X`` turns it
+into a verdict — a fleet whose fairness index has collapsed below X is
+paged even while every worker is individually healthy, because a
+starved tenant is an outage for THAT tenant. Snapshot files may carry
+the rollup under a ``"tenants"`` key next to ``"healthz"``.
 """
 
 from __future__ import annotations
@@ -80,21 +89,38 @@ def fetch_flight(url: str, timeout_s: float = 3.0):
         return None
 
 
+def fetch_tenants(url: str, timeout_s: float = 3.0):
+    """GET <url>/tenants (the federated per-tenant QoS rollup); None
+    when the endpoint is missing — a single-tenant fleet has no rollup
+    and that is not a probe failure."""
+    try:
+        body = json.loads(_fetch(url, "/tenants", timeout_s))
+        return body if isinstance(body, dict) and body.get("tenants") \
+            else None
+    except Exception:
+        return None
+
+
 def load_snapshot_doc(path: str):
-    """One read of a snapshot file -> (healthz, flight|None). The file
-    is either a bare federated /healthz body or a full-plane wrapper
-    {"healthz": {...}, "metrics": "...", "flight": {...}}."""
+    """One read of a snapshot file -> (healthz, flight|None,
+    tenants|None). The file is either a bare federated /healthz body
+    or a full-plane wrapper {"healthz": {...}, "metrics": "...",
+    "flight": {...}, "tenants": {...}}."""
     with open(path) as f:
         data = json.load(f)
     flight = None
+    tenants = None
     if isinstance(data, dict) and "healthz" in data:
         fl = data.get("flight")
         flight = fl if isinstance(fl, dict) else None
+        tn = data.get("tenants")
+        tenants = tn if isinstance(tn, dict) and tn.get("tenants") \
+            else None
         data = data["healthz"]
     if not isinstance(data, dict) or "workers" not in data:
         raise ValueError("not a federated healthz body "
                          "(no 'workers' key)")
-    return data, flight
+    return data, flight, tenants
 
 
 def load_snapshot(path: str) -> dict:
@@ -163,6 +189,51 @@ def fleet_verdict(healthz: dict,
     return (not problems, problems)
 
 
+def tenant_problems(tenants, min_fairness: float) -> List[str]:
+    """Verdict over the federated /tenants rollup. Only judged when
+    ``--min-fairness`` asks for it: a fairness index below the floor
+    pages, and so does asking for the judgment on a fleet that
+    publishes no rollup (a fairness gate against nothing is a
+    misconfigured probe, same logic as exit 2 for a bad file)."""
+    if min_fairness <= 0:
+        return []
+    if not isinstance(tenants, dict) or not tenants.get("tenants"):
+        return ["--min-fairness set but the fleet publishes no "
+                "/tenants rollup (fair mode off?)"]
+    fi = tenants.get("fairness_index")
+    if fi is None:
+        return ["/tenants rollup has no fairness_index"]
+    if fi < min_fairness:
+        service = tenants.get("service") or {}
+        starved = min(service, key=service.get) if service else "?"
+        return [f"fairness index {fi:.4f} < {min_fairness} "
+                f"(most-starved tenant: {starved})"]
+    return []
+
+
+def _tenant_lines(tenants) -> List[str]:
+    """The per-tenant rollup view (federated /tenants): cost counters,
+    pooled latency percentiles, and each tenant's service share."""
+    if not isinstance(tenants, dict) or not tenants.get("tenants"):
+        return []
+    out = [f"  tenants (fleet rollup, fairness index "
+           f"{tenants.get('fairness_index', 0.0):.4f}):"]
+    share = tenants.get("share") or {}
+    for name, e in sorted((tenants.get("tenants") or {}).items()):
+        reqs = e.get("requests") or {}
+        ttft = e.get("ttft_s") or {}
+        secs = e.get("seconds") or {}
+        out.append(
+            f"    {name:>12}: {sum(reqs.values()):5d} req"
+            f"  {e.get('output_tokens', 0):7d} tok out"
+            f"  {e.get('prompt_tokens', 0):7d} prompt"
+            f"  share {share.get(name, 0.0) * 100:5.1f}%"
+            f"  ttft p99 {ttft.get('p99', 0.0) * 1e3:8.2f} ms"
+            f"  cost {sum(secs.values()):.3f}s"
+        )
+    return out
+
+
 def _flight_lines(flight: dict) -> List[str]:
     """The rolled-up latency view (federated /flight): fleet TTFT/TPOT
     and phase percentiles over the pooled worker samples."""
@@ -191,7 +262,8 @@ def _flight_lines(flight: dict) -> List[str]:
 
 
 def render(source: str, healthz: dict, ok: bool,
-           problems: List[str], flight: dict = None) -> str:
+           problems: List[str], flight: dict = None,
+           tenants: dict = None) -> str:
     lines = [f"{source}: fleet {healthz.get('status', '?')}"]
     for wid in sorted(healthz.get("workers", {})):
         w = healthz["workers"][wid]
@@ -246,6 +318,7 @@ def render(source: str, healthz: dict, ok: bool,
                 f"{last.get('size', '?')}"
                 + (f", join {join:.3f}s" if join is not None else "")
             )
+    lines.extend(_tenant_lines(tenants))
     lines.extend(_flight_lines(flight))
     if ok:
         lines.append(f"{source}: OK")
@@ -269,6 +342,11 @@ def main(argv=None) -> int:
                    metavar="S", dest="max_age",
                    help="heartbeats older than this are a failure "
                         "(default 5s)")
+    p.add_argument("--min-fairness", type=float, default=0.0,
+                   metavar="X", dest="min_fairness",
+                   help="page when the federated /tenants rollup's "
+                        "Jain's fairness index is below X "
+                        "(0 = view only, no verdict)")
     p.add_argument("--json", action="store_true",
                    help="machine-readable report per target")
     args = p.parse_args(argv)
@@ -276,12 +354,14 @@ def main(argv=None) -> int:
     reports = {}
     for target in args.targets:
         flight = None
+        tenants = None
         try:
             if target.startswith(("http://", "https://")):
                 healthz = fetch_healthz(target)
                 flight = fetch_flight(target)
+                tenants = fetch_tenants(target)
             else:
-                healthz, flight = load_snapshot_doc(target)
+                healthz, flight, tenants = load_snapshot_doc(target)
         except Exception as e:
             if args.json:
                 reports[target] = {"error": str(e)}
@@ -290,6 +370,10 @@ def main(argv=None) -> int:
             rc = max(rc, UNREADABLE)
             continue
         ok, problems = fleet_verdict(healthz, args.max_age)
+        tp = tenant_problems(tenants, args.min_fairness)
+        if tp:
+            problems = problems + tp
+            ok = False
         reports[target] = {
             "ok": ok, "status": healthz.get("status"),
             "problems": problems,
@@ -302,8 +386,15 @@ def main(argv=None) -> int:
             reports[target]["autoscaler"] = healthz["autoscaler"]
         if flight is not None:
             reports[target]["flight"] = flight.get("fleet", flight)
+        if tenants is not None:
+            reports[target]["tenants"] = {
+                "fairness_index": tenants.get("fairness_index"),
+                "share": tenants.get("share"),
+                "names": sorted((tenants.get("tenants") or {})),
+            }
         if not args.json:
-            print(render(target, healthz, ok, problems, flight))
+            print(render(target, healthz, ok, problems, flight,
+                         tenants))
         if not ok:
             rc = max(rc, PROBLEM)
     if args.json:

@@ -1,0 +1,226 @@
+"""Offline per-tenant QoS verdict over a streamed telemetry JSONL.
+
+tools/check_slo.py answers "did the RUN meet its SLOs"; this tool
+answers the multi-tenant question the QoS plane (serve/fairshare.py,
+serve/slo.py TenantSLORegistry) exists for: did each TENANT meet its
+SLOs, was service shared fairly, and did the isolation story hold —
+did the hostile tenant's burn alert trip while the compliant tenants'
+did not? Used two ways:
+
+- as a library from tests: ``qos_report`` over parsed records (the
+  tier-1 artifact test runs it over the checked-in qos bench
+  telemetry);
+- as a CLI over bench artifacts::
+
+      python tools/check_qos.py --slo '{"ttft_p99_s": 0.5}' \\
+          --hostile bulk --min-fairness 0.9 run.jsonl
+
+  exit 0 = every verdict green, 1 = a tenant verdict or the fairness /
+  isolation gate failed, 2 = input unreadable.
+
+Verdict rules (each one an isolation claim):
+
+- every NON-hostile tenant must meet every configured objective AND
+  record zero alert trips — a compliant tenant paging during someone
+  else's flood is precisely the failure weighted-fair scheduling
+  exists to prevent;
+- tenants named ``--hostile`` are exempt from the SLO verdict (their
+  latency is the cost of their own flood, not a system failure); with
+  ``--expect-hostile-trip`` their burn alert MUST have tripped, which
+  pins that the per-tenant watchdogs actually attribute the burn to
+  the tenant causing it;
+- Jain's fairness index over per-tenant output tokens delivered
+  INSIDE the contended window (completions that finished before the
+  last recorded arrival) must be at least ``--min-fairness``
+  (0 disables). The window bound matters: a run that drains to idle
+  eventually delivers every tenant's totals whatever the scheduler
+  did, so only tokens delivered while load was still arriving can
+  show who got served during the fight — under weighted-fair
+  scheduling backlogged tenants converge to equal service there,
+  under FIFO the flooder eats the fleet.
+
+Percentile math, status semantics, and record parsing are SHARED with
+check_slo.py / serve/slo.py, so per-tenant and whole-run verdicts can
+never disagree about what a p99 means. slo_exempt flights (brown-out
+sheds) are excluded from tenant verdicts for the same anti-windup
+reason the live watchdog never judged them.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional, Sequence
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ddp_practice_tpu.serve.fairshare import jains_index, tenant_name  # noqa: E402
+from ddp_practice_tpu.serve.slo import SLOConfig  # noqa: E402
+from tools.check_slo import load_events, slo_report  # noqa: E402
+
+
+def _tenant_of(record: dict) -> str:
+    return tenant_name(record.get("tenant"))
+
+
+def qos_report(records: List[dict], config: SLOConfig, *,
+               hostile: Sequence[str] = (),
+               min_fairness: float = 0.0,
+               expect_hostile_trip: bool = False) -> dict:
+    """Per-tenant SLO reports + fairness + isolation verdict."""
+    hostile_set = {tenant_name(h) for h in hostile}
+    flights = [r for r in records if r.get("kind") == "flight"]
+    tenants = sorted({_tenant_of(r) for r in flights})
+    if not tenants:
+        raise ValueError("no flight records — nothing to judge")
+    # the contended-window bound for the fairness verdict (module doc)
+    window_end = max((r["arrival"] for r in flights
+                      if r.get("arrival") is not None), default=None)
+
+    per_tenant: Dict[str, dict] = {}
+    for t in tenants:
+        # a tenant's view of the run: its own flights, plus only the
+        # alert lines attributed to it (the registry labels every
+        # per-tenant watchdog edge with tenant=...)
+        mine = [
+            r for r in records
+            if (r.get("kind") == "flight" and _tenant_of(r) == t)
+            or (r.get("kind") == "alert"
+                and tenant_name(r.get("tenant")) == t)
+            or (r.get("kind") == "instant"
+                and r.get("name") in ("slo_alert", "slo_resolve")
+                and tenant_name((r.get("attrs") or {}).get("tenant")) == t)
+        ]
+        rep = slo_report(mine, config)
+        rep["hostile"] = t in hostile_set
+        judged = [r for r in mine if r.get("kind") == "flight"
+                  and not r.get("slo_exempt")]
+        rep["output_tokens"] = sum(int(r.get("tokens") or 0)
+                                   for r in judged)
+        rep["window_tokens"] = sum(
+            int(r.get("tokens") or 0) for r in judged
+            if window_end is not None
+            and r.get("finish") is not None
+            and r["finish"] <= window_end)
+        per_tenant[t] = rep
+
+    service = [per_tenant[t]["window_tokens"] for t in tenants]
+    fairness = jains_index(service)
+
+    problems: List[str] = []
+    for t in tenants:
+        rep = per_tenant[t]
+        if rep["hostile"]:
+            continue
+        bad = [n for n, o in rep["objectives"].items() if not o["met"]]
+        if bad:
+            problems.append(f"tenant {t}: violated {', '.join(bad)}")
+        if rep["trips"]:
+            problems.append(
+                f"tenant {t}: {rep['trips']} alert trip(s) on a "
+                "compliant tenant")
+    if min_fairness > 0 and fairness < min_fairness:
+        problems.append(
+            f"fairness index {fairness:.4f} < {min_fairness}")
+    if expect_hostile_trip:
+        tripped = [t for t in hostile_set
+                   if per_tenant.get(t, {}).get("trips")]
+        if not tripped:
+            problems.append(
+                "no hostile tenant tripped its burn alert "
+                f"(expected one of {sorted(hostile_set)})")
+
+    return {
+        "tenants": per_tenant,
+        "fairness_index": fairness,
+        "service_tokens": dict(zip(tenants, service)),
+        "problems": problems,
+        "ok": not problems,
+    }
+
+
+def render(path: str, report: dict, truncated: bool) -> str:
+    lines = [f"{path}: {'OK' if report['ok'] else 'QOS VIOLATED'} — "
+             f"{len(report['tenants'])} tenant(s), fairness index "
+             f"{report['fairness_index']:.4f}"
+             + (" (crash-truncated tail line skipped)" if truncated
+                else "")]
+    for t, rep in report["tenants"].items():
+        tag = " [hostile]" if rep["hostile"] else ""
+        lines.append(
+            f"  {t}{tag}: {rep['flights']} flights, "
+            f"{rep['output_tokens']} tokens out, "
+            f"{rep['trips']} trip(s)")
+        for name, o in rep["objectives"].items():
+            verdict = "met" if o["met"] else (
+                "violated (hostile, not judged)" if rep["hostile"]
+                else "VIOLATED")
+            lines.append(
+                f"    {name:>12}: measured {o['measured']:.6g} vs "
+                f"target {o['target']:.6g} — {verdict}")
+    for p in report["problems"]:
+        lines.append(f"  PROBLEM: {p}")
+    return "\n".join(lines)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        "check_qos", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    p.add_argument("--slo", required=True, metavar="JSON|PATH",
+                   help="per-tenant SLO config: a JSON object literal "
+                        "or a path (serve/slo.py SLOConfig keys); "
+                        "applied to every tenant")
+    p.add_argument("--hostile", action="append", default=[],
+                   metavar="TENANT",
+                   help="tenant exempt from the SLO verdict (its pain "
+                        "is self-inflicted); repeatable")
+    p.add_argument("--min-fairness", dest="min_fairness", type=float,
+                   default=0.0, metavar="X",
+                   help="fail if Jain's index over per-tenant output "
+                        "tokens is below X (0 = no gate)")
+    p.add_argument("--expect-hostile-trip", dest="expect_hostile_trip",
+                   action="store_true",
+                   help="fail unless at least one --hostile tenant's "
+                        "burn alert tripped (isolation attribution)")
+    p.add_argument("--json", action="store_true",
+                   help="print the report(s) as one JSON object")
+    p.add_argument("files", nargs="+", metavar="TELEMETRY_JSONL")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        config = SLOConfig.from_json(args.slo)
+    except (ValueError, TypeError, json.JSONDecodeError) as e:
+        print(f"bad --slo: {e}", file=sys.stderr)
+        return 2
+    rc = 0
+    reports = {}
+    for path in args.files:
+        try:
+            records, truncated = load_events(path)
+            report = qos_report(
+                records, config, hostile=args.hostile,
+                min_fairness=args.min_fairness,
+                expect_hostile_trip=args.expect_hostile_trip)
+        except (OSError, ValueError) as e:
+            print(f"{path}: UNREADABLE — {e}", file=sys.stderr)
+            rc = 2
+            continue
+        reports[path] = report
+        if not args.json:
+            print(render(path, report, truncated))
+        if not report["ok"] and rc == 0:
+            rc = 1
+    if args.json:
+        print(json.dumps(reports))
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
